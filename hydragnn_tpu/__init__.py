@@ -1,0 +1,23 @@
+"""hydragnn_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework for multi-headed
+graph convolutional neural networks.
+
+Capability target: LemonAndRabbit/HydraGNN (reference layout documented in
+SURVEY.md). Public facade mirrors the reference's two entry points
+(``hydragnn/__init__.py:1-3``): ``run_training`` and ``run_prediction``.
+
+Design stance (TPU-first, not a port):
+  * graphs are batched into statically-shaped, padded ``GraphBatch`` pytrees
+    (XLA needs static shapes; padding absorbs variable graph sizes),
+  * message passing is expressed as gather + segment reductions that XLA fuses
+    onto the MXU/VPU,
+  * data parallelism is ``jax.jit`` over a ``jax.sharding.Mesh`` with the batch
+    sharded on the ``data`` axis — gradient sync is an XLA all-reduce over ICI,
+    never NCCL,
+  * the train step (forward + loss + grad + update) is ONE compiled XLA program.
+"""
+
+from hydragnn_tpu.run_training import run_training
+from hydragnn_tpu.run_prediction import run_prediction
+from hydragnn_tpu import graph, models, data, train, parallel, utils, postprocess
+
+__version__ = "0.1.0"

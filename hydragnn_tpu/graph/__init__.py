@@ -1,0 +1,10 @@
+from hydragnn_tpu.graph.batch import GraphBatch, collate_graphs, pad_sizes_for
+from hydragnn_tpu.graph.segment import (
+    segment_sum,
+    segment_mean,
+    segment_max,
+    segment_min,
+    segment_std,
+    segment_softmax,
+    segment_count,
+)

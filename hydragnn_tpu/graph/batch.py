@@ -1,0 +1,191 @@
+"""Statically-shaped padded graph batches.
+
+The reference batches graphs with torch_geometric's ragged ``Batch`` — shapes
+change every step, which is fine for eager CUDA but poison for XLA (every new
+shape is a recompile). Here a batch is ONE static shape: node/edge/graph arrays
+padded to fixed sizes, with a dedicated trailing *padding graph* that absorbs
+all padding nodes and edges (so pooled/graph-level math needs no special
+cases — the padding rows simply fall into graph ``G-1`` and are masked out).
+
+This replaces the reference's variable-graph-size machinery
+(``hydragnn/preprocess/utils.py:25-80`` detection + PyG dynamic batching) with
+the TPU-idiomatic design: pad once, compile once.
+
+Multi-task labels: the reference packs all heads into a flat ``data.y`` plus a
+``y_loc`` index table (``hydragnn/preprocess/utils.py:237-278``) and re-slices
+it every step (``train/train_validate_test.py:302-365``). We store one target
+array per head instead — graph heads ``[G, dim]``, node heads ``[N, dim]`` —
+which removes the index gymnastics from the hot loop entirely.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class GraphBatch:
+    """A padded multigraph batch (pytree; every field is a device array).
+
+    Shapes: N = padded node count, E = padded edge count, G = padded graph
+    count (always >= num real graphs + 1: the last slot is the padding graph).
+    """
+
+    x: jnp.ndarray  # [N, F] node input features
+    pos: jnp.ndarray  # [N, 3] node positions
+    senders: jnp.ndarray  # [E] int32, source node of each edge (j of j->i)
+    receivers: jnp.ndarray  # [E] int32, target node of each edge
+    edge_attr: Optional[jnp.ndarray]  # [E, De] or None
+    node_graph: jnp.ndarray  # [N] int32, graph id of each node
+    n_node: jnp.ndarray  # [G] int32
+    n_edge: jnp.ndarray  # [G] int32
+    node_mask: jnp.ndarray  # [N] bool, True on real nodes
+    edge_mask: jnp.ndarray  # [E] bool
+    graph_mask: jnp.ndarray  # [G] bool
+    targets: Tuple[jnp.ndarray, ...] = ()  # per head: [G, d] or [N, d]
+    # model-specific precomputed index arrays (e.g. DimeNet triplets),
+    # padded to static budgets host-side
+    extras: Optional[dict] = None
+
+    @property
+    def num_nodes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+    @property
+    def num_graphs(self) -> int:
+        return self.n_node.shape[0]
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return int(-(-value // multiple) * multiple)
+
+
+def pad_sizes_for(
+    max_nodes: int,
+    max_edges: int,
+    batch_size: int,
+    node_multiple: int = 8,
+    edge_multiple: int = 8,
+) -> Tuple[int, int, int]:
+    """Static pad sizes for a batch of up to ``batch_size`` graphs.
+
+    Worst-case sizing (every graph maximal) plus one guaranteed padding node
+    and one padding graph, rounded up so XLA tiles land on lane boundaries.
+    """
+    n_pad = _round_up(batch_size * max_nodes + 1, node_multiple)
+    e_pad = _round_up(max(batch_size * max_edges, 1), edge_multiple)
+    g_pad = batch_size + 1
+    return n_pad, e_pad, g_pad
+
+
+def collate_graphs(
+    samples,
+    n_pad: int,
+    e_pad: int,
+    g_pad: int,
+    head_types: Tuple[str, ...] = (),
+    head_dims: Tuple[int, ...] = (),
+    to_device: bool = False,
+):
+    """Collate a list of ``GraphData``-like samples into one padded batch.
+
+    Each sample must expose numpy arrays: ``x [n,F]``, ``pos [n,3]``,
+    ``edge_index [2,e]``, optional ``edge_attr [e,De]``, and (if ``head_types``
+    given) ``targets`` — a list with one array per head (graph head: ``[d]``,
+    node head: ``[n, d]``).
+
+    Runs on the host in numpy: this is the producer side of the input
+    pipeline; the arrays are shipped to HBM once per step.
+    """
+    num_graphs = len(samples)
+    total_nodes = int(sum(s.x.shape[0] for s in samples))
+    total_edges = int(sum(s.edge_index.shape[1] for s in samples))
+    if num_graphs > g_pad - 1:
+        raise ValueError(f"batch of {num_graphs} graphs exceeds g_pad-1={g_pad - 1}")
+    if total_nodes > n_pad - 1:
+        raise ValueError(f"{total_nodes} nodes exceed n_pad-1={n_pad - 1}")
+    if total_edges > e_pad:
+        raise ValueError(f"{total_edges} edges exceed e_pad={e_pad}")
+
+    feat_dim = samples[0].x.shape[1]
+    x = np.zeros((n_pad, feat_dim), dtype=np.float32)
+    pos = np.zeros((n_pad, 3), dtype=np.float32)
+    # padding edges point at the last node slot (always a padding node since
+    # total_nodes <= n_pad - 1) and live in the padding graph.
+    senders = np.full((e_pad,), n_pad - 1, dtype=np.int32)
+    receivers = np.full((e_pad,), n_pad - 1, dtype=np.int32)
+    edge_dim = None
+    if samples[0].edge_attr is not None:
+        edge_dim = samples[0].edge_attr.shape[1]
+        edge_attr = np.zeros((e_pad, edge_dim), dtype=np.float32)
+    node_graph = np.full((n_pad,), g_pad - 1, dtype=np.int32)
+    n_node = np.zeros((g_pad,), dtype=np.int32)
+    n_edge = np.zeros((g_pad,), dtype=np.int32)
+    node_mask = np.zeros((n_pad,), dtype=bool)
+    edge_mask = np.zeros((e_pad,), dtype=bool)
+    graph_mask = np.zeros((g_pad,), dtype=bool)
+
+    targets = []
+    for t, d in zip(head_types, head_dims):
+        if t == "graph":
+            targets.append(np.zeros((g_pad, d), dtype=np.float32))
+        else:
+            targets.append(np.zeros((n_pad, d), dtype=np.float32))
+
+    node_off = 0
+    edge_off = 0
+    for g, s in enumerate(samples):
+        n = s.x.shape[0]
+        e = s.edge_index.shape[1]
+        x[node_off : node_off + n] = s.x
+        if s.pos is not None:
+            pos[node_off : node_off + n] = s.pos
+        senders[edge_off : edge_off + e] = s.edge_index[0] + node_off
+        receivers[edge_off : edge_off + e] = s.edge_index[1] + node_off
+        if edge_dim is not None:
+            edge_attr[edge_off : edge_off + e] = s.edge_attr
+        node_graph[node_off : node_off + n] = g
+        n_node[g] = n
+        n_edge[g] = e
+        node_mask[node_off : node_off + n] = True
+        edge_mask[edge_off : edge_off + e] = True
+        graph_mask[g] = True
+        for ih, t in enumerate(head_types):
+            tgt = np.asarray(s.targets[ih], dtype=np.float32)
+            if t == "graph":
+                targets[ih][g] = tgt.reshape(-1)
+            else:
+                targets[ih][node_off : node_off + n] = tgt.reshape(n, -1)
+        node_off += n
+        edge_off += e
+
+    # padding nodes all sit in the padding graph; record its node count so
+    # segment means over the padding graph stay well-defined.
+    n_node[g_pad - 1] = n_pad - node_off
+    n_edge[g_pad - 1] = e_pad - edge_off
+
+    batch = GraphBatch(
+        x=x,
+        pos=pos,
+        senders=senders,
+        receivers=receivers,
+        edge_attr=edge_attr if edge_dim is not None else None,
+        node_graph=node_graph,
+        n_node=n_node,
+        n_edge=n_edge,
+        node_mask=node_mask,
+        edge_mask=edge_mask,
+        graph_mask=graph_mask,
+        targets=tuple(targets),
+    )
+    if to_device:
+        import jax
+
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+    return batch

@@ -1,0 +1,87 @@
+"""Segment reductions — the substrate of message passing on TPU.
+
+The reference's conv stacks lean on torch_scatter/torch_sparse CUDA kernels
+(SURVEY.md §2.4). On TPU the idiomatic equivalent is ``jax.ops.segment_sum``
+and friends: XLA lowers them to sorted-scatter programs it can fuse with the
+surrounding elementwise work, keeping everything in registers/VMEM instead of
+bouncing through HBM.
+
+All ops take static ``num_segments`` (XLA needs static output shapes) and are
+safe under padding: padded edges must carry zeroed data or be masked by the
+caller; padded segments simply produce the reduction identity.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_BIG = 1e9  # sentinel for min/max identities; float32-safe
+
+
+def segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_count(segment_ids, num_segments, weights=None):
+    """Number of elements per segment (in-degree when ids are edge receivers)."""
+    ones = (
+        jnp.ones(segment_ids.shape[0], dtype=jnp.float32)
+        if weights is None
+        else weights
+    )
+    return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments):
+    total = segment_sum(data, segment_ids, num_segments)
+    count = segment_count(segment_ids, num_segments)
+    count = jnp.maximum(count, 1.0)
+    return total / count.reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+def segment_max(data, segment_ids, num_segments, fill=0.0):
+    """Max per segment; empty segments get ``fill`` (reference semantics: padded
+    nodes should see 0, not -inf, so downstream matmuls stay finite)."""
+    out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    has = segment_count(segment_ids, num_segments) > 0
+    has = has.reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(has, jnp.where(jnp.isfinite(out), out, fill), fill)
+
+
+def segment_min(data, segment_ids, num_segments, fill=0.0):
+    out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+    has = segment_count(segment_ids, num_segments) > 0
+    has = has.reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(has, jnp.where(jnp.isfinite(out), out, fill), fill)
+
+
+def segment_std(data, segment_ids, num_segments, eps=1e-5):
+    """Per-segment standard deviation, PNA-style: sqrt(relu(E[x^2]-E[x]^2)+eps).
+
+    Matches PyG PNAConv's ``std`` aggregator numerics (reference uses it via
+    ``models/PNAStack.py:28``) so degree-scaler statistics line up.
+    """
+    mean = segment_mean(data, segment_ids, num_segments)
+    mean_sq = segment_mean(data * data, segment_ids, num_segments)
+    var = jax.nn.relu(mean_sq - mean * mean)
+    return jnp.sqrt(var + eps)
+
+
+def segment_softmax(logits, segment_ids, num_segments, mask=None):
+    """Numerically-stable softmax within segments (GAT edge attention).
+
+    ``mask`` (bool over elements) zeroes out padded edges so they contribute
+    neither to the max nor the normalizer.
+    """
+    if mask is not None:
+        m = mask.reshape(mask.shape + (1,) * (logits.ndim - 1))
+        logits = jnp.where(m, logits, -_BIG)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    logits = logits - seg_max[segment_ids]
+    unnorm = jnp.exp(logits)
+    if mask is not None:
+        m = mask.reshape(mask.shape + (1,) * (logits.ndim - 1))
+        unnorm = jnp.where(m, unnorm, 0.0)
+    denom = segment_sum(unnorm, segment_ids, num_segments)
+    denom = jnp.maximum(denom, 1e-16)
+    return unnorm / denom[segment_ids]

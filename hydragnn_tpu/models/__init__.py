@@ -1,0 +1,23 @@
+from hydragnn_tpu.models.base import HydraBase, MLPNode
+from hydragnn_tpu.models.create import (
+    MODEL_TYPES,
+    create_model_config,
+    init_model_params,
+)
+from hydragnn_tpu.models.common import (
+    MLP,
+    MaskedBatchNorm,
+    TorchLinear,
+    get_activation,
+    global_mean_pool,
+    masked_error,
+)
+from hydragnn_tpu.models.pna import PNAStack
+from hydragnn_tpu.models.gin import GINStack
+from hydragnn_tpu.models.gat import GATStack
+from hydragnn_tpu.models.mfc import MFCStack
+from hydragnn_tpu.models.sage import SAGEStack
+from hydragnn_tpu.models.cgcnn import CGCNNStack
+from hydragnn_tpu.models.schnet import SCFStack
+from hydragnn_tpu.models.egnn import EGCLStack
+from hydragnn_tpu.models.dimenet import DIMEStack, compute_triplets

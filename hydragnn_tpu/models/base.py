@@ -1,0 +1,268 @@
+"""HydraBase — the multi-headed GNN stack, TPU-native.
+
+Behavioral contract from the reference ``hydragnn/models/Base.py:26-376``:
+conv stack -> BatchNorm + activation per layer -> masked global mean pool ->
+shared graph MLP + per-head MLPs (graph heads), node heads as shared-weight
+MLP / per-node MLP bank / conv stacks -> weighted multi-task loss
+(``loss_hpweighted``, ``Base.py:356-373``).
+
+TPU-first differences:
+  * one flax module, applied inside a single jitted train step;
+  * all pooling/norm/loss are padding-aware (masks from ``GraphBatch``);
+  * per-node MLPs (``mlp_per_node``) are a single gathered parameter bank
+    (einsum over a [num_mlp, in, out] tensor) instead of a Python loop over
+    ``num_nodes`` modules (``Base.py:379-439``) — one MXU matmul;
+  * conv gradient checkpointing is ``nn.remat`` (``jax.checkpoint``) instead
+    of ``torch.utils.checkpoint`` (``Base.py:296-301``).
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.graph.batch import GraphBatch
+from hydragnn_tpu.models.common import (
+    MLP,
+    MaskedBatchNorm,
+    TorchLinear,
+    get_activation,
+    global_mean_pool,
+    masked_error,
+)
+
+
+class MLPNode(nn.Module):
+    """Node-level head: one shared MLP (``mlp``) or a per-node MLP bank
+    (``mlp_per_node``) — reference ``Base.py:379-439``.
+
+    The bank is stored as stacked parameters ``[num_mlp, fan_in, fan_out]``;
+    each node gathers its own MLP by its position within the graph, so the
+    whole head is a batched matmul instead of ``num_nodes`` separate modules.
+    """
+
+    input_dim: int
+    output_dim: int
+    num_mlp: int
+    hidden_dims: Tuple[int, ...]
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x, node_index_in_graph):
+        act = get_activation(self.activation)
+        dims = [self.input_dim] + list(self.hidden_dims) + [self.output_dim]
+        sel = jnp.clip(node_index_in_graph, 0, self.num_mlp - 1)
+        h = x
+        n_layers = len(dims) - 1
+        for i in range(n_layers):
+            fan_in, fan_out = dims[i], dims[i + 1]
+            bound = 1.0 / jnp.sqrt(fan_in)
+            kernel = self.param(
+                f"kernel_{i}",
+                lambda key, shape: jax.random.uniform(
+                    key, shape, minval=-bound, maxval=bound
+                ),
+                (self.num_mlp, fan_in, fan_out),
+            )
+            bias = self.param(
+                f"bias_{i}",
+                lambda key, shape: jax.random.uniform(
+                    key, shape, minval=-bound, maxval=bound
+                ),
+                (self.num_mlp, fan_out),
+            )
+            if self.num_mlp == 1:
+                h = h @ kernel[0] + bias[0]
+            else:
+                h = jnp.einsum("nf,nfo->no", h, kernel[sel]) + bias[sel]
+            if i < n_layers - 1:
+                h = act(h)
+        return h
+
+
+class HydraBase(nn.Module):
+    """Abstract multi-headed stack; subclasses provide ``get_conv``.
+
+    ``get_conv(in_dim, out_dim, last_layer)`` must return a flax module with
+    signature ``(x, pos, batch, train) -> (x, pos)`` (positions threaded for
+    the E(3)-equivariant stacks, reference ``Base.py:289-302``).
+    """
+
+    input_dim: int = 1
+    hidden_dim: int = 8
+    output_dim: Tuple[int, ...] = ()
+    output_type: Tuple[str, ...] = ()
+    config_heads: Dict[str, Any] = None
+    activation: str = "relu"
+    loss_function_type: str = "mse"
+    equivariance: bool = False
+    loss_weights: Tuple[float, ...] = ()
+    num_conv_layers: int = 2
+    num_nodes: Optional[int] = None
+    edge_dim: Optional[int] = None
+    conv_checkpointing: bool = False
+    initial_bias: Optional[float] = None
+    dropout: float = 0.25
+
+    @property
+    def use_edge_attr(self) -> bool:
+        return self.edge_dim is not None and self.edge_dim > 0
+
+    @property
+    def num_heads(self) -> int:
+        return len(self.output_dim)
+
+    # ---- subclass hooks ------------------------------------------------
+    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+        raise NotImplementedError
+
+    def _conv_layer_specs(self):
+        """(in_dim, out_dim, bn_dim, conv_kwargs) per encoder layer.
+
+        Default matches ``Base._init_conv`` (``Base.py:115-121``); GAT
+        overrides for attention-head concat (``GATStack.py:36-47``).
+        """
+        specs = []
+        for i in range(self.num_conv_layers):
+            in_dim = self.input_dim if i == 0 else self.hidden_dim
+            specs.append((in_dim, self.hidden_dim, self.hidden_dim, {}))
+        return specs
+
+    def _node_conv_specs(self, node_cfg, head_dim):
+        """Layer specs for a conv-type node head (``Base.py:145-203``)."""
+        dims = node_cfg["dim_headlayers"]
+        num = node_cfg["num_headlayers"]
+        specs = []
+        prev = self.hidden_dim
+        for il in range(num):
+            specs.append((prev, dims[il], dims[il], {"last_layer": False}))
+            prev = dims[il]
+        specs.append((prev, head_dim, head_dim, {"last_layer": True}))
+        return specs
+
+    def _node_index_in_graph(self, batch: GraphBatch):
+        starts = jnp.cumsum(batch.n_node) - batch.n_node
+        return jnp.arange(batch.num_nodes, dtype=jnp.int32) - starts[batch.node_graph]
+
+    def _conv_cls(self, cls):
+        """Wrap a conv class in ``nn.remat`` when conv checkpointing is on
+        (parity with ``torch.utils.checkpoint`` at ``Base.py:296-301``).
+        Subclasses must construct their conv through this hook."""
+        if self.conv_checkpointing:
+            return nn.remat(cls, static_argnums=(4,), prevent_cse=False)
+        return cls
+
+    def _apply_conv(self, conv, x, pos, batch, train):
+        return conv(x, pos, batch, train)
+
+    @nn.compact
+    def __call__(self, batch: GraphBatch, train: bool = False):
+        act = get_activation(self.activation)
+        heads_cfg = self.config_heads or {}
+        x = batch.x
+        pos = batch.pos
+
+        # ---- encoder: conv stack (Base.py:289-302) ----------------------
+        # SchNet/EGNN use Identity feature layers instead of BatchNorm
+        # (SCFStack.py:63, EGCLStack.py:41)
+        use_bn = getattr(self, "conv_use_batchnorm", True)
+        for in_dim, out_dim, bn_dim, kw in self._conv_layer_specs():
+            conv = self.get_conv(in_dim, out_dim, **kw)
+            c, pos = self._apply_conv(conv, x, pos, batch, train)
+            if use_bn:
+                c = MaskedBatchNorm(bn_dim)(c, batch.node_mask, not train)
+            x = act(c)
+
+        # ---- decoder: multihead (Base.py:205-283,304-327) ---------------
+        x_graph = global_mean_pool(x, batch.node_graph, batch.n_node, batch.num_graphs)
+
+        graph_shared = None
+        if "graph" in heads_cfg:
+            dim_shared = heads_cfg["graph"]["dim_sharedlayers"]
+            n_shared = heads_cfg["graph"]["num_sharedlayers"]
+            graph_shared = MLP(
+                [dim_shared] * n_shared,
+                activation=self.activation,
+                final_activation=True,
+                name="graph_shared",
+            )
+
+        outputs = []
+        node_index = None
+        for ihead in range(self.num_heads):
+            head_type = self.output_type[ihead]
+            head_dim = self.output_dim[ihead]
+            if head_type == "graph":
+                num_head_hidden = heads_cfg["graph"]["num_headlayers"]
+                dim_head_hidden = heads_cfg["graph"]["dim_headlayers"]
+                layer_dims = list(dim_head_hidden[:num_head_hidden]) + [head_dim]
+                head_mlp = MLP(
+                    layer_dims,
+                    activation=self.activation,
+                    final_bias_value=self.initial_bias,
+                    name=f"head_{ihead}_graph",
+                )
+                outputs.append(head_mlp(graph_shared(x_graph)))
+            elif head_type == "node":
+                node_cfg = heads_cfg["node"]
+                node_type = node_cfg["type"]
+                hidden_dims = tuple(node_cfg["dim_headlayers"])
+                if node_type in ("mlp", "mlp_per_node"):
+                    num_mlp = 1 if node_type == "mlp" else int(self.num_nodes)
+                    if node_index is None:
+                        node_index = self._node_index_in_graph(batch)
+                    head = MLPNode(
+                        input_dim=self.hidden_dim,
+                        output_dim=head_dim,
+                        num_mlp=num_mlp,
+                        hidden_dims=hidden_dims,
+                        activation=self.activation,
+                        name=f"head_{ihead}_node",
+                    )
+                    out = head(x, node_index)
+                    outputs.append(jnp.where(batch.node_mask[:, None], out, 0.0))
+                elif node_type == "conv":
+                    # shared hidden convs + per-head output conv, BatchNorm +
+                    # activation after every conv incl. the output one
+                    # (Base.py:318-323).
+                    h = x
+                    p = pos
+                    for in_dim, od, bn_dim, kw in self._node_conv_specs(
+                        node_cfg, head_dim
+                    ):
+                        conv = self.get_conv(in_dim, od, **kw)
+                        c, p = self._apply_conv(conv, h, p, batch, train)
+                        c = MaskedBatchNorm(bn_dim)(c, batch.node_mask, not train)
+                        h = act(c)
+                    outputs.append(h)
+                else:
+                    raise ValueError(
+                        f"Unknown head NN structure for node features: {node_type};"
+                        " supported: 'mlp', 'mlp_per_node', 'conv'"
+                    )
+            else:
+                raise ValueError(f"Unknown head type: {head_type}")
+        return tuple(outputs)
+
+    # ---- loss (Base.py:329-373) -----------------------------------------
+    def loss(self, outputs, batch: GraphBatch):
+        """Weighted multi-task loss; returns (total, per-task list).
+
+        ``loss_weights`` are already normalized by their abs-sum at model
+        construction (``Base.py:89-90``).
+        """
+        tot = 0.0
+        tasks = []
+        for ihead in range(self.num_heads):
+            pred = outputs[ihead]
+            target = batch.targets[ihead]
+            mask = (
+                batch.graph_mask
+                if self.output_type[ihead] == "graph"
+                else batch.node_mask
+            )
+            err = masked_error(pred, target, mask, self.loss_function_type)
+            tasks.append(err)
+            tot = tot + self.loss_weights[ihead] * err
+        return tot, tasks

@@ -1,0 +1,180 @@
+"""Shared building blocks for all model stacks.
+
+Numerics are kept behaviorally equivalent to the reference's torch modules
+(``hydragnn/models/Base.py``, ``hydragnn/utils/model.py:30-57``) — same
+activations, same BatchNorm statistics (masked to real nodes), torch-style
+uniform init so tiny CI-scale models land in the same loss basin — while the
+implementation is pure functional JAX that XLA can fuse end to end.
+"""
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.graph import segment_sum
+
+# torch.nn.Linear default init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for both
+# weight and bias (kaiming_uniform(a=sqrt(5))). variance_scaling(1/3, fan_in,
+# uniform) reproduces the weight bound exactly.
+torch_weight_init = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+
+class TorchLinear(nn.Module):
+    """Dense layer with torch.nn.Linear's default initialization."""
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        fan_in = x.shape[-1]
+        kernel = self.param("kernel", torch_weight_init, (fan_in, self.features))
+        y = x @ kernel
+        if self.use_bias:
+            bound = 1.0 / jnp.sqrt(fan_in)
+            bias = self.param(
+                "bias",
+                lambda key, shape: jax.random.uniform(
+                    key, shape, minval=-bound, maxval=bound
+                ),
+                (self.features,),
+            )
+            y = y + bias
+        return y
+
+
+def get_activation(name: str) -> Callable:
+    """Activation selection (reference: ``utils/model.py:30-47``)."""
+    table = {
+        "relu": jax.nn.relu,
+        "selu": jax.nn.selu,
+        "prelu": lambda x: jnp.where(x >= 0, x, 0.25 * x),  # PReLU at init slope
+        "elu": jax.nn.elu,
+        "gelu": jax.nn.gelu,
+        "tanh": jnp.tanh,
+        "lrelu_01": lambda x: jax.nn.leaky_relu(x, 0.1),
+        "lrelu_025": lambda x: jax.nn.leaky_relu(x, 0.25),
+        "lrelu_05": lambda x: jax.nn.leaky_relu(x, 0.5),
+        "sigmoid": jax.nn.sigmoid,
+    }
+    if name not in table:
+        raise ValueError(f"Unknown activation function: {name}")
+    return table[name]
+
+
+def masked_error(pred, target, mask, kind: str = "mse"):
+    """Masked elementwise loss, mean over real rows x features.
+
+    Matches ``loss_function_selection`` (``utils/model.py:49-57``) applied to
+    unpadded tensors: padding rows contribute nothing to numerator or count.
+    """
+    m = mask.reshape(mask.shape + (1,) * (pred.ndim - 1)).astype(pred.dtype)
+    # where (not multiply) so NaN/inf garbage in padded rows cannot leak in
+    diff = jnp.where(m > 0, pred - target, 0.0)
+    count = jnp.maximum(m.sum() * pred.shape[-1], 1.0)
+    if kind == "mse":
+        return (diff * diff).sum() / count
+    if kind == "mae":
+        return jnp.abs(diff).sum() / count
+    if kind == "rmse":
+        return jnp.sqrt((diff * diff).sum() / count)
+    if kind == "smooth_l1":
+        a = jnp.abs(diff)
+        val = jnp.where(a < 1.0, 0.5 * diff * diff, a - 0.5)
+        return (val * m).sum() / count
+    raise ValueError(f"Unknown loss function: {kind}")
+
+
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm1d over real nodes only (padding excluded from statistics).
+
+    Same statistics contract as torch's BatchNorm1d (eps=1e-5, momentum=0.1,
+    biased var for normalization, unbiased var into the running estimate),
+    used after every conv layer (reference ``models/Base.py:115-121,295-302``).
+    Under a jitted data-parallel step the batch statistics are global across
+    the mesh — i.e. SyncBatchNorm semantics (``utils/distributed.py:268-269``)
+    by construction, deterministically.
+    """
+
+    features: int
+    momentum: float = 0.1
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, mask, use_running_average: bool):
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((self.features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((self.features,), jnp.float32)
+        )
+        scale = self.param("scale", nn.initializers.ones, (self.features,))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            m = mask.astype(x.dtype)[:, None]
+            count = jnp.maximum(m.sum(), 1.0)
+            mean = (x * m).sum(axis=0) / count
+            centered = (x - mean) * m
+            var = (centered * centered).sum(axis=0) / count
+            if not self.is_initializing():
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_mean.value = (
+                    1.0 - self.momentum
+                ) * ra_mean.value + self.momentum * mean
+                ra_var.value = (
+                    1.0 - self.momentum
+                ) * ra_var.value + self.momentum * unbiased
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
+        return jnp.where(mask[:, None], y, 0.0)
+
+
+class MLP(nn.Module):
+    """Sequence of TorchLinear layers with activation after each hidden layer.
+
+    ``final_activation`` mirrors the reference's shared graph-head layers,
+    which end in an activation (``models/Base.py:208-217``), vs per-head MLPs
+    which end in a bare Linear (``:231-244``).
+    """
+
+    layer_dims: Sequence[int]
+    activation: str = "relu"
+    final_activation: bool = False
+    final_bias_value: Optional[float] = None  # UQ initial_bias (Base.py:138-143)
+
+    @nn.compact
+    def __call__(self, x):
+        act = get_activation(self.activation)
+        n = len(self.layer_dims)
+        for i, dim in enumerate(self.layer_dims):
+            if i == n - 1 and self.final_bias_value is not None:
+                fan_in = x.shape[-1]
+                kernel = self.param(
+                    f"final_kernel", torch_weight_init, (fan_in, dim)
+                )
+                bias = self.param(
+                    "final_bias",
+                    nn.initializers.constant(self.final_bias_value),
+                    (dim,),
+                )
+                x = x @ kernel + bias
+            else:
+                x = TorchLinear(dim)(x)
+            if i < n - 1 or self.final_activation:
+                x = act(x)
+        return x
+
+
+def global_mean_pool(x, node_graph, n_node, num_graphs: int):
+    """Padding-aware per-graph mean of node features -> [G, F].
+
+    Equivalent to PyG's ``global_mean_pool`` (``models/Base.py:306-309``); the
+    padding graph's row is garbage-free because padded node rows are zero.
+    """
+    total = segment_sum(x, node_graph, num_graphs)
+    denom = jnp.maximum(n_node.astype(x.dtype), 1.0)[:, None]
+    return total / denom

@@ -1,0 +1,303 @@
+"""DimeNet++ stack — directional message passing.
+
+Parity with reference ``hydragnn/models/DIMEStack.py:32-201``: per conv layer a
+Linear embedding + HydraEmbeddingBlock (no atomic-number embedding,
+``:185-201``) + InteractionPPBlock + OutputPPBlock, with Bessel radial and
+spherical (Legendre x Bessel) angular bases and an envelope cutoff; Identity
+feature layers (no encoder BatchNorm, ``:71-77``).
+
+TPU design: the reference builds triplets per batch with torch_sparse
+SparseTensor (``DIMEStack.py:158-182``) — dynamic shapes. Here triplet index
+arrays (k->j->i) are precomputed on the HOST at collation time and padded to a
+static per-batch budget (``hydragnn_tpu/data`` fills ``batch.extras``);
+distances, angles, rbf and sbf are computed inside the jitted step from those
+static index arrays, so the whole conv remains one XLA program.
+
+Basis functions: instead of sympy-lambdified code (PyG), the spherical basis
+is computed numerically — spherical Bessel j_l via upward recurrence and
+Legendre P_l(cos t) via recurrence — with the same zeros-based frequency
+scaling; behavior matches PyG's implementation for the l,n ranges used.
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from hydragnn_tpu.graph import segment_sum
+from hydragnn_tpu.models.base import HydraBase
+from hydragnn_tpu.models.common import TorchLinear
+
+# zeros of spherical Bessel functions j_l, l = 0..6, first 6 zeros each —
+# j_0 zeros are n*pi; higher-l zeros computed offline with scipy.optimize
+# (values match PyG's sympy-derived `bessel_basis` frequencies).
+_BESSEL_ZEROS = np.array(
+    [
+        [3.141593, 6.283185, 9.424778, 12.566371, 15.707963, 18.849556],
+        [4.493409, 7.725252, 10.904122, 14.066194, 17.220755, 20.371303],
+        [5.763459, 9.095011, 12.322941, 15.514603, 18.689036, 21.853874],
+        [6.987932, 10.417119, 13.698023, 16.923621, 20.121806, 23.304247],
+        [8.182561, 11.704907, 15.039665, 18.301256, 21.525418, 24.727566],
+        [9.355812, 12.966530, 16.354710, 19.653152, 22.904551, 26.127750],
+        [10.512835, 14.207392, 17.647975, 20.983463, 24.262768, 27.507868],
+    ]
+)
+
+
+def _spherical_jn(l_max: int, x):
+    """j_0..j_{l_max} via upward recurrence; x > 0 assumed (clamped)."""
+    x = jnp.maximum(x, 1e-8)
+    j = [jnp.sin(x) / x]
+    if l_max >= 1:
+        j.append(jnp.sin(x) / (x * x) - jnp.cos(x) / x)
+    for l in range(2, l_max + 1):
+        j.append((2 * l - 1) / x * j[l - 1] - j[l - 2])
+    return j
+
+
+def _legendre(l_max: int, x):
+    """P_0..P_{l_max}(x) by recurrence."""
+    p = [jnp.ones_like(x)]
+    if l_max >= 1:
+        p.append(x)
+    for l in range(2, l_max + 1):
+        p.append(((2 * l - 1) * x * p[l - 1] - (l - 1) * p[l - 2]) / l)
+    return p
+
+
+class Envelope:
+    """Smooth cutoff envelope u(x) = 1/x + a x^(p-1) + b x^p + c x^(p+1)."""
+
+    def __init__(self, exponent: int):
+        p = exponent + 1
+        self.p = p
+        self.a = -(p + 1) * (p + 2) / 2.0
+        self.b = p * (p + 2.0)
+        self.c = -p * (p + 1) / 2.0
+
+    def __call__(self, x):
+        p, a, b, c = self.p, self.a, self.b, self.c
+        xp = jnp.power(jnp.maximum(x, 1e-8), p - 1)
+        val = 1.0 / jnp.maximum(x, 1e-8) + a * xp + b * xp * x + c * xp * x * x
+        return jnp.where(x < 1.0, val, 0.0)
+
+
+class BesselBasisLayer(nn.Module):
+    num_radial: int
+    cutoff: float
+    envelope_exponent: int = 5
+
+    @nn.compact
+    def __call__(self, dist):
+        freq = self.param(
+            "freq",
+            lambda key, shape: jnp.arange(1, shape[0] + 1, dtype=jnp.float32)
+            * math.pi,
+            (self.num_radial,),
+        )
+        d = (dist / self.cutoff)[:, None]
+        env = Envelope(self.envelope_exponent)(d)
+        return env * jnp.sin(freq * d)
+
+
+class SphericalBasisLayer(nn.Module):
+    """sbf[t, l*num_radial+n] = env(d_kj) j_l(z_ln d_kj) P-norm_l(angle_t).
+
+    Mirrors PyG's SphericalBasisLayer: radial part evaluated on the k->j edge
+    distance gathered per triplet, angular part on the triplet angle. The
+    normalization constants fold into the learned linear layers downstream.
+    """
+
+    num_spherical: int
+    num_radial: int
+    cutoff: float
+    envelope_exponent: int = 5
+
+    @nn.compact
+    def __call__(self, dist, angle, idx_kj):
+        d = jnp.clip(dist / self.cutoff, 1e-6, 1.0)
+        env = Envelope(self.envelope_exponent)(d)[:, None]  # [E,1]
+        zeros = jnp.asarray(
+            _BESSEL_ZEROS[: self.num_spherical, : self.num_radial],
+            dtype=jnp.float32,
+        )
+        jl = _spherical_jn(self.num_spherical - 1, d[:, None, None] * zeros[None])
+        rbf = jnp.stack(
+            [jl[l][:, l, :] for l in range(self.num_spherical)], axis=1
+        )  # [E, S, R]
+        rbf = env[:, :, None] * rbf
+        cbf = jnp.stack(
+            _legendre(self.num_spherical - 1, jnp.cos(angle)), axis=1
+        )  # [T, S]
+        out = rbf[idx_kj] * cbf[:, :, None]  # [T, S, R]
+        return out.reshape(out.shape[0], self.num_spherical * self.num_radial)
+
+
+class ResidualLayer(nn.Module):
+    dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = jax.nn.silu(TorchLinear(self.dim, name="lin1")(x))
+        h = jax.nn.silu(TorchLinear(self.dim, name="lin2")(h))
+        return x + h
+
+
+class DimeNetConv(nn.Module):
+    """One reference "conv": lin -> embedding -> interaction -> output block
+    (``DIMEStack.py:79-116``)."""
+
+    in_dim: int
+    out_dim: int
+    hidden_dim: int
+    int_emb_size: int
+    basis_emb_size: int
+    out_emb_size: int
+    num_radial: int
+    num_spherical: int
+    num_before_skip: int
+    num_after_skip: int
+    cutoff: float
+    envelope_exponent: int
+
+    @nn.compact
+    def __call__(self, x, pos, batch, train: bool = False):
+        act = jax.nn.silu
+        ex = batch.extras
+        i, j = batch.receivers, batch.senders
+        idx_i, idx_j, idx_k = ex["trip_i"], ex["trip_j"], ex["trip_k"]
+        idx_kj, idx_ji = ex["trip_kj"], ex["trip_ji"]
+        trip_mask = ex["trip_mask"]
+        n = x.shape[0]
+        num_edges = i.shape[0]
+
+        dist = jnp.sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
+        dist = jnp.where(batch.edge_mask, dist, self.cutoff)  # keep env finite
+
+        pos_i = pos[idx_i]
+        pos_ji = pos[idx_j] - pos_i
+        pos_ki = pos[idx_k] - pos_i
+        a = (pos_ji * pos_ki).sum(-1)
+        b = jnp.linalg.norm(jnp.cross(pos_ji, pos_ki), axis=-1)
+        angle = jnp.arctan2(b, a)
+
+        rbf = BesselBasisLayer(
+            self.num_radial, self.cutoff, self.envelope_exponent, name="rbf"
+        )(dist)
+        sbf = SphericalBasisLayer(
+            self.num_spherical,
+            self.num_radial,
+            self.cutoff,
+            self.envelope_exponent,
+            name="sbf",
+        )(dist, angle, idx_kj)
+        sbf = jnp.where(trip_mask[:, None], sbf, 0.0)
+
+        # lin + embedding block (edge-level states)
+        h = TorchLinear(self.hidden_dim, name="lin")(x)
+        r = act(TorchLinear(self.hidden_dim, name="emb_lin_rbf")(rbf))
+        e = act(
+            TorchLinear(self.hidden_dim, name="emb_lin")(
+                jnp.concatenate([h[i], h[j], r], axis=-1)
+            )
+        )
+
+        # InteractionPPBlock
+        rbf_b = TorchLinear(self.basis_emb_size, use_bias=False, name="int_rbf1")(rbf)
+        rbf_b = TorchLinear(self.hidden_dim, use_bias=False, name="int_rbf2")(rbf_b)
+        sbf_b = TorchLinear(self.basis_emb_size, use_bias=False, name="int_sbf1")(sbf)
+        sbf_b = TorchLinear(self.int_emb_size, use_bias=False, name="int_sbf2")(sbf_b)
+        x_ji = act(TorchLinear(self.hidden_dim, name="int_lin_ji")(e))
+        x_kj = act(TorchLinear(self.hidden_dim, name="int_lin_kj")(e))
+        x_kj = x_kj * rbf_b
+        x_kj = act(TorchLinear(self.int_emb_size, use_bias=False, name="int_down")(x_kj))
+        x_kj = jnp.where(trip_mask[:, None], x_kj[idx_kj] * sbf_b, 0.0)
+        x_kj = segment_sum(x_kj, idx_ji, num_edges)
+        x_kj = act(TorchLinear(self.hidden_dim, use_bias=False, name="int_up")(x_kj))
+        hh = x_ji + x_kj
+        for bi in range(self.num_before_skip):
+            hh = ResidualLayer(self.hidden_dim, name=f"before_skip_{bi}")(hh)
+        hh = act(TorchLinear(self.hidden_dim, name="int_lin")(hh)) + e
+        for ai in range(self.num_after_skip):
+            hh = ResidualLayer(self.hidden_dim, name=f"after_skip_{ai}")(hh)
+
+        # OutputPPBlock: edge states -> node states
+        o = TorchLinear(self.hidden_dim, use_bias=False, name="out_lin_rbf")(rbf) * hh
+        o = jnp.where(batch.edge_mask[:, None], o, 0.0)
+        o = segment_sum(o, i, n)
+        o = TorchLinear(self.out_emb_size, use_bias=False, name="out_up")(o)
+        o = act(TorchLinear(self.out_emb_size, name="out_0")(o))
+        o = TorchLinear(self.out_dim, use_bias=False, name="out_final")(o)
+        return o, pos
+
+
+class DIMEStack(HydraBase):
+    basis_emb_size: int = 8
+    envelope_exponent: int = 5
+    int_emb_size: int = 64
+    out_emb_size: int = 128
+    num_after_skip: int = 2
+    num_before_skip: int = 1
+    num_radial: int = 6
+    num_spherical: int = 7
+    radius: float = 2.0
+    conv_use_batchnorm: bool = False  # Identity feature layers (DIMEStack.py:73)
+
+    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+        # hidden = out if in==1 else in (DIMEStack.py:80)
+        hidden_dim = out_dim if in_dim == 1 else in_dim
+        assert hidden_dim > 1, (
+            "DimeNet requires more than one hidden dimension between "
+            "input_dim and output_dim."
+        )
+        return self._conv_cls(DimeNetConv)(
+            in_dim=in_dim,
+            out_dim=out_dim,
+            hidden_dim=hidden_dim,
+            int_emb_size=self.int_emb_size,
+            basis_emb_size=self.basis_emb_size,
+            out_emb_size=self.out_emb_size,
+            num_radial=self.num_radial,
+            num_spherical=self.num_spherical,
+            num_before_skip=self.num_before_skip,
+            num_after_skip=self.num_after_skip,
+            cutoff=self.radius,
+            envelope_exponent=self.envelope_exponent,
+        )
+
+
+def compute_triplets(edge_index: np.ndarray, num_nodes: int):
+    """Host-side triplet construction (k->j -> j->i), numpy.
+
+    Same contract as the reference's SparseTensor version
+    (``DIMEStack.py:158-182``): for every directed edge j->i and every edge
+    k->j with k != i, emit (idx_i, idx_j, idx_k, idx_kj, idx_ji).
+    """
+    row, col = edge_index[0], edge_index[1]  # j -> i
+    num_edges = row.shape[0]
+    # incoming edge ids per node: edges whose receiver is v
+    in_edges = [[] for _ in range(num_nodes)]
+    for eid in range(num_edges):
+        in_edges[col[eid]].append(eid)
+    ti, tj, tk, tkj, tji = [], [], [], [], []
+    for eid in range(num_edges):
+        jn, inode = row[eid], col[eid]
+        for kj in in_edges[jn]:  # edges k -> j
+            k = row[kj]
+            if k == inode:
+                continue
+            ti.append(inode)
+            tj.append(jn)
+            tk.append(k)
+            tkj.append(kj)
+            tji.append(eid)
+    return (
+        np.asarray(ti, dtype=np.int32),
+        np.asarray(tj, dtype=np.int32),
+        np.asarray(tk, dtype=np.int32),
+        np.asarray(tkj, dtype=np.int32),
+        np.asarray(tji, dtype=np.int32),
+    )

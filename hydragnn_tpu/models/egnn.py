@@ -1,0 +1,90 @@
+"""EGNN stack — E(n)-equivariant graph convolution.
+
+Parity with reference ``hydragnn/models/EGCLStack.py:21-245`` (custom E_GCL):
+edge MLP on [h_row, h_col, ||dx||^2, e_ij] (2x Linear+ReLU), node MLP on
+[h, aggregated messages], tanh-bounded equivariant coordinate update with
+xavier(gain=1e-3) final layer, message aggregation at the SENDER index
+(``:194,210`` — `row` = edge_index[0]), Identity feature layers (no encoder
+BatchNorm, ``:36-46``), coord update gated off on the last layer.
+"""
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.graph import segment_sum
+from hydragnn_tpu.models.base import HydraBase
+from hydragnn_tpu.models.common import TorchLinear
+
+
+class E_GCL(nn.Module):
+    in_dim: int
+    out_dim: int
+    hidden_dim: int
+    edge_attr_dim: int
+    equivariant: bool
+
+    @nn.compact
+    def __call__(self, x, pos, batch, train: bool = False):
+        n = x.shape[0]
+        row, col = batch.senders, batch.receivers
+
+        coord_diff = pos[row] - pos[col]
+        radial = (coord_diff * coord_diff).sum(-1, keepdims=True)
+        norm = jnp.sqrt(radial) + 1.0  # norm_diff=True
+        coord_diff = coord_diff / norm
+
+        parts = [x[row], x[col], radial]
+        if self.edge_attr_dim > 0:
+            parts.append(batch.edge_attr)
+        e = jnp.concatenate(parts, axis=-1)
+        e = jax.nn.relu(TorchLinear(self.hidden_dim, name="edge_mlp_0")(e))
+        e = jax.nn.relu(TorchLinear(self.hidden_dim, name="edge_mlp_1")(e))
+        e = jnp.where(batch.edge_mask[:, None], e, 0.0)
+
+        if self.equivariant:
+            cw = jax.nn.relu(TorchLinear(self.hidden_dim, name="coord_mlp_0")(e))
+            small = nn.initializers.variance_scaling(
+                0.001 * 0.001 / 3.0, "fan_avg", "uniform"
+            )
+            cw = cw @ self.param("coord_mlp_1", small, (self.hidden_dim, 1))
+            cw = jnp.tanh(cw)  # tanh=True bounds the update
+            trans = jnp.clip(coord_diff * cw, -100.0, 100.0)
+            trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
+            agg = segment_sum(trans, row, n)
+            cnt = segment_sum(batch.edge_mask.astype(trans.dtype), row, n)
+            pos = pos + agg / jnp.maximum(cnt, 1.0)[:, None]
+
+        # node model: aggregate edge features at the sender index (row)
+        agg = segment_sum(e, row, n)
+        h = jnp.concatenate([x, agg], axis=-1)
+        h = jax.nn.relu(TorchLinear(self.hidden_dim, name="node_mlp_0")(h))
+        h = TorchLinear(self.out_dim, name="node_mlp_1")(h)
+        return h, pos
+
+
+class EGCLStack(HydraBase):
+    conv_use_batchnorm: bool = False  # Identity feature layers (EGCLStack.py:41)
+
+    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+        return self._conv_cls(E_GCL)(
+            in_dim=in_dim,
+            out_dim=out_dim,
+            hidden_dim=self.hidden_dim,
+            edge_attr_dim=self.edge_dim if self.edge_dim else 0,
+            equivariant=self.equivariance and not last_layer,
+        )
+
+    def _conv_layer_specs(self):
+        specs = []
+        for i in range(self.num_conv_layers):
+            in_dim = self.input_dim if i == 0 else self.hidden_dim
+            specs.append(
+                (
+                    in_dim,
+                    self.hidden_dim,
+                    self.hidden_dim,
+                    {"last_layer": i == self.num_conv_layers - 1},
+                )
+            )
+        return specs

@@ -1,0 +1,149 @@
+"""GAT stack — GATv2 attention.
+
+Parity with reference ``hydragnn/models/GATStack.py:22-118`` (PyG GATv2Conv:
+heads/negative_slope from the factory — 6 / 0.05, ``models/create.py:150-152``
+— dropout on attention, add_self_loops=True, per-layer concat schedule:
+hidden layers concat heads, final layer averages them,
+``GATStack.py:36-47``).
+
+TPU shape: self-loops are appended as a virtual edge block (static shapes);
+attention softmax is a masked segment softmax over receivers.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.graph import segment_softmax, segment_sum
+from hydragnn_tpu.models.base import HydraBase
+
+
+class GATv2Conv(nn.Module):
+    in_dim: int
+    out_dim: int
+    heads: int
+    negative_slope: float
+    dropout: float
+    concat: bool
+
+    @nn.compact
+    def __call__(self, x, pos, batch, train: bool = False):
+        n = x.shape[0]
+        h, c = self.heads, self.out_dim
+        glorot = nn.initializers.xavier_uniform()
+        w_l = self.param("w_l", glorot, (self.in_dim, h * c))
+        b_l = self.param("b_l", nn.initializers.zeros, (h * c,))
+        w_r = self.param("w_r", glorot, (self.in_dim, h * c))
+        b_r = self.param("b_r", nn.initializers.zeros, (h * c,))
+        att = self.param("att", glorot, (1, h, c))
+
+        x_l = (x @ w_l + b_l).reshape(n, h, c)
+        x_r = (x @ w_r + b_r).reshape(n, h, c)
+
+        # real edges + one self-loop per node (add_self_loops=True)
+        loop = jnp.arange(n, dtype=batch.senders.dtype)
+        send = jnp.concatenate([batch.senders, loop])
+        recv = jnp.concatenate([batch.receivers, loop])
+        emask = jnp.concatenate([batch.edge_mask, batch.node_mask])
+
+        g = x_l[send] + x_r[recv]
+        g = jax.nn.leaky_relu(g, self.negative_slope)
+        alpha = (g * att).sum(axis=-1)  # [E+N, H]
+        alpha = segment_softmax(alpha, recv, n, mask=emask)
+        alpha = nn.Dropout(rate=self.dropout, deterministic=not train)(alpha)
+        msg = x_l[send] * alpha[..., None]
+        msg = jnp.where(emask[:, None, None], msg, 0.0)
+        out = segment_sum(msg, recv, n)  # [N, H, C]
+
+        if self.concat:
+            out = out.reshape(n, h * c)
+            bias = self.param("bias", nn.initializers.zeros, (h * c,))
+        else:
+            out = out.mean(axis=1)
+            bias = self.param("bias", nn.initializers.zeros, (c,))
+        return out + bias, pos
+
+
+class GATStack(HydraBase):
+    heads: int = 6
+    negative_slope: float = 0.05
+
+    def _conv_layer_specs(self):
+        # concat on all but the last conv layer (GATStack.py:36-47)
+        specs = [
+            (
+                self.input_dim,
+                self.hidden_dim,
+                self.hidden_dim * self.heads,
+                {"concat": True},
+            )
+        ]
+        for _ in range(self.num_conv_layers - 2):
+            specs.append(
+                (
+                    self.hidden_dim * self.heads,
+                    self.hidden_dim,
+                    self.hidden_dim * self.heads,
+                    {"concat": True},
+                )
+            )
+        specs.append(
+            (
+                self.hidden_dim * self.heads,
+                self.hidden_dim,
+                self.hidden_dim,
+                {"concat": False},
+            )
+        )
+        return specs
+
+    def _node_conv_specs(self, node_cfg, head_dim):
+        # concat on hidden node-head convs, average on the output conv
+        # (GATStack.py:49-90)
+        dims = node_cfg["dim_headlayers"]
+        num = node_cfg["num_headlayers"]
+        specs = [
+            (
+                self.hidden_dim,
+                dims[0],
+                dims[0] * self.heads,
+                {"concat": True, "last_layer": False},
+            )
+        ]
+        for il in range(num - 1):
+            specs.append(
+                (
+                    dims[il] * self.heads,
+                    dims[il + 1],
+                    dims[il + 1] * self.heads,
+                    {"concat": True, "last_layer": False},
+                )
+            )
+        specs.append(
+            (
+                dims[-1] * self.heads,
+                head_dim,
+                head_dim,
+                {"concat": False, "last_layer": True},
+            )
+        )
+        return specs
+
+    def get_conv(
+        self,
+        in_dim: int,
+        out_dim: int,
+        last_layer: bool = False,
+        concat: bool = True,
+        **kw,
+    ):
+        return self._conv_cls(GATv2Conv)(
+            in_dim=in_dim,
+            out_dim=out_dim,
+            heads=self.heads,
+            negative_slope=self.negative_slope,
+            dropout=self.dropout,
+            concat=concat,
+        )

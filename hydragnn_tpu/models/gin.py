@@ -1,0 +1,36 @@
+"""GIN stack — Graph Isomorphism Network.
+
+Parity with reference ``hydragnn/models/GINStack.py:21-47``: PyG GINConv with
+an inner MLP [Linear(in,out), ReLU, Linear(out,out)], trainable eps
+initialized at 100.0. Formula: out = MLP((1 + eps) * x_i + sum_{j->i} x_j).
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.graph import segment_sum
+from hydragnn_tpu.models.base import HydraBase
+from hydragnn_tpu.models.common import TorchLinear
+
+
+class GINConv(nn.Module):
+    in_dim: int
+    out_dim: int
+    eps_init: float = 100.0
+
+    @nn.compact
+    def __call__(self, x, pos, batch, train: bool = False):
+        eps = self.param("eps", nn.initializers.constant(self.eps_init), ())
+        msg = x[batch.senders]
+        msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
+        aggr = segment_sum(msg, batch.receivers, x.shape[0])
+        h = (1.0 + eps) * x + aggr
+        h = TorchLinear(self.out_dim, name="mlp_0")(h)
+        h = nn.relu(h)  # GINStack hardcodes ReLU inside the conv MLP
+        h = TorchLinear(self.out_dim, name="mlp_1")(h)
+        return h, pos
+
+
+class GINStack(HydraBase):
+    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+        return self._conv_cls(GINConv)(in_dim=in_dim, out_dim=out_dim)

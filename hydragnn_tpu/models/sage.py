@@ -1,0 +1,40 @@
+"""GraphSAGE stack.
+
+Parity with reference ``hydragnn/models/SAGEStack.py:22-43`` (PyG SAGEConv
+defaults): out = lin_l(mean_{j->i} x_j) + lin_r(x_i), lin_r without bias.
+"""
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.graph import segment_mean
+from hydragnn_tpu.models.base import HydraBase
+from hydragnn_tpu.models.common import TorchLinear
+
+
+class SAGEConv(nn.Module):
+    in_dim: int
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x, pos, batch, train: bool = False):
+        msg = x[batch.senders]
+        msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
+        # mean over real incoming edges only: sum then divide by real degree
+        n = x.shape[0]
+        from hydragnn_tpu.graph import segment_count, segment_sum
+
+        total = segment_sum(msg, batch.receivers, n)
+        deg = segment_count(
+            batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
+        )
+        aggr = total / jnp.maximum(deg, 1.0)[:, None]
+        out = TorchLinear(self.out_dim, name="lin_l")(aggr) + TorchLinear(
+            self.out_dim, use_bias=False, name="lin_r"
+        )(x)
+        return out, pos
+
+
+class SAGEStack(HydraBase):
+    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+        return self._conv_cls(SAGEConv)(in_dim=in_dim, out_dim=out_dim)

@@ -1,0 +1,137 @@
+"""SchNet stack (SCF) — continuous-filter convolutions.
+
+Parity with reference ``hydragnn/models/SCFStack.py:32-223``: GaussianSmearing
+distance basis, CFConv with cosine cutoff, ShiftedSoftplus filter MLP,
+Identity feature layers (NO BatchNorm in the encoder, ``SCFStack.py:51-68``),
+optional E(3)-equivariant position updates gated OFF on the last conv layer
+(``:59-66``).
+
+TPU design note: the reference recomputes the radius interaction graph from
+positions every layer (``RadiusInteractionGraph``). Under XLA we keep the edge
+TOPOLOGY static (host-side radius graph with the same cutoff) and recompute
+edge WEIGHTS from the current positions each layer — identical when positions
+are fixed, and a faithful approximation under the tiny (gain=1e-3) equivariant
+position updates.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.graph import segment_mean, segment_sum
+from hydragnn_tpu.models.base import HydraBase
+from hydragnn_tpu.models.common import TorchLinear
+
+
+def shifted_softplus(x):
+    return jax.nn.softplus(x) - math.log(2.0)
+
+
+class GaussianSmearing(nn.Module):
+    start: float
+    stop: float
+    num_gaussians: int
+
+    @nn.compact
+    def __call__(self, dist):
+        offset = jnp.linspace(self.start, self.stop, self.num_gaussians)
+        coeff = -0.5 / (offset[1] - offset[0]) ** 2
+        d = dist[:, None] - offset[None, :]
+        return jnp.exp(coeff * d * d)
+
+
+class CFConv(nn.Module):
+    in_dim: int
+    out_dim: int
+    num_filters: int
+    num_gaussians: int
+    cutoff: float
+    equivariant: bool
+    use_edge_attr: bool
+
+    @nn.compact
+    def __call__(self, x, pos, batch, train: bool = False):
+        n = x.shape[0]
+        send, recv = batch.senders, batch.receivers
+        if self.use_edge_attr:
+            # reference: edge_weight = edge_attr.norm(dim=-1) on the
+            # normalized lengths (SCFStack.py:123-131)
+            edge_weight = jnp.linalg.norm(batch.edge_attr, axis=-1)
+        else:
+            diff = pos[send] - pos[recv]
+            edge_weight = jnp.sqrt((diff * diff).sum(-1) + 1e-12)
+        edge_attr = GaussianSmearing(0.0, self.cutoff, self.num_gaussians)(
+            edge_weight
+        )
+
+        # filter network: Linear, ShiftedSoftplus, Linear; cosine cutoff
+        w = TorchLinear(self.num_filters, name="filter_0")(edge_attr)
+        w = shifted_softplus(w)
+        w = TorchLinear(self.num_filters, name="filter_1")(w)
+        cos_cut = 0.5 * (jnp.cos(edge_weight * math.pi / self.cutoff) + 1.0)
+        w = w * cos_cut[:, None]
+        w = jnp.where(batch.edge_mask[:, None], w, 0.0)
+
+        glorot = nn.initializers.xavier_uniform()
+        lin1 = self.param("lin1", glorot, (self.in_dim, self.num_filters))
+        h = x @ lin1
+
+        if self.equivariant:
+            # coord update (SCFStack.py:173-181): aggregate at senders
+            diff = pos[send] - pos[recv]
+            norm = jnp.sqrt((diff * diff).sum(-1, keepdims=True)) + 1.0
+            coord_diff = diff / norm
+            cw = TorchLinear(self.num_filters, name="coord_mlp_0")(w)
+            cw = jax.nn.relu(cw)
+            small = nn.initializers.variance_scaling(
+                0.001 * 0.001 / 3.0, "fan_avg", "uniform"
+            )
+            cw = cw @ self.param("coord_mlp_1", small, (self.num_filters, 1))
+            trans = jnp.clip(coord_diff * cw, -100.0, 100.0)
+            trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
+            agg = segment_sum(trans, send, n)
+            cnt = segment_sum(batch.edge_mask.astype(trans.dtype), send, n)
+            pos = pos + agg / jnp.maximum(cnt, 1.0)[:, None]
+
+        msg = h[send] * w
+        aggr = segment_sum(msg, recv, n)
+        lin2 = self.param("lin2", glorot, (self.num_filters, self.out_dim))
+        bias2 = self.param("bias2", nn.initializers.zeros, (self.out_dim,))
+        out = aggr @ lin2 + bias2
+        return out, pos
+
+
+class SCFStack(HydraBase):
+    num_filters: int = 126
+    num_gaussians: int = 50
+    radius: float = 2.0
+    conv_use_batchnorm: bool = False  # Identity feature layers (SCFStack.py:63)
+
+    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+        return self._conv_cls(CFConv)(
+            in_dim=in_dim,
+            out_dim=out_dim,
+            num_filters=self.num_filters,
+            num_gaussians=self.num_gaussians,
+            cutoff=self.radius,
+            equivariant=self.equivariance and not last_layer,
+            use_edge_attr=self.use_edge_attr,
+        )
+
+    def _conv_layer_specs(self):
+        # same dims as Base, but the equivariance gate needs last_layer info
+        specs = []
+        for i in range(self.num_conv_layers):
+            in_dim = self.input_dim if i == 0 else self.hidden_dim
+            specs.append(
+                (
+                    in_dim,
+                    self.hidden_dim,
+                    self.hidden_dim,
+                    {"last_layer": i == self.num_conv_layers - 1},
+                )
+            )
+        return specs

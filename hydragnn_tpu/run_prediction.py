@@ -1,0 +1,17 @@
+"""run_prediction facade (reference: ``hydragnn/run_prediction.py:48-107``).
+
+Loads the trained model named by the config's derived log name, runs the test
+split, returns (total_rmse, per-head rmse list, true values, predictions)
+with optional denormalization.
+"""
+
+import json
+
+
+def run_prediction(config, use_devices=None):
+    if isinstance(config, str):
+        with open(config, "r") as f:
+            config = json.load(f)
+    from hydragnn_tpu.train.driver import run_prediction_impl
+
+    return run_prediction_impl(config)

@@ -1,0 +1,17 @@
+"""run_training facade (reference: ``hydragnn/run_training.py:49-182``).
+
+Accepts a config dict or a path to a JSON config file; orchestrates
+distributed setup -> data loading/splitting -> config derivation -> model ->
+optimizer -> train/validate/test -> checkpoint save.
+"""
+
+import json
+
+
+def run_training(config, use_devices=None):
+    if isinstance(config, str):
+        with open(config, "r") as f:
+            config = json.load(f)
+    from hydragnn_tpu.train.driver import run_training_impl
+
+    return run_training_impl(config)

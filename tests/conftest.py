@@ -1,0 +1,27 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference CI strategy (SURVEY.md §4): their "fake cluster" is
+gloo-on-CPU under mpirun; ours is XLA's host-platform device partitioning —
+the same sharded code paths compile and run with N=8 logical devices on one
+host, no mocks.
+
+Note: this container pre-imports jax and pins JAX_PLATFORMS to the TPU plugin
+at interpreter startup, so plain env vars in conftest are too late — we
+override through jax.config before any backend is initialized.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,155 @@
+"""Forward/init smoke tests: every stack builds, runs, and yields finite
+outputs and losses on a padded random batch (single-head graph + node)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.graph import GraphBatch, collate_graphs, pad_sizes_for
+from hydragnn_tpu.models import (
+    MODEL_TYPES,
+    compute_triplets,
+    create_model_config,
+    init_model_params,
+)
+
+
+class FakeData:
+    def __init__(self, rng, n):
+        self.x = rng.random((n, 1)).astype(np.float32)
+        self.pos = rng.random((n, 3)).astype(np.float32)
+        # ring graph, both directions
+        src = np.arange(n)
+        dst = (src + 1) % n
+        self.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        d = np.linalg.norm(
+            self.pos[self.edge_index[0]] - self.pos[self.edge_index[1]], axis=1
+        )
+        self.edge_attr = d[:, None].astype(np.float32)
+        self.targets = [
+            np.array([self.x.sum()], dtype=np.float32),  # graph head
+            self.x.astype(np.float32),  # node head
+        ]
+
+
+def make_batch(num_graphs=3, max_n=6, with_triplets=False):
+    rng = np.random.default_rng(0)
+    samples = [FakeData(rng, rng.integers(3, max_n + 1)) for _ in range(num_graphs)]
+    n_pad, e_pad, g_pad = pad_sizes_for(max_n, 2 * max_n, num_graphs)
+    batch = collate_graphs(
+        samples,
+        n_pad,
+        e_pad,
+        g_pad,
+        head_types=("graph", "node"),
+        head_dims=(1, 1),
+    )
+    if with_triplets:
+        t_pad = 8 * e_pad
+        ti = np.full((t_pad,), n_pad - 1, np.int32)
+        tj = np.full((t_pad,), n_pad - 1, np.int32)
+        tk = np.full((t_pad,), n_pad - 1, np.int32)
+        tkj = np.zeros((t_pad,), np.int32)
+        tji = np.zeros((t_pad,), np.int32)
+        tmask = np.zeros((t_pad,), bool)
+        off_n = 0
+        off_e = 0
+        off_t = 0
+        for s in samples:
+            a, b, c, kj, ji = compute_triplets(s.edge_index, s.x.shape[0])
+            t = a.shape[0]
+            ti[off_t : off_t + t] = a + off_n
+            tj[off_t : off_t + t] = b + off_n
+            tk[off_t : off_t + t] = c + off_n
+            tkj[off_t : off_t + t] = kj + off_e
+            tji[off_t : off_t + t] = ji + off_e
+            tmask[off_t : off_t + t] = True
+            off_t += t
+            off_n += s.x.shape[0]
+            off_e += s.edge_index.shape[1]
+        batch = batch.replace(
+            extras={
+                "trip_i": ti,
+                "trip_j": tj,
+                "trip_k": tk,
+                "trip_kj": tkj,
+                "trip_ji": tji,
+                "trip_mask": tmask,
+            }
+        )
+    return jax.tree_util.tree_map(jnp.asarray, batch)
+
+
+def arch_config(model_type):
+    cfg = {
+        "model_type": model_type,
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 2,
+                "dim_sharedlayers": 4,
+                "num_headlayers": 2,
+                "dim_headlayers": [10, 10],
+            },
+            "node": {"num_headlayers": 2, "dim_headlayers": [4, 4], "type": "mlp"},
+        },
+        "task_weights": [1.0, 1.0],
+        "num_conv_layers": 2,
+        "num_nodes": 6,
+        "max_neighbours": 10,
+        "edge_dim": None,
+        "pna_deg": [0, 2, 10, 4],
+        "num_gaussians": 50,
+        "num_filters": 16,
+        "radius": 2.0,
+        "basis_emb_size": 8,
+        "envelope_exponent": 5,
+        "int_emb_size": 16,
+        "out_emb_size": 16,
+        "num_after_skip": 2,
+        "num_before_skip": 1,
+        "num_radial": 6,
+        "num_spherical": 7,
+        "equivariance": False,
+    }
+    return cfg
+
+
+@pytest.mark.parametrize("model_type", MODEL_TYPES)
+def pytest_forward_finite(model_type):
+    batch = make_batch(with_triplets=(model_type == "DimeNet"))
+    model = create_model_config(arch_config(model_type))
+    variables = init_model_params(model, batch)
+    outputs, _ = model.apply(
+        variables,
+        batch,
+        train=True,
+        mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(2)},
+    )
+    assert len(outputs) == 2
+    assert outputs[0].shape == (batch.num_graphs, 1)
+    assert outputs[1].shape == (batch.num_nodes, 1)
+    tot, tasks = model.loss(outputs, batch)
+    assert jnp.isfinite(tot), f"{model_type} loss not finite"
+    for t in tasks:
+        assert jnp.isfinite(t)
+
+
+@pytest.mark.parametrize("model_type", ["SchNet", "EGNN"])
+def pytest_equivariant_forward(model_type):
+    batch = make_batch()
+    cfg = arch_config(model_type)
+    cfg["equivariance"] = True
+    model = create_model_config(cfg)
+    variables = init_model_params(model, batch)
+    outputs = model.apply(variables, batch, train=False)
+    tot, _ = model.loss(outputs, batch)
+    assert jnp.isfinite(tot)
