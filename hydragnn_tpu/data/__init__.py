@@ -1,0 +1,25 @@
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.data.radius_graph import radius_graph, radius_graph_pbc
+from hydragnn_tpu.data.loaders import (
+    BatchLayout,
+    GraphLoader,
+    compute_layout,
+    create_dataloaders,
+    dataset_loading_and_splitting,
+    total_to_train_val_test_pkls,
+    transform_raw_data_to_serialized,
+)
+from hydragnn_tpu.data.serialized import (
+    SerializedGraphLoader,
+    extract_targets,
+    select_input_node_features,
+)
+from hydragnn_tpu.data.split import (
+    compositional_stratified_splitting,
+    split_dataset,
+    stratified_subsample,
+)
+from hydragnn_tpu.data.raw import AbstractRawDataset
+from hydragnn_tpu.data.lsms import LSMSDataset
+from hydragnn_tpu.data.cfg import CFGDataset
+from hydragnn_tpu.data.xyz import XYZDataset

@@ -1,0 +1,308 @@
+"""Batch loaders: samples -> statically-shaped padded GraphBatch streams.
+
+Replaces PyG's DataLoader + DistributedSampler (``preprocess/load_data.py:
+207-297``) with a numpy collator targeting ONE compiled XLA program: pad
+sizes (the "layout") are computed once over all splits, every batch of a
+split shares the same shapes, and per-epoch shuffling follows
+DistributedSampler semantics (seeded by epoch via ``set_epoch``, sharded
+evenly across processes with wrap-around padding).
+"""
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.graph.batch import collate_graphs, pad_sizes_for
+
+
+@dataclass
+class BatchLayout:
+    n_pad: int
+    e_pad: int
+    g_pad: int
+    head_types: Tuple[str, ...]
+    head_dims: Tuple[int, ...]
+    need_triplets: bool = False
+    t_pad: int = 0
+
+
+def _sample_triplets(data: GraphData):
+    if "triplets" not in data.extras:
+        from hydragnn_tpu.models.dimenet import compute_triplets
+
+        data.extras["triplets"] = compute_triplets(data.edge_index, data.num_nodes)
+    return data.extras["triplets"]
+
+
+def _lcm(a, b):
+    import math
+
+    return a * b // math.gcd(a, b)
+
+
+def compute_layout(
+    datasets: List[List[GraphData]],
+    batch_size: int,
+    need_triplets: bool = False,
+    device_multiple: Optional[int] = None,
+) -> BatchLayout:
+    """``device_multiple``: every padded leading axis is made divisible by
+    this (the data-parallel axis size) so sharded batches split evenly."""
+    if device_multiple is None:
+        try:
+            import jax
+
+            device_multiple = jax.device_count()
+        except Exception:
+            device_multiple = 1
+    mult = _lcm(8, max(device_multiple, 1))
+    max_nodes = 1
+    max_edges = 1
+    max_trip = 0
+    first = None
+    for ds in datasets:
+        for d in ds:
+            first = first or d
+            max_nodes = max(max_nodes, d.num_nodes)
+            max_edges = max(max_edges, d.num_edges)
+            if need_triplets:
+                max_trip = max(max_trip, _sample_triplets(d)[0].shape[0])
+    head_types = tuple(first.target_types)
+    head_dims = tuple(
+        t.shape[-1] if t.ndim > 1 else t.shape[0] for t in first.targets
+    )
+    n_pad, e_pad, g_pad = pad_sizes_for(
+        max_nodes,
+        max_edges,
+        batch_size,
+        node_multiple=mult,
+        edge_multiple=mult,
+        graph_multiple=max(device_multiple, 1),
+    )
+    t_pad = 0
+    if need_triplets:
+        t_pad = int(-(-(batch_size * max(max_trip, 1)) // mult) * mult)
+    return BatchLayout(
+        n_pad=n_pad,
+        e_pad=e_pad,
+        g_pad=g_pad,
+        head_types=head_types,
+        head_dims=head_dims,
+        need_triplets=need_triplets,
+        t_pad=t_pad,
+    )
+
+
+def _collate_with_extras(samples, layout: BatchLayout):
+    batch = collate_graphs(
+        samples,
+        layout.n_pad,
+        layout.e_pad,
+        layout.g_pad,
+        head_types=layout.head_types,
+        head_dims=layout.head_dims,
+    )
+    if layout.need_triplets:
+        t_pad = layout.t_pad
+        n_pad = layout.n_pad
+        ti = np.full((t_pad,), n_pad - 1, np.int32)
+        tj = np.full((t_pad,), n_pad - 1, np.int32)
+        tk = np.full((t_pad,), n_pad - 1, np.int32)
+        tkj = np.zeros((t_pad,), np.int32)
+        tji = np.zeros((t_pad,), np.int32)
+        tmask = np.zeros((t_pad,), bool)
+        off_n = off_e = off_t = 0
+        for s in samples:
+            a, b, c, kj, ji = _sample_triplets(s)
+            t = a.shape[0]
+            ti[off_t : off_t + t] = a + off_n
+            tj[off_t : off_t + t] = b + off_n
+            tk[off_t : off_t + t] = c + off_n
+            tkj[off_t : off_t + t] = kj + off_e
+            tji[off_t : off_t + t] = ji + off_e
+            tmask[off_t : off_t + t] = True
+            off_t += t
+            off_n += s.num_nodes
+            off_e += s.num_edges
+        batch = batch.replace(
+            extras={
+                "trip_i": ti,
+                "trip_j": tj,
+                "trip_k": tk,
+                "trip_kj": tkj,
+                "trip_ji": tji,
+                "trip_mask": tmask,
+            }
+        )
+    return batch
+
+
+class GraphLoader:
+    """Iterates padded batches; DistributedSampler-style sharding + epoch
+    shuffling (``load_data.py:237-245``, ``train_validate_test.py:151-153``)."""
+
+    def __init__(
+        self,
+        dataset: List[GraphData],
+        batch_size: int,
+        layout: BatchLayout,
+        shuffle: bool = True,
+        seed: int = 42,
+        num_shards: Optional[int] = None,
+        shard_id: Optional[int] = None,
+    ):
+        from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+        world, rank = get_comm_size_and_rank()
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.layout = layout
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.num_shards = world if num_shards is None else num_shards
+        self.shard_id = rank if shard_id is None else shard_id
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _indices(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(n)
+        else:
+            idx = np.arange(n)
+        if self.num_shards > 1:
+            # pad to a multiple of num_shards by wrapping (DistributedSampler)
+            total = -(-n // self.num_shards) * self.num_shards
+            idx = np.concatenate([idx, idx[: total - n]])
+            idx = idx[self.shard_id :: self.num_shards]
+        return idx
+
+    def __len__(self):
+        n = len(self._indices())
+        return -(-n // self.batch_size)
+
+    def __iter__(self):
+        idx = self._indices()
+        for start in range(0, len(idx), self.batch_size):
+            chunk = [self.dataset[i] for i in idx[start : start + self.batch_size]]
+            yield _collate_with_extras(chunk, self.layout)
+
+
+def create_dataloaders(
+    trainset,
+    valset,
+    testset,
+    batch_size: int,
+    need_triplets: bool = False,
+):
+    layout = compute_layout([trainset, valset, testset], batch_size, need_triplets)
+    return (
+        GraphLoader(trainset, batch_size, layout, shuffle=True),
+        GraphLoader(valset, batch_size, layout, shuffle=True),
+        GraphLoader(testset, batch_size, layout, shuffle=True),
+    )
+
+
+def dataset_loading_and_splitting(config: dict):
+    """Parity with ``preprocess/load_data.py:207-223``: raw -> serialized ->
+    split pkls -> per-split datasets -> loaders."""
+    from hydragnn_tpu.data.serialized import SerializedGraphLoader
+
+    paths = config["Dataset"]["path"]
+    if not list(paths.values())[0].endswith(".pkl"):
+        transform_raw_data_to_serialized(config["Dataset"])
+    if "total" in paths:
+        total_to_train_val_test_pkls(config)
+
+    loader = SerializedGraphLoader(config)
+    datasets = {}
+    for name, p in config["Dataset"]["path"].items():
+        if p.endswith(".pkl"):
+            files_dir = p
+        else:
+            files_dir = (
+                f"{os.environ.get('SERIALIZED_DATA_PATH', os.getcwd())}"
+                f"/serialized_dataset/{config['Dataset']['name']}_{name}.pkl"
+            )
+        datasets[name] = loader.load_serialized_data(files_dir)
+
+    need_triplets = (
+        config["NeuralNetwork"]["Architecture"].get("model_type") == "DimeNet"
+    )
+    return create_dataloaders(
+        datasets["train"],
+        datasets["validate"],
+        datasets["test"],
+        batch_size=config["NeuralNetwork"]["Training"]["batch_size"],
+        need_triplets=need_triplets,
+    )
+
+
+def transform_raw_data_to_serialized(ds_config: dict):
+    """Rank-0 raw parsing + serialization (``load_data.py:349-363``)."""
+    from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+    _, rank = get_comm_size_and_rank()
+    if rank == 0:
+        fmt = ds_config["format"]
+        if fmt in ("LSMS", "unit_test"):
+            from hydragnn_tpu.data.lsms import LSMSDataset
+
+            loader = LSMSDataset(ds_config)
+        elif fmt == "CFG":
+            from hydragnn_tpu.data.cfg import CFGDataset
+
+            loader = CFGDataset(ds_config)
+        elif fmt == "XYZ":
+            from hydragnn_tpu.data.xyz import XYZDataset
+
+            loader = XYZDataset(ds_config)
+        else:
+            raise NameError("Data format not recognized for raw data loader")
+        loader.load_raw_data()
+
+
+def total_to_train_val_test_pkls(config: dict, isdist: bool = False):
+    """Split a monolithic pkl into train/val/test pkls and point the config at
+    them (``load_data.py:366-407``)."""
+    import pickle
+
+    from hydragnn_tpu.data.split import split_dataset
+    from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+    _, rank = get_comm_size_and_rank()
+    paths = config["Dataset"]["path"]
+    if list(paths.values())[0].endswith(".pkl"):
+        file_dir = paths["total"]
+    else:
+        file_dir = (
+            f"{os.environ.get('SERIALIZED_DATA_PATH', os.getcwd())}"
+            f"/serialized_dataset/{config['Dataset']['name']}.pkl"
+        )
+    with open(file_dir, "rb") as f:
+        minmax_node = pickle.load(f)
+        minmax_graph = pickle.load(f)
+        total = pickle.load(f)
+    trainset, valset, testset = split_dataset(
+        total,
+        config["NeuralNetwork"]["Training"]["perc_train"],
+        config["Dataset"]["compositional_stratified_splitting"],
+    )
+    serialized_dir = os.path.dirname(file_dir)
+    config["Dataset"]["path"] = {}
+    for name, ds in zip(
+        ["train", "validate", "test"], [trainset, valset, testset]
+    ):
+        serial_name = f"{config['Dataset']['name']}_{name}.pkl"
+        config["Dataset"]["path"][name] = os.path.join(serialized_dir, serial_name)
+        if isdist or rank == 0:
+            with open(os.path.join(serialized_dir, serial_name), "wb") as f:
+                pickle.dump(minmax_node, f)
+                pickle.dump(minmax_graph, f)
+                pickle.dump(ds, f)

@@ -1,0 +1,57 @@
+"""LSMS text-format raw loader.
+
+Parity with ``hydragnn/preprocess/lsms_raw_dataset_loader.py:20-106``. Format
+(also used by the synthetic "unit_test" fixture,
+``tests/deterministic_graph_data.py:80-105``):
+
+    line 0:  graph-level features (whitespace separated)
+    line i:  feature  node_index  x  y  z  output1  output2  ...
+
+Graph/node feature blocks are selected via the Dataset config's
+``column_index``/``dim`` tables. The LSMS "charge density" correction
+subtracts the proton count (column 0 of the selected node features) from
+column 1 (``lsms_raw_dataset_loader.py:90-106``).
+"""
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.data.raw import AbstractRawDataset
+
+
+class LSMSDataset(AbstractRawDataset):
+    def transform_input_to_data_object_base(self, filepath: str):
+        with open(filepath, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+        graph_feat = lines[0].split()
+        g_feature = []
+        for item in range(len(self.graph_feature_dim)):
+            for icomp in range(self.graph_feature_dim[item]):
+                col = self.graph_feature_col[item] + icomp
+                g_feature.append(float(graph_feat[col]))
+
+        node_features = []
+        positions = []
+        for line in lines[1:]:
+            fields = line.split()
+            if not fields:
+                continue
+            positions.append(
+                [float(fields[2]), float(fields[3]), float(fields[4])]
+            )
+            row = []
+            for item in range(len(self.node_feature_dim)):
+                for icomp in range(self.node_feature_dim[item]):
+                    col = self.node_feature_col[item] + icomp
+                    row.append(float(fields[col]))
+            node_features.append(row)
+
+        data = GraphData(
+            x=np.asarray(node_features, dtype=np.float32),
+            pos=np.asarray(positions, dtype=np.float32),
+            y=np.asarray(g_feature, dtype=np.float32),
+        )
+        # charge density correction: x[:,1] -= x[:,0]
+        if data.x.shape[1] >= 2:
+            data.x[:, 1] = data.x[:, 1] - data.x[:, 0]
+        return data

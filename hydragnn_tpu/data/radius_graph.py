@@ -1,0 +1,105 @@
+"""Host-side neighbor search: radius graph with and without periodic
+boundary conditions.
+
+Replaces torch_cluster's ``radius_graph`` (``preprocess/utils.py:102-131``)
+and ase.neighborlist's PBC path (``RadiusGraphPBC``,
+``preprocess/utils.py:134-174``) with numpy implementations — graph
+construction is dataset preprocessing, it runs once on the host, not on TPU.
+
+Edge convention: (senders=j, receivers=i), every ordered pair within the
+cutoff (radius graphs are symmetric). ``max_neighbors`` caps incoming edges
+per receiver in index order, matching torch-cluster's behavior.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def radius_graph(
+    pos: np.ndarray,
+    radius: float,
+    max_neighbors: int = 32,
+    loop: bool = False,
+) -> np.ndarray:
+    n = pos.shape[0]
+    if n == 0:
+        return np.zeros((2, 0), dtype=np.int64)
+    diff = pos[None, :, :] - pos[:, None, :]  # [i, j]
+    dist = np.sqrt((diff * diff).sum(-1))
+    within = dist <= radius
+    if not loop:
+        np.fill_diagonal(within, False)
+    senders, receivers = [], []
+    for i in range(n):
+        js = np.nonzero(within[i])[0][:max_neighbors]
+        senders.append(js)
+        receivers.append(np.full(js.shape, i, dtype=np.int64))
+    return np.stack(
+        [np.concatenate(senders), np.concatenate(receivers)]
+    ).astype(np.int64)
+
+
+def radius_graph_pbc(
+    pos: np.ndarray,
+    cell: np.ndarray,
+    radius: float,
+    max_neighbors: int = 32,
+    loop: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Periodic radius graph over the 27 minimum-image shifts.
+
+    Returns (edge_index, edge_length). Raises if a pair is connected through
+    more than one image — the same "duplicate edges" guard as the reference
+    (``preprocess/utils.py:162-167``): reduce the cutoff or grow the cell.
+    """
+    cell = np.asarray(cell, dtype=np.float64)
+    if cell.ndim == 1:
+        cell = np.diag(cell)
+    n = pos.shape[0]
+    shifts = np.array(
+        [[i, j, k] for i in (-1, 0, 1) for j in (-1, 0, 1) for k in (-1, 0, 1)]
+    )
+    shift_vecs = shifts @ cell  # [27, 3]
+    senders, receivers, lengths = [], [], []
+    seen = set()
+    for s in shift_vecs:
+        diff = (pos[None, :, :] + s[None, None, :]) - pos[:, None, :]  # [i, j]
+        dist = np.sqrt((diff * diff).sum(-1))
+        within = dist <= radius
+        # self-interaction excluded only for the zero shift; a node's own
+        # periodic image is a legitimate neighbor (ase semantics)
+        if not loop and np.abs(s).sum() <= 1e-12:
+            np.fill_diagonal(within, False)
+        ii, jj = np.nonzero(within)
+        for i, j in zip(ii, jj):
+            key = (int(j), int(i))
+            if key in seen:
+                raise ValueError(
+                    "Adding periodic boundary conditions would result in "
+                    "duplicate edges. Cutoff radius must be reduced or "
+                    "system size increased."
+                )
+            seen.add(key)
+            senders.append(j)
+            receivers.append(i)
+            lengths.append(dist[i, j])
+    if not senders:
+        return np.zeros((2, 0), dtype=np.int64), np.zeros((0,), dtype=np.float32)
+    senders = np.asarray(senders, dtype=np.int64)
+    receivers = np.asarray(receivers, dtype=np.int64)
+    lengths = np.asarray(lengths, dtype=np.float32)
+    # cap incoming neighbors per receiver in insertion order
+    order = np.argsort(receivers, kind="stable")
+    senders, receivers, lengths = senders[order], receivers[order], lengths[order]
+    keep = np.ones(senders.shape[0], dtype=bool)
+    count = {}
+    for idx, r in enumerate(receivers):
+        c = count.get(int(r), 0)
+        if c >= max_neighbors:
+            keep[idx] = False
+        count[int(r)] = c + 1
+    return (
+        np.stack([senders[keep], receivers[keep]]),
+        lengths[keep],
+    )

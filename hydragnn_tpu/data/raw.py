@@ -1,0 +1,204 @@
+"""Raw -> serialized dataset pipeline.
+
+Behavioral parity with ``hydragnn/preprocess/raw_dataset_loader.py:27-279``:
+walk the per-split directories, parse each file into a ``GraphData``, scale
+``*_scaled_num_nodes`` features by node count, compute GLOBAL min-max over all
+splits, normalize every feature block to [0, 1], and pickle
+``(minmax_node_feature, minmax_graph_feature, dataset)`` per split under
+``$SERIALIZED_DATA_PATH/serialized_dataset``.
+"""
+
+import os
+import pickle
+import random
+from typing import Dict, List
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+
+
+def _tensor_divide(num, den):
+    return np.divide(num, den, out=np.zeros_like(num), where=den != 0)
+
+
+class AbstractRawDataset:
+    def __init__(self, config: dict, dist: bool = False, comm=None):
+        self.node_feature_name = config["node_features"]["name"]
+        self.node_feature_dim = config["node_features"]["dim"]
+        self.node_feature_col = config["node_features"]["column_index"]
+        self.graph_feature_name = config["graph_features"]["name"]
+        self.graph_feature_dim = config["graph_features"]["dim"]
+        self.graph_feature_col = config["graph_features"]["column_index"]
+        self.raw_dataset_name = config["name"]
+        self.data_format = config["format"]
+        self.path_dictionary = config["path"]
+
+        assert len(self.node_feature_name) == len(self.node_feature_dim)
+        assert len(self.node_feature_name) == len(self.node_feature_col)
+        assert len(self.graph_feature_name) == len(self.graph_feature_dim)
+        assert len(self.graph_feature_name) == len(self.graph_feature_col)
+
+        self.dist = dist
+        self.comm = comm
+        self.dataset_list: List[List[GraphData]] = []
+        self.serial_data_name_list: List[str] = []
+        self.minmax_node_feature = None
+        self.minmax_graph_feature = None
+
+    # ---- subclass hook: parse one file ---------------------------------
+    def transform_input_to_data_object_base(self, filepath: str):
+        raise NotImplementedError
+
+    def load_raw_data(self):
+        serialized_dir = os.path.join(
+            os.environ.get("SERIALIZED_DATA_PATH", os.getcwd()),
+            "serialized_dataset",
+        )
+        os.makedirs(serialized_dir, exist_ok=True)
+
+        for dataset_type, raw_path in self.path_dictionary.items():
+            if not os.path.isabs(raw_path):
+                raw_path = os.path.join(os.getcwd(), raw_path)
+            if not os.path.exists(raw_path):
+                raise ValueError(f"Folder not found: {raw_path}")
+            filelist = sorted(os.listdir(raw_path))
+            assert len(filelist) > 0, f"No data files provided in {raw_path}!"
+            if self.dist:
+                # shuffle deterministically then shard across hosts
+                random.seed(43)
+                random.shuffle(filelist)
+                from hydragnn_tpu.parallel.distributed import (
+                    get_comm_size_and_rank,
+                    nsplit,
+                )
+
+                world, rank = get_comm_size_and_rank()
+                filelist = list(nsplit(filelist, world))[rank]
+
+            dataset = []
+            for name in filelist:
+                if name == ".DS_Store":
+                    continue
+                full = os.path.join(raw_path, name)
+                if os.path.isfile(full):
+                    obj = self.transform_input_to_data_object_base(full)
+                    if obj is not None:
+                        dataset.append(obj)
+                elif os.path.isdir(full):
+                    for sub in sorted(os.listdir(full)):
+                        subfull = os.path.join(full, sub)
+                        if os.path.isfile(subfull):
+                            obj = self.transform_input_to_data_object_base(subfull)
+                            if obj is not None:
+                                dataset.append(obj)
+
+            dataset = self.scale_features_by_num_nodes(dataset)
+            if dataset_type == "total":
+                serial_name = self.raw_dataset_name + ".pkl"
+            else:
+                serial_name = f"{self.raw_dataset_name}_{dataset_type}.pkl"
+            self.dataset_list.append(dataset)
+            self.serial_data_name_list.append(serial_name)
+
+        self.normalize_dataset()
+
+        for serial_name, dataset in zip(
+            self.serial_data_name_list, self.dataset_list
+        ):
+            with open(os.path.join(serialized_dir, serial_name), "wb") as f:
+                pickle.dump(self.minmax_node_feature, f)
+                pickle.dump(self.minmax_graph_feature, f)
+                pickle.dump(dataset, f)
+
+    def scale_features_by_num_nodes(self, dataset):
+        """Divide ``*_scaled_num_nodes`` feature blocks by node count
+        (``raw_dataset_loader.py:169-192``)."""
+        g_idx = [
+            i
+            for i, name in enumerate(self.graph_feature_name)
+            if "_scaled_num_nodes" in name
+        ]
+        n_idx = [
+            i
+            for i, name in enumerate(self.node_feature_name)
+            if "_scaled_num_nodes" in name
+        ]
+        for data in dataset:
+            if data.y is not None and g_idx:
+                data.y[g_idx] = data.y[g_idx] / data.num_nodes
+            if data.x is not None and n_idx:
+                data.x[:, n_idx] = data.x[:, n_idx] / data.num_nodes
+        return dataset
+
+    def normalize_dataset(self):
+        """Global min-max over every split, then normalize each feature block
+        to [0, 1] (``raw_dataset_loader.py:194-279``)."""
+        num_nf = len(self.node_feature_dim)
+        num_gf = len(self.graph_feature_dim)
+        self.minmax_graph_feature = np.full((2, num_gf), np.inf)
+        self.minmax_node_feature = np.full((2, num_nf), np.inf)
+        self.minmax_graph_feature[1, :] *= -1
+        self.minmax_node_feature[1, :] *= -1
+
+        for dataset in self.dataset_list:
+            for data in dataset:
+                g_start = 0
+                for ifeat in range(num_gf):
+                    g_end = g_start + self.graph_feature_dim[ifeat]
+                    block = data.y[g_start:g_end]
+                    self.minmax_graph_feature[0, ifeat] = min(
+                        block.min(), self.minmax_graph_feature[0, ifeat]
+                    )
+                    self.minmax_graph_feature[1, ifeat] = max(
+                        block.max(), self.minmax_graph_feature[1, ifeat]
+                    )
+                    g_start = g_end
+                n_start = 0
+                for ifeat in range(num_nf):
+                    n_end = n_start + self.node_feature_dim[ifeat]
+                    block = data.x[:, n_start:n_end]
+                    self.minmax_node_feature[0, ifeat] = min(
+                        block.min(), self.minmax_node_feature[0, ifeat]
+                    )
+                    self.minmax_node_feature[1, ifeat] = max(
+                        block.max(), self.minmax_node_feature[1, ifeat]
+                    )
+                    n_start = n_end
+
+        if self.dist:
+            from hydragnn_tpu.parallel.distributed import host_allreduce
+
+            self.minmax_graph_feature[0] = host_allreduce(
+                self.minmax_graph_feature[0], op="min"
+            )
+            self.minmax_graph_feature[1] = host_allreduce(
+                self.minmax_graph_feature[1], op="max"
+            )
+            self.minmax_node_feature[0] = host_allreduce(
+                self.minmax_node_feature[0], op="min"
+            )
+            self.minmax_node_feature[1] = host_allreduce(
+                self.minmax_node_feature[1], op="max"
+            )
+
+        for dataset in self.dataset_list:
+            for data in dataset:
+                g_start = 0
+                for ifeat in range(num_gf):
+                    g_end = g_start + self.graph_feature_dim[ifeat]
+                    lo = self.minmax_graph_feature[0, ifeat]
+                    hi = self.minmax_graph_feature[1, ifeat]
+                    data.y[g_start:g_end] = _tensor_divide(
+                        data.y[g_start:g_end] - lo, hi - lo
+                    )
+                    g_start = g_end
+                n_start = 0
+                for ifeat in range(num_nf):
+                    n_end = n_start + self.node_feature_dim[ifeat]
+                    lo = self.minmax_node_feature[0, ifeat]
+                    hi = self.minmax_node_feature[1, ifeat]
+                    data.x[:, n_start:n_end] = _tensor_divide(
+                        data.x[:, n_start:n_end] - lo, hi - lo
+                    )
+                    n_start = n_end

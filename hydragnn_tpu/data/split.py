@@ -1,0 +1,90 @@
+"""Dataset splitting.
+
+Parity with ``hydragnn/preprocess/compositional_data_splitting.py:109-155``
+(stratified train/val/test preserving element-composition categories) and
+``preprocess/load_data.py:300-318`` (plain proportional split).
+"""
+
+import collections
+import math
+from typing import List
+
+import numpy as np
+from sklearn.model_selection import StratifiedShuffleSplit
+
+from hydragnn_tpu.data.dataobj import GraphData
+
+
+def _dataset_categories(dataset: List[GraphData]):
+    """Encode each graph's element composition as an integer category
+    (``compositional_data_splitting.py:54-71``)."""
+    max_graph_size = max(d.num_nodes for d in dataset)
+    power_ten = math.ceil(math.log10(max(max_graph_size, 2)))
+    elements = sorted(
+        set(float(e) for d in dataset for e in np.unique(d.x[:, 0]))
+    )
+    element_index = {e: i for i, e in enumerate(elements)}
+    categories = []
+    for d in dataset:
+        vals, counts = np.unique(d.x[:, 0], return_counts=True)
+        cat = 0
+        for v, c in zip(vals, counts):
+            cat += int(c) * (10 ** (power_ten * element_index[float(v)]))
+        categories.append(cat)
+    return categories
+
+
+def _duplicate_singletons(dataset, categories):
+    """Duplicate category-unique samples so stratified splitting can place a
+    member on each side (``compositional_data_splitting.py:74-92``)."""
+    counter = collections.Counter(categories)
+    extra, extra_cat = [], []
+    for d, c in zip(dataset, categories):
+        if counter[c] == 1:
+            extra.append(d.clone())
+            extra_cat.append(c)
+    return list(dataset) + extra, list(categories) + extra_cat
+
+
+def _partition(dataset, categories, train_size):
+    sss = StratifiedShuffleSplit(n_splits=1, train_size=train_size, random_state=0)
+    idx_a, idx_b = next(sss.split(dataset, categories))
+    return [dataset[i] for i in idx_a], [dataset[i] for i in idx_b]
+
+
+def compositional_stratified_splitting(dataset, perc_train):
+    categories = _dataset_categories(dataset)
+    dataset, categories = _duplicate_singletons(dataset, categories)
+    trainset, val_test = _partition(dataset, categories, perc_train)
+    vt_categories = _dataset_categories(val_test)
+    val_test, vt_categories = _duplicate_singletons(val_test, vt_categories)
+    valset, testset = _partition(val_test, vt_categories, 0.5)
+    return trainset, valset, testset
+
+
+def split_dataset(dataset, perc_train: float, stratify_splitting: bool):
+    if not stratify_splitting:
+        perc_val = (1 - perc_train) / 2
+        n = len(dataset)
+        a = int(n * perc_train)
+        b = int(n * (perc_train + perc_val))
+        return dataset[:a], dataset[a:b], dataset[b:]
+    return compositional_stratified_splitting(dataset, perc_train)
+
+
+def stratified_subsample(dataset, subsample_percentage: float, verbosity=0):
+    """Stratified subsample (``preprocess/utils.py:295-336``): category is
+    the sorted per-type frequency signature in base 100."""
+    categories = []
+    for d in dataset:
+        freqs = np.bincount(d.x[:, 0].astype(np.int64))
+        freqs = sorted(int(f) for f in freqs if f > 0)
+        cat = 0
+        for i, f in enumerate(freqs):
+            cat += f * (100 ** i)
+        categories.append(cat)
+    sss = StratifiedShuffleSplit(
+        n_splits=1, train_size=subsample_percentage, random_state=0
+    )
+    idx, _ = next(sss.split(dataset, categories))
+    return [dataset[i] for i in idx]

@@ -72,15 +72,18 @@ def pad_sizes_for(
     batch_size: int,
     node_multiple: int = 8,
     edge_multiple: int = 8,
+    graph_multiple: int = 1,
 ) -> Tuple[int, int, int]:
     """Static pad sizes for a batch of up to ``batch_size`` graphs.
 
     Worst-case sizing (every graph maximal) plus one guaranteed padding node
     and one padding graph, rounded up so XLA tiles land on lane boundaries.
+    ``graph_multiple``/``node_multiple`` should be divisible by the
+    data-parallel axis size so sharded batches split evenly across devices.
     """
     n_pad = _round_up(batch_size * max_nodes + 1, node_multiple)
     e_pad = _round_up(max(batch_size * max_edges, 1), edge_multiple)
-    g_pad = batch_size + 1
+    g_pad = _round_up(batch_size + 1, graph_multiple)
     return n_pad, e_pad, g_pad
 
 

@@ -114,7 +114,14 @@ class HydraBase(nn.Module):
         return len(self.output_dim)
 
     # ---- subclass hooks ------------------------------------------------
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+    def get_conv(
+        self,
+        in_dim: int,
+        out_dim: int,
+        last_layer: bool = False,
+        name: Optional[str] = None,
+        **kw,
+    ):
         raise NotImplementedError
 
     def _conv_layer_specs(self):
@@ -167,11 +174,13 @@ class HydraBase(nn.Module):
         # SchNet/EGNN use Identity feature layers instead of BatchNorm
         # (SCFStack.py:63, EGCLStack.py:41)
         use_bn = getattr(self, "conv_use_batchnorm", True)
-        for in_dim, out_dim, bn_dim, kw in self._conv_layer_specs():
-            conv = self.get_conv(in_dim, out_dim, **kw)
+        for i, (in_dim, out_dim, bn_dim, kw) in enumerate(self._conv_layer_specs()):
+            conv = self.get_conv(in_dim, out_dim, name=f"encoder_conv_{i}", **kw)
             c, pos = self._apply_conv(conv, x, pos, batch, train)
             if use_bn:
-                c = MaskedBatchNorm(bn_dim)(c, batch.node_mask, not train)
+                c = MaskedBatchNorm(bn_dim, name=f"encoder_bn_{i}")(
+                    c, batch.node_mask, not train
+                )
             x = act(c)
 
         # ---- decoder: multihead (Base.py:205-283,304-327) ---------------
@@ -228,12 +237,16 @@ class HydraBase(nn.Module):
                     # (Base.py:318-323).
                     h = x
                     p = pos
-                    for in_dim, od, bn_dim, kw in self._node_conv_specs(
-                        node_cfg, head_dim
+                    for il, (in_dim, od, bn_dim, kw) in enumerate(
+                        self._node_conv_specs(node_cfg, head_dim)
                     ):
-                        conv = self.get_conv(in_dim, od, **kw)
+                        conv = self.get_conv(
+                            in_dim, od, name=f"head_{ihead}_conv_{il}", **kw
+                        )
                         c, p = self._apply_conv(conv, h, p, batch, train)
-                        c = MaskedBatchNorm(bn_dim)(c, batch.node_mask, not train)
+                        c = MaskedBatchNorm(bn_dim, name=f"head_{ihead}_bn_{il}")(
+                            c, batch.node_mask, not train
+                        )
                         h = act(c)
                     outputs.append(h)
                 else:

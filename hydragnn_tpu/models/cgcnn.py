@@ -36,8 +36,9 @@ class CGConv(nn.Module):
 
 
 class CGCNNStack(HydraBase):
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
         # CGConv keeps dimensions: in_dim is both in and out.
         return self._conv_cls(CGConv)(
-            channels=in_dim, edge_dim=self.edge_dim if self.edge_dim else 0
+            channels=in_dim, edge_dim=self.edge_dim if self.edge_dim else 0,
+            name=name,
         )

@@ -246,7 +246,7 @@ class DIMEStack(HydraBase):
     radius: float = 2.0
     conv_use_batchnorm: bool = False  # Identity feature layers (DIMEStack.py:73)
 
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
         # hidden = out if in==1 else in (DIMEStack.py:80)
         hidden_dim = out_dim if in_dim == 1 else in_dim
         assert hidden_dim > 1, (
@@ -254,6 +254,7 @@ class DIMEStack(HydraBase):
             "input_dim and output_dim."
         )
         return self._conv_cls(DimeNetConv)(
+            name=name,
             in_dim=in_dim,
             out_dim=out_dim,
             hidden_dim=hidden_dim,

@@ -66,8 +66,9 @@ class E_GCL(nn.Module):
 class EGCLStack(HydraBase):
     conv_use_batchnorm: bool = False  # Identity feature layers (EGCLStack.py:41)
 
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
         return self._conv_cls(E_GCL)(
+            name=name,
             in_dim=in_dim,
             out_dim=out_dim,
             hidden_dim=self.hidden_dim,

@@ -137,9 +137,11 @@ class GATStack(HydraBase):
         out_dim: int,
         last_layer: bool = False,
         concat: bool = True,
+        name=None,
         **kw,
     ):
         return self._conv_cls(GATv2Conv)(
+            name=name,
             in_dim=in_dim,
             out_dim=out_dim,
             heads=self.heads,

@@ -32,5 +32,5 @@ class GINConv(nn.Module):
 
 
 class GINStack(HydraBase):
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
-        return self._conv_cls(GINConv)(in_dim=in_dim, out_dim=out_dim)
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
+        return self._conv_cls(GINConv)(in_dim=in_dim, out_dim=out_dim, name=name)

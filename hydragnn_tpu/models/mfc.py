@@ -54,7 +54,7 @@ class MFConv(nn.Module):
 class MFCStack(HydraBase):
     max_degree: int = 10
 
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
         return self._conv_cls(MFConv)(
-            in_dim=in_dim, out_dim=out_dim, max_degree=self.max_degree
+            in_dim=in_dim, out_dim=out_dim, max_degree=self.max_degree, name=name
         )

@@ -100,10 +100,11 @@ class PNAStack(HydraBase):
 
     deg: Tuple[int, ...] = ()
 
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False):
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
         avg_log, avg_lin = pna_degree_averages(self.deg)
         cls = self._conv_cls(PNAConv)
         return cls(
+            name=name,
             in_dim=in_dim,
             out_dim=out_dim,
             avg_deg_log=avg_log,

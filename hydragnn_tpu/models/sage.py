@@ -36,5 +36,5 @@ class SAGEConv(nn.Module):
 
 
 class SAGEStack(HydraBase):
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
-        return self._conv_cls(SAGEConv)(in_dim=in_dim, out_dim=out_dim)
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
+        return self._conv_cls(SAGEConv)(in_dim=in_dim, out_dim=out_dim, name=name)

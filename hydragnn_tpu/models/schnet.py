@@ -110,8 +110,9 @@ class SCFStack(HydraBase):
     radius: float = 2.0
     conv_use_batchnorm: bool = False  # Identity feature layers (SCFStack.py:63)
 
-    def get_conv(self, in_dim: int, out_dim: int, last_layer: bool = False, **kw):
+    def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
         return self._conv_cls(CFConv)(
+            name=name,
             in_dim=in_dim,
             out_dim=out_dim,
             num_filters=self.num_filters,

@@ -1,0 +1,10 @@
+from hydragnn_tpu.parallel.distributed import (
+    check_remaining,
+    get_comm_size_and_rank,
+    host_allreduce,
+    nsplit,
+    parse_slurm_nodelist,
+    print_peak_memory,
+    setup_distributed,
+)
+from hydragnn_tpu.parallel.mesh import default_mesh, make_mesh, shard_optimizer_state

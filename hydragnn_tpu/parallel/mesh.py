@@ -1,0 +1,55 @@
+"""Device mesh construction & sharding policy.
+
+The scaling design (SURVEY.md §2.3/§5): data parallelism is a 1-D ``data``
+axis over all devices — batch leading axes sharded, parameters replicated,
+gradient all-reduce inserted by XLA over ICI (intra-slice) / DCN (across
+slices). Optimizer-state sharding (ZeRO parity) shards the optimizer moments
+over the same axis.
+
+On a multi-host TPU pod, ``jax.devices()`` spans every host; each host feeds
+its local shard of the batch (the loaders shard sample indices per process,
+DistributedSampler-style) and ``make_array_from_process_local_data`` builds
+the global sharded batch.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+
+def default_mesh(min_devices: int = 2):
+    """1-D data-parallel mesh over all devices; None on a single device (jit
+    without a mesh is already optimal there)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if len(devices) < min_devices:
+        return None
+    return Mesh(np.asarray(devices), ("data",))
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def shard_optimizer_state(opt_state, mesh):
+    """ZeRO-1 parity: shard optimizer-state leaves over the data axis where
+    divisible, replicate the rest (``utils/optimizer.py:48-139`` analog)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis_size = mesh.shape["data"]
+
+    def place(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] % axis_size == 0:
+            return jax.device_put(leaf, NamedSharding(mesh, P("data")))
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(place, opt_state)

@@ -1,0 +1,4 @@
+from hydragnn_tpu.postprocess.postprocess import (
+    output_denormalize,
+    unscale_features_by_num_nodes,
+)

@@ -1,0 +1,27 @@
+"""Prediction post-processing (parity with
+``hydragnn/postprocess/postprocess.py:13-54``)."""
+
+from typing import List
+
+import numpy as np
+
+
+def output_denormalize(y_minmax: List, true_values, predicted_values):
+    """Invert the min-max normalization per head
+    (``postprocess.py:13-26``)."""
+    for ihead in range(len(y_minmax)):
+        ymin, ymax = y_minmax[ihead][0], y_minmax[ihead][1]
+        for arrs in (predicted_values, true_values):
+            arrs[ihead] = np.asarray(arrs[ihead]) * (ymax - ymin) + ymin
+    return true_values, predicted_values
+
+
+def unscale_features_by_num_nodes(
+    feature_names, values, num_nodes_list, scaled_suffix="_scaled_num_nodes"
+):
+    """Undo per-node feature scaling (``postprocess.py:29-54``)."""
+    out = list(values)
+    for i, name in enumerate(feature_names):
+        if scaled_suffix in name:
+            out[i] = np.asarray(out[i]) * np.asarray(num_nodes_list).reshape(-1, 1)
+    return out

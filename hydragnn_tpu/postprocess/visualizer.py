@@ -1,0 +1,122 @@
+"""Visualizer — matplotlib diagnostics (parity with
+``hydragnn/postprocess/visualizer.py:24-742``: parity/scatter plots, error
+histograms, loss history, node-count histogram), writing under
+``./logs/<name>/``."""
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+
+class Visualizer:
+    def __init__(
+        self,
+        model_with_config_name: str,
+        node_feature=None,
+        num_heads: int = 1,
+        head_dims: Optional[List[int]] = None,
+        num_nodes_list=None,
+        plot_init_solution: bool = True,
+        plot_hist_solution: bool = False,
+        create_plots: bool = True,
+    ):
+        self.name = model_with_config_name
+        self.out_dir = os.path.join("./logs", model_with_config_name)
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.node_feature = node_feature
+        self.num_heads = num_heads
+        self.head_dims = head_dims or [1] * num_heads
+        self.num_nodes_list = num_nodes_list or []
+        self.plot_init_solution = plot_init_solution
+        self.plot_hist_solution = plot_hist_solution
+        self.create_plots = create_plots
+
+    def _save(self, fig, fname):
+        fig.savefig(os.path.join(self.out_dir, fname), dpi=120)
+        plt.close(fig)
+
+    def num_nodes_plot(self):
+        if not self.num_nodes_list:
+            return
+        fig, ax = plt.subplots(figsize=(5, 4))
+        ax.hist(self.num_nodes_list, bins=20)
+        ax.set_xlabel("number of nodes")
+        ax.set_ylabel("count")
+        self._save(fig, "num_nodes.png")
+
+    def create_scatter_plots(
+        self, true_values, predicted_values, output_names=None, iepoch=None
+    ):
+        """Per-head parity scatter (``visualizer.py`` scatter catalog)."""
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
+        for ihead in range(len(true_values)):
+            t = np.asarray(true_values[ihead]).reshape(-1)
+            p = np.asarray(predicted_values[ihead]).reshape(-1)
+            fig, ax = plt.subplots(figsize=(5, 5))
+            ax.scatter(t, p, s=4, alpha=0.5)
+            lo = min(t.min(), p.min()) if t.size else 0.0
+            hi = max(t.max(), p.max()) if t.size else 1.0
+            ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+            name = (
+                output_names[ihead]
+                if output_names and ihead < len(output_names)
+                else f"head{ihead}"
+            )
+            ax.set_xlabel(f"true {name}")
+            ax.set_ylabel(f"predicted {name}")
+            self._save(fig, f"scatter_{name}{suffix}.png")
+
+    def create_error_histograms(
+        self, true_values, predicted_values, output_names=None
+    ):
+        for ihead in range(len(true_values)):
+            t = np.asarray(true_values[ihead]).reshape(-1)
+            p = np.asarray(predicted_values[ihead]).reshape(-1)
+            fig, ax = plt.subplots(figsize=(5, 4))
+            ax.hist(p - t, bins=40)
+            name = (
+                output_names[ihead]
+                if output_names and ihead < len(output_names)
+                else f"head{ihead}"
+            )
+            ax.set_xlabel(f"error {name}")
+            self._save(fig, f"error_hist_{name}.png")
+
+    def create_plot_global(
+        self, true_values, predicted_values, output_names=None
+    ):
+        """Combined parity panel across all heads."""
+        n = len(true_values)
+        fig, axes = plt.subplots(1, n, figsize=(5 * n, 5), squeeze=False)
+        for ihead in range(n):
+            ax = axes[0][ihead]
+            t = np.asarray(true_values[ihead]).reshape(-1)
+            p = np.asarray(predicted_values[ihead]).reshape(-1)
+            ax.scatter(t, p, s=4, alpha=0.5)
+            if t.size:
+                lo, hi = min(t.min(), p.min()), max(t.max(), p.max())
+                ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+            name = (
+                output_names[ihead]
+                if output_names and ihead < len(output_names)
+                else f"head{ihead}"
+            )
+            ax.set_title(name)
+        self._save(fig, "parity_all_heads.png")
+
+    def plot_history(self, total_loss_train, total_loss_val, total_loss_test):
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.plot(total_loss_train, label="train")
+        ax.plot(total_loss_val, label="val")
+        ax.plot(total_loss_test, label="test")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss")
+        ax.set_yscale("log")
+        ax.legend()
+        self._save(fig, "history_loss.png")

@@ -1,0 +1,17 @@
+from hydragnn_tpu.train.trainer import TrainState, Trainer, train_validate_test
+from hydragnn_tpu.train.optimizer import (
+    select_optimizer,
+    get_learning_rate,
+    set_learning_rate,
+)
+from hydragnn_tpu.train.scheduler import (
+    BestCheckpoint,
+    EarlyStopping,
+    ReduceLROnPlateau,
+)
+from hydragnn_tpu.train.checkpoint import (
+    checkpoint_exists,
+    load_state_dict,
+    restore_into,
+    save_model,
+)

@@ -1,0 +1,67 @@
+"""Checkpoint save/load.
+
+Parity with ``hydragnn/utils/model.py:60-119``: one logical checkpoint file
+``./logs/<name>/<name>.pk`` written by process 0, holding model params, batch
+stats AND optimizer state (the reference saves
+``{model_state_dict, optimizer_state_dict}``). Under sharded training the
+leaves are gathered to host before writing — the single-file contract is kept
+even with ZeRO-style sharded optimizer state (reference consolidates via
+``consolidate_state_dict``; here ``jax.device_get`` does the same job).
+
+Format: flax msgpack (framework-neutral, no pickle of code objects).
+"""
+
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _state_dict(state) -> Dict[str, Any]:
+    return {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+        "step": jax.device_get(state.step),
+    }
+
+
+def save_model(state_or_dict, name: str, path: str = "./logs/"):
+    from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+    _, rank = get_comm_size_and_rank()
+    if rank != 0:
+        return
+    sd = (
+        state_or_dict
+        if isinstance(state_or_dict, dict)
+        else _state_dict(state_or_dict)
+    )
+    out_dir = os.path.join(path, name)
+    os.makedirs(out_dir, exist_ok=True)
+    # to_state_dict flattens custom containers (optax states) to plain dicts
+    sd = serialization.to_state_dict(sd)
+    blob = serialization.msgpack_serialize(
+        jax.tree_util.tree_map(np.asarray, sd)
+    )
+    with open(os.path.join(out_dir, name + ".pk"), "wb") as f:
+        f.write(blob)
+
+
+def load_state_dict(name: str, path: str = "./logs/") -> Dict[str, Any]:
+    fname = os.path.join(path, name, name + ".pk")
+    with open(fname, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def restore_into(template, restored):
+    """Re-impose the template pytree structure (opt_state NamedTuples etc.)
+    onto the raw msgpack dict — the analog of the reference's DDP "module."
+    prefix fixup on old checkpoints (``model.py:109-114``)."""
+    return serialization.from_state_dict(template, restored)
+
+
+def checkpoint_exists(name: str, path: str = "./logs/") -> bool:
+    return os.path.exists(os.path.join(path, name, name + ".pk"))

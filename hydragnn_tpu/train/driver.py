@@ -1,0 +1,145 @@
+"""End-to-end orchestration behind ``run_training`` / ``run_prediction``.
+
+Parity with ``hydragnn/run_training.py:49-182`` and
+``hydragnn/run_prediction.py:48-107``: distributed setup -> data loading &
+splitting -> config derivation -> model + optimizer -> epoch driver ->
+checkpoint, and the prediction path that reloads the trained model and
+returns (error, per-task error, true values, predictions) with optional
+denormalization.
+"""
+
+import os
+
+import numpy as np
+
+from hydragnn_tpu.data.loaders import dataset_loading_and_splitting
+from hydragnn_tpu.models.create import create_model_config
+from hydragnn_tpu.parallel.distributed import setup_distributed
+from hydragnn_tpu.parallel.mesh import default_mesh
+from hydragnn_tpu.train.checkpoint import (
+    checkpoint_exists,
+    load_state_dict,
+    restore_into,
+    save_model,
+)
+from hydragnn_tpu.train.trainer import Trainer, train_validate_test
+from hydragnn_tpu.utils import tracer as tr
+from hydragnn_tpu.utils.config import (
+    get_log_name_config,
+    save_config,
+    update_config,
+)
+from hydragnn_tpu.utils.print_utils import setup_log
+from hydragnn_tpu.utils.timers import Timer, print_timers
+
+
+def _arch_for_factory(config) -> dict:
+    arch = dict(config["NeuralNetwork"]["Architecture"])
+    training = config["NeuralNetwork"]["Training"]
+    arch["loss_function_type"] = training.get("loss_function_type", "mse")
+    arch["conv_checkpointing"] = training.get("conv_checkpointing", False)
+    return arch
+
+
+def _get_summary_writer(log_name):
+    from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+    _, rank = get_comm_size_and_rank()
+    if rank != 0:
+        return None
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter("./logs/" + log_name)
+    except Exception:
+        return None
+
+
+def _build_model_and_trainer(config, train_loader, verbosity):
+    arch = _arch_for_factory(config)
+    model = create_model_config(arch, verbosity)
+    mesh = default_mesh()
+    trainer = Trainer(
+        model,
+        config["NeuralNetwork"]["Training"],
+        mesh=mesh,
+        verbosity=verbosity,
+        freeze_conv=arch.get("freeze_conv_layers", False),
+    )
+    example_batch = next(iter(train_loader))
+    state = trainer.init_state(example_batch, seed=0)
+    return model, trainer, state
+
+
+def run_training_impl(config):
+    timer = Timer("run_training")
+    timer.start()
+    setup_distributed()
+    tr.initialize()
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+
+    train_loader, val_loader, test_loader = dataset_loading_and_splitting(config)
+    config = update_config(config, train_loader, val_loader, test_loader)
+    log_name = get_log_name_config(config)
+    setup_log(log_name)
+    save_config(config, log_name)
+
+    model, trainer, state = _build_model_and_trainer(
+        config, train_loader, verbosity
+    )
+
+    training = config["NeuralNetwork"]["Training"]
+    if "continue" in training and training["continue"]:
+        model_name = training.get("startfrom", log_name)
+        if checkpoint_exists(model_name):
+            state = restore_into(state, load_state_dict(model_name))
+
+    writer = _get_summary_writer(log_name)
+    vis_cfg = config.get("Visualization", {})
+    state = train_validate_test(
+        trainer,
+        state,
+        train_loader,
+        val_loader,
+        test_loader,
+        config["NeuralNetwork"],
+        log_name,
+        verbosity,
+        writer=writer,
+        create_plots=vis_cfg.get("create_plots", False),
+        plot_init_solution=vis_cfg.get("plot_init_solution", False),
+    )
+    save_model(state, log_name)
+    timer.stop()
+    print_timers(verbosity)
+    tr.save(f"./logs/{log_name}/trace")
+    return state
+
+
+def run_prediction_impl(config):
+    setup_distributed()
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+
+    train_loader, val_loader, test_loader = dataset_loading_and_splitting(config)
+    config = update_config(config, train_loader, val_loader, test_loader)
+    log_name = get_log_name_config(config)
+
+    model, trainer, state = _build_model_and_trainer(
+        config, train_loader, verbosity
+    )
+    assert checkpoint_exists(log_name), f"No trained model found: {log_name}"
+    state = restore_into(state, load_state_dict(log_name))
+
+    error, tasks_error, true_values, predicted_values = trainer.predict(
+        state, test_loader
+    )
+
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    if voi.get("denormalize_output") and "y_minmax" in voi:
+        from hydragnn_tpu.postprocess.postprocess import output_denormalize
+
+        true_values, predicted_values = output_denormalize(
+            voi["y_minmax"], true_values, predicted_values
+        )
+
+    return error, list(np.atleast_1d(tasks_error)), true_values, predicted_values

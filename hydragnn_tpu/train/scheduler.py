@@ -1,0 +1,88 @@
+"""LR scheduling + training guards.
+
+``ReduceLROnPlateau`` matches torch's semantics as used by the reference
+(``run_training.py:99-105``: mode=min, factor=0.5, patience=5, min_lr=1e-5).
+``EarlyStopping`` and best-val ``Checkpoint``-gating mirror
+``hydragnn/utils/model.py:189-248``.
+"""
+
+
+class ReduceLROnPlateau:
+    def __init__(
+        self,
+        lr: float,
+        mode: str = "min",
+        factor: float = 0.5,
+        patience: int = 5,
+        threshold: float = 1e-4,
+        min_lr: float = 0.00001,
+    ):
+        self.lr = lr
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.min_lr = min_lr
+        self.best = None
+        self.num_bad_epochs = 0
+
+    def _is_better(self, metric):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return metric < self.best * (1.0 - self.threshold)
+        return metric > self.best * (1.0 + self.threshold)
+
+    def step(self, metric) -> float:
+        """Feed the epoch's validation loss; returns the (possibly reduced)
+        learning rate."""
+        if self._is_better(metric):
+            self.best = metric
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            self.lr = max(self.lr * self.factor, self.min_lr)
+            self.num_bad_epochs = 0
+        return self.lr
+
+
+class EarlyStopping:
+    """Stop when validation loss hasn't improved for ``patience`` epochs
+    (``utils/model.py:189-204``)."""
+
+    def __init__(self, patience: int = 5, min_delta: float = 0.0):
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.counter = 0
+        self.early_stop = False
+
+    def __call__(self, val_loss: float) -> bool:
+        if self.best is None or val_loss < self.best - self.min_delta:
+            self.best = val_loss
+            self.counter = 0
+        else:
+            self.counter += 1
+            if self.counter >= self.patience:
+                self.early_stop = True
+        return self.early_stop
+
+
+class BestCheckpoint:
+    """Save-on-best-validation with warmup epochs (``utils/model.py:207-248``)."""
+
+    def __init__(self, name: str, warmup: int = 10, path: str = "./logs/"):
+        self.name = name
+        self.warmup = warmup
+        self.path = path
+        self.best = None
+
+    def __call__(self, state_dict, epoch: int, val_loss: float, save_fn) -> bool:
+        if epoch < self.warmup:
+            return False
+        if self.best is None or val_loss < self.best:
+            self.best = val_loss
+            save_fn(state_dict, self.name, self.path)
+            return True
+        return False

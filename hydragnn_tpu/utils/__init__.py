@@ -1,0 +1,16 @@
+from hydragnn_tpu.utils.config import (
+    get_log_name_config,
+    merge_config,
+    save_config,
+    update_config,
+)
+from hydragnn_tpu.utils.print_utils import (
+    iterate_tqdm,
+    log,
+    log0,
+    print_distributed,
+    print_master,
+    setup_log,
+)
+from hydragnn_tpu.utils.timers import Timer, print_timers, reset_timers
+from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank, nsplit

@@ -1,0 +1,78 @@
+"""Verbosity-leveled, rank-aware logging.
+
+Parity with ``hydragnn/utils/print_utils.py:19-111``: verbosity levels 0-4,
+rank-0-only and per-rank variants, optional file logging under
+``./logs/<name>/``.
+"""
+
+import logging
+import os
+import sys
+
+VERBOSITY_LEVELS = (0, 1, 2, 3, 4)
+_logger = None
+
+
+def _rank():
+    try:
+        from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+        return get_comm_size_and_rank()[1]
+    except Exception:
+        return 0
+
+
+def print_distributed(verbosity_level: int, *args):
+    """Print on rank 0 when verbosity >= 2 (matches reference gating)."""
+    if verbosity_level >= 2 and _rank() == 0:
+        print(*args)
+
+
+def print_master(*args, verbosity_level: int = 2):
+    if _rank() == 0:
+        print(*args)
+
+
+def setup_log(log_name: str, path: str = "./logs/"):
+    """Rank-tagged python logging to ./logs/<name>/run.log + console
+    (``print_utils.py:63-96``)."""
+    global _logger
+    rank = _rank()
+    log_dir = os.path.join(path, log_name)
+    os.makedirs(log_dir, exist_ok=True)
+    logger = logging.getLogger("hydragnn_tpu")
+    logger.setLevel(logging.INFO)
+    logger.handlers.clear()
+    fmt = logging.Formatter(f"[rank {rank}] %(message)s")
+    fh = logging.FileHandler(os.path.join(log_dir, "run.log"))
+    fh.setFormatter(fmt)
+    logger.addHandler(fh)
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    _logger = logger
+    return logger
+
+
+def log(*args):
+    msg = " ".join(str(a) for a in args)
+    if _logger is not None:
+        _logger.info(msg)
+
+
+def log0(*args):
+    if _rank() == 0:
+        log(*args)
+
+
+def iterate_tqdm(iterable, verbosity_level: int = 0, desc: str = ""):
+    """tqdm wrapper gated on verbosity (``print_utils.py:55-59``); plain
+    iteration if tqdm is unavailable."""
+    if verbosity_level >= 2:
+        try:
+            from tqdm import tqdm
+
+            return tqdm(iterable, desc=desc)
+        except ImportError:
+            pass
+    return iterable

@@ -1,0 +1,57 @@
+"""Wall-clock timers with cross-host reduction.
+
+Parity with ``hydragnn/utils/time_utils.py:22-138``: class-level aggregation
+of named timers, min/max/avg across hosts printed at exit.
+"""
+
+import time
+from typing import Dict
+
+import numpy as np
+
+_timers: Dict[str, "Timer"] = {}
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed = _timers[name].elapsed if name in _timers else 0.0
+        self._start = None
+        _timers[name] = self
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is not None:
+            self.elapsed += time.perf_counter() - self._start
+            self._start = None
+
+
+def reset_timers():
+    _timers.clear()
+
+
+def print_timers(verbosity: int = 0):
+    """Print min/max/avg over hosts for each named timer
+    (``time_utils.py:97-138``)."""
+    from hydragnn_tpu.parallel.distributed import (
+        get_comm_size_and_rank,
+        host_allreduce,
+    )
+
+    world, rank = get_comm_size_and_rank()
+    if not _timers:
+        return
+    names = sorted(_timers)
+    values = np.asarray([_timers[n].elapsed for n in names])
+    tmin = host_allreduce(values, op="min")
+    tmax = host_allreduce(values, op="max")
+    tsum = host_allreduce(values, op="sum")
+    if rank == 0:
+        print(f"{'timer':<28}{'min_s':>12}{'max_s':>12}{'avg_s':>12}")
+        for i, n in enumerate(names):
+            print(
+                f"{n:<28}{tmin[i]:>12.4f}{tmax[i]:>12.4f}"
+                f"{tsum[i] / world:>12.4f}"
+            )

@@ -1,0 +1,162 @@
+"""Tracer facade — HPC-style nested region timers.
+
+Parity with ``hydragnn/utils/tracer.py:18-171`` (GPTL/Score-P facade with a
+registry, enable/disable, optional device sync for honest attribution, and a
+``@profile`` decorator). Backends:
+
+  * ``timer``  — pure-Python region timers with per-host summaries (GPTL
+    analog; a C++ backend drops in behind the same interface, see
+    ``native/``).
+  * ``jax``    — forwards regions to ``jax.profiler.TraceAnnotation`` so they
+    appear in TensorBoard/perfetto traces (Score-P analog).
+
+``HYDRAGNN_TRACE_LEVEL=1`` inserts a device sync (``block_until_ready``
+analog of the reference's cudasync+barrier, ``tracer.py:110-131``) at region
+boundaries.
+"""
+
+import os
+import time
+from collections import defaultdict
+from functools import wraps
+from typing import Dict
+
+_tracers: Dict[str, object] = {}
+_enabled = True
+
+
+class TimerTracer:
+    def __init__(self):
+        self.acc = defaultdict(float)
+        self.count = defaultdict(int)
+        self._start = {}
+
+    def start(self, name):
+        self._start[name] = time.perf_counter()
+
+    def stop(self, name):
+        if name in self._start:
+            self.acc[name] += time.perf_counter() - self._start.pop(name)
+            self.count[name] += 1
+
+    def reset(self):
+        self.acc.clear()
+        self.count.clear()
+        self._start.clear()
+
+    def pr_file(self, filename):
+        os.makedirs(os.path.dirname(filename) or ".", exist_ok=True)
+        with open(filename, "w") as f:
+            f.write(f"{'region':<30}{'calls':>10}{'total_s':>14}{'avg_ms':>12}\n")
+            for name in sorted(self.acc):
+                c = self.count[name]
+                t = self.acc[name]
+                f.write(
+                    f"{name:<30}{c:>10}{t:>14.4f}{(t / max(c, 1)) * 1e3:>12.3f}\n"
+                )
+
+
+class JaxProfilerTracer:
+    """Regions as jax.profiler trace annotations."""
+
+    def __init__(self):
+        self._spans = {}
+
+    def start(self, name):
+        import jax.profiler
+
+        span = jax.profiler.TraceAnnotation(name)
+        span.__enter__()
+        self._spans.setdefault(name, []).append(span)
+
+    def stop(self, name):
+        spans = self._spans.get(name)
+        if spans:
+            spans.pop().__exit__(None, None, None)
+
+    def reset(self):
+        self._spans.clear()
+
+    def pr_file(self, filename):
+        pass
+
+
+def initialize(trace_backends=("timer",), verbosity: int = 0):
+    for b in trace_backends:
+        if b == "timer":
+            _tracers["timer"] = TimerTracer()
+        elif b == "jax":
+            _tracers["jax"] = JaxProfilerTracer()
+    return list(_tracers)
+
+
+def has(name):
+    return name in _tracers
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def reset():
+    for t in _tracers.values():
+        t.reset()
+
+
+def _sync():
+    if os.getenv("HYDRAGNN_TRACE_LEVEL", "0") == "1":
+        try:
+            import jax
+
+            jax.effects_barrier()
+        except Exception:
+            pass
+
+
+def start(name):
+    if not _enabled or not _tracers:
+        return
+    _sync()
+    for t in _tracers.values():
+        t.start(name)
+
+
+def stop(name):
+    if not _enabled or not _tracers:
+        return
+    _sync()
+    for t in _tracers.values():
+        t.stop(name)
+
+
+def profile(name):
+    """Decorator marking a traced region (``tracer.py:149-164``)."""
+
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            start(name)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                stop(name)
+
+        return wrapper
+
+    return deco
+
+
+def save(prefix: str = "./logs/trace"):
+    """Per-host region dump (GPTL ``gp.pr_file`` analog)."""
+    from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+    _, rank = get_comm_size_and_rank()
+    t = _tracers.get("timer")
+    if t is not None:
+        t.pr_file(f"{prefix}.{rank}")

@@ -1,0 +1,97 @@
+"""Deterministic synthetic dataset with closed-form targets.
+
+Same data contract as the reference fixture
+(``tests/deterministic_graph_data.py:19-173``): BCC supercells written as
+LSMS-style text files where node feature = type id, node outputs are the
+KNN-smoothed feature x and x^2 + type, x^3, and the graph output is the sum of
+all node outputs. File format:
+
+    GRAPH_OUTPUT [GRAPH_OUTPUT_LINEAR]
+    feature  index  x  y  z  out1  out2  out3
+"""
+
+import os
+
+import numpy as np
+from sklearn.neighbors import KNeighborsRegressor
+
+
+def deterministic_graph_data(
+    path: str,
+    number_configurations: int = 500,
+    configuration_start: int = 0,
+    unit_cell_x_range=(1, 3),
+    unit_cell_y_range=(1, 3),
+    unit_cell_z_range=(1, 2),
+    number_types: int = 3,
+    types=None,
+    number_neighbors: int = 2,
+    linear_only: bool = False,
+    seed: int = 97,
+):
+    if types is None:
+        types = range(number_types)
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(seed + configuration_start)
+    ux = rng.integers(unit_cell_x_range[0], unit_cell_x_range[1], number_configurations)
+    uy = rng.integers(unit_cell_y_range[0], unit_cell_y_range[1], number_configurations)
+    uz = rng.integers(unit_cell_z_range[0], unit_cell_z_range[1], number_configurations)
+    for c in range(number_configurations):
+        _write_configuration(
+            path,
+            c + configuration_start,
+            int(ux[c]),
+            int(uy[c]),
+            int(uz[c]),
+            list(types),
+            number_neighbors,
+            linear_only,
+            rng,
+        )
+
+
+def _write_configuration(
+    path, index, uc_x, uc_y, uc_z, types, number_neighbors, linear_only, rng
+):
+    n = 2 * uc_x * uc_y * uc_z
+    positions = np.zeros((n, 3))
+    k = 0
+    for x in range(uc_x):
+        for y in range(uc_y):
+            for z in range(uc_z):
+                positions[k] = (x, y, z)
+                positions[k + 1] = (x + 0.5, y + 0.5, z + 0.5)
+                k += 2
+    node_feature = rng.integers(min(types), max(types) + 1, (n, 1)).astype(
+        np.float64
+    )
+    if linear_only:
+        out_x = node_feature.copy()
+    else:
+        knn = KNeighborsRegressor(number_neighbors)
+        knn.fit(positions, node_feature)
+        out_x = knn.predict(positions).reshape(n, 1)
+    out_x2 = out_x ** 2 + node_feature
+    out_x3 = out_x ** 3
+
+    total = float(out_x.sum() + out_x2.sum() + out_x3.sum())
+    total_linear = float(out_x.sum())
+    lines = []
+    if linear_only:
+        lines.append(f"{total_linear:.6g}")
+    else:
+        lines.append(f"{total:.6g}\t{total_linear:.6g}")
+    for i in range(n):
+        row = [
+            node_feature[i, 0],
+            float(i),
+            positions[i, 0],
+            positions[i, 1],
+            positions[i, 2],
+            out_x[i, 0],
+            out_x2[i, 0],
+            out_x3[i, 0],
+        ]
+        lines.append("\t".join(f"{v:.2f}" for v in row))
+    with open(os.path.join(path, f"output{index}.txt"), "w") as f:
+        f.write("\n".join(lines))
