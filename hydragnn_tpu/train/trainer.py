@@ -122,20 +122,22 @@ class Trainer:
         def train_step(state, batch, rng):
             def loss_fn(params):
                 variables = {"params": params}
-                mutable = []
                 if state.batch_stats:
                     variables["batch_stats"] = state.batch_stats
-                    mutable = ["batch_stats"]
-                out = model.apply(
-                    variables,
-                    batch,
-                    train=True,
-                    mutable=mutable,
-                    rngs={"dropout": rng},
-                )
-                outputs, mut = out if mutable else (out, {})
+                    outputs, mut = model.apply(
+                        variables,
+                        batch,
+                        train=True,
+                        mutable=["batch_stats"],
+                        rngs={"dropout": rng},
+                    )
+                    new_bs = mut["batch_stats"]
+                else:
+                    outputs = model.apply(
+                        variables, batch, train=True, rngs={"dropout": rng}
+                    )
+                    new_bs = state.batch_stats
                 tot, tasks = model.loss(outputs, batch)
-                new_bs = mut.get("batch_stats", state.batch_stats)
                 return tot, (tuple(tasks), new_bs)
 
             (loss, (tasks, new_bs)), grads = jax.value_and_grad(

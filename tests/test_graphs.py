@@ -112,6 +112,52 @@ def unittest_train_model(
         os.chdir(cwd)
 
 
-@pytest.mark.parametrize("model_type", ["PNA"])
-def pytest_train_model_pna(model_type):
+ALL_MODELS = ["SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN", "SchNet", "DimeNet", "EGNN"]
+FULL = int(os.getenv("HYDRAGNN_FULL_TEST", "0")) == 1
+
+# Default CI keeps one run per feature axis + the fast models; set
+# HYDRAGNN_FULL_TEST=1 for the reference's full 33-run matrix
+# (tests/test_graphs.py:193-224).
+_DEFAULT_SINGLEHEAD = ["PNA", "GIN", "SchNet", "EGNN"]
+_DEFAULT_MULTIHEAD = ["PNA"]
+
+
+@pytest.mark.parametrize(
+    "model_type", ALL_MODELS if FULL else _DEFAULT_SINGLEHEAD
+)
+def pytest_train_model(model_type):
     unittest_train_model(model_type, "ci.json", False)
+
+
+@pytest.mark.parametrize(
+    "model_type", ALL_MODELS if FULL else _DEFAULT_MULTIHEAD
+)
+def pytest_train_model_multihead(model_type):
+    unittest_train_model(model_type, "ci_multihead.json", False)
+
+
+@pytest.mark.parametrize(
+    "model_type", ["PNA", "CGCNN", "SchNet", "EGNN"] if FULL else ["PNA"]
+)
+def pytest_train_model_lengths(model_type):
+    unittest_train_model(model_type, "ci.json", True)
+
+
+@pytest.mark.parametrize("model_type", ["EGNN", "SchNet"] if FULL else ["EGNN"])
+def pytest_train_equivariant_model(model_type):
+    unittest_train_model(model_type, "ci_equivariant.json", False)
+
+
+@pytest.mark.parametrize("model_type", ["PNA"])
+def pytest_train_model_vectoroutput(model_type):
+    unittest_train_model(model_type, "ci_vectoroutput.json", True)
+
+
+@pytest.mark.parametrize(
+    "model_type",
+    ["SAGE", "GIN", "GAT", "MFC", "PNA", "SchNet", "DimeNet", "EGNN"]
+    if FULL
+    else ["GIN"],
+)
+def pytest_train_model_conv_head(model_type):
+    unittest_train_model(model_type, "ci_conv_head.json", False)
