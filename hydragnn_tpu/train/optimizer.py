@@ -68,10 +68,21 @@ def select_optimizer(
 
     if freeze_conv:
         assert params is not None, "freeze_conv requires params to build the mask"
-        mask = freeze_mask_fn(params)
+        trainable = freeze_mask_fn(params)
+        import jax
+
+        labels = jax.tree_util.tree_map(
+            lambda t: "trainable" if t else "frozen", trainable
+        )
 
         def factory(learning_rate):
-            return optax.masked(base(learning_rate), mask)
+            return optax.multi_transform(
+                {
+                    "trainable": base(learning_rate),
+                    "frozen": optax.set_to_zero(),
+                },
+                param_labels=labels,
+            )
 
     else:
 

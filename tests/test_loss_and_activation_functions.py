@@ -1,0 +1,43 @@
+"""Interface smoke tests: every loss x activation combination runs a few
+training steps without error (reference
+``tests/test_loss_and_activation_functions.py`` — 'does not assert
+anything' beyond completing)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hydragnn_tpu.models import create_model_config, init_model_params
+from hydragnn_tpu.train.trainer import Trainer
+
+from test_models_forward import arch_config, make_batch
+
+LOSSES = ["mse", "mae", "rmse", "smooth_l1"]
+ACTIVATIONS = [
+    "relu",
+    "selu",
+    "prelu",
+    "elu",
+    "lrelu_01",
+    "lrelu_025",
+    "lrelu_05",
+    "sigmoid",
+]
+
+
+@pytest.mark.parametrize("loss_name", LOSSES)
+@pytest.mark.parametrize("activation", ACTIVATIONS)
+def pytest_loss_activation(loss_name, activation):
+    batch = make_batch()
+    cfg = arch_config("PNA")
+    cfg["activation_function"] = activation
+    cfg["loss_function_type"] = loss_name
+    model = create_model_config(cfg)
+    trainer = Trainer(model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}})
+    state = trainer.init_state(batch)
+    rng = jax.random.PRNGKey(0)
+    for _ in range(2):
+        rng, sub = jax.random.split(rng)
+        state, metrics = trainer._train_step(state, trainer.put_batch(batch), sub)
+    assert np.isfinite(float(metrics["loss"]))

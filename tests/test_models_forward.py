@@ -39,7 +39,9 @@ class FakeData:
 def make_batch(num_graphs=3, max_n=6, with_triplets=False):
     rng = np.random.default_rng(0)
     samples = [FakeData(rng, rng.integers(3, max_n + 1)) for _ in range(num_graphs)]
-    n_pad, e_pad, g_pad = pad_sizes_for(max_n, 2 * max_n, num_graphs)
+    n_pad, e_pad, g_pad = pad_sizes_for(
+        max_n, 2 * max_n, num_graphs, graph_multiple=8
+    )
     batch = collate_graphs(
         samples,
         n_pad,

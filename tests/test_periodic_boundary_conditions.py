@@ -1,0 +1,62 @@
+"""PBC neighbor-count checks against analytic expectations.
+
+Mirrors the reference strategy (``tests/test_periodic_boundary_conditions.py:
+25-123``): build small crystals with known coordination and assert exact edge
+counts with and without periodic images.
+"""
+
+import numpy as np
+
+from hydragnn_tpu.data.radius_graph import radius_graph, radius_graph_pbc
+
+
+def _bcc_supercell(n):
+    """n x n x n BCC supercell with lattice constant 1."""
+    pts = []
+    for x in range(n):
+        for y in range(n):
+            for z in range(n):
+                pts.append([x, y, z])
+                pts.append([x + 0.5, y + 0.5, z + 0.5])
+    return np.asarray(pts, dtype=np.float64), float(n) * np.eye(3)
+
+
+def pytest_bcc_coordination():
+    # BCC first neighbor shell: 8 at distance sqrt(3)/2 ~ 0.866. Use a 2x2x2
+    # supercell so each neighbor is a distinct atom (a 1-cell would connect
+    # the same pair through several images, which — like the reference's
+    # duplicate-edge assert — is rejected).
+    pos, cell = _bcc_supercell(2)
+    edge_index, lengths = radius_graph_pbc(pos, cell, radius=0.9, max_neighbors=100)
+    assert edge_index.shape[1] == 8 * pos.shape[0]
+    assert np.allclose(lengths, np.sqrt(3) / 2, atol=1e-6)
+    # without PBC the corner atom at the origin keeps only its in-cell shell
+    ei = radius_graph(pos, radius=0.9, max_neighbors=100)
+    assert ei.shape[1] < 8 * pos.shape[0]
+
+
+def pytest_bcc_second_shell():
+    # radius 1.05 adds the 6 second-shell neighbors at distance 1.0
+    # (3x3x3 supercell keeps +x / -x neighbors distinct atoms)
+    pos, cell = _bcc_supercell(3)
+    edge_index, lengths = radius_graph_pbc(pos, cell, radius=1.05, max_neighbors=100)
+    per_atom = edge_index.shape[1] / pos.shape[0]
+    assert per_atom == 8 + 6
+
+
+def pytest_dimer_in_vacuum_cell():
+    # a dimer in a large cell: PBC must not add any extra neighbors
+    pos = np.array([[0.0, 0.0, 0.0], [0.74, 0.0, 0.0]])
+    cell = 20.0 * np.eye(3)
+    edge_index, lengths = radius_graph_pbc(pos, cell, radius=1.0, max_neighbors=10)
+    assert edge_index.shape[1] == 2
+    assert np.allclose(lengths, 0.74, atol=1e-6)
+
+
+def pytest_pbc_edge_lengths_cross_boundary():
+    # atom pair split across the boundary: minimum image distance applies
+    pos = np.array([[0.05, 0.5, 0.5], [0.95, 0.5, 0.5]])
+    cell = np.eye(3)
+    edge_index, lengths = radius_graph_pbc(pos, cell, radius=0.2, max_neighbors=10)
+    assert edge_index.shape[1] == 2
+    assert np.allclose(lengths, 0.1, atol=1e-6)

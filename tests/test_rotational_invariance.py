@@ -1,0 +1,41 @@
+"""Rotational-invariance of the NormalizeRotation transform (reference:
+``tests/test_rotational_invariance.py``): rotating the input positions must
+not change the principal-axes-aligned geometry (up to sign conventions), so
+edge lengths and radius graphs are identical."""
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.data.radius_graph import radius_graph
+from hydragnn_tpu.data.transforms import add_edge_lengths, normalize_rotation
+
+
+def _rot(theta_z, theta_y):
+    cz, sz = np.cos(theta_z), np.sin(theta_z)
+    cy, sy = np.cos(theta_y), np.sin(theta_y)
+    rz = np.array([[cz, -sz, 0], [sz, cz, 0], [0, 0, 1]])
+    ry = np.array([[cy, 0, sy], [0, 1, 0], [-sy, 0, cy]])
+    return rz @ ry
+
+
+def pytest_rotated_geometry_matches():
+    rng = np.random.default_rng(7)
+    pos = rng.random((10, 3)).astype(np.float32) * 3
+    d1 = GraphData(x=np.ones((10, 1), np.float32), pos=pos.copy())
+    d2 = GraphData(
+        x=np.ones((10, 1), np.float32),
+        pos=(pos @ _rot(0.7, -0.3).T).astype(np.float32),
+    )
+    normalize_rotation(d1)
+    normalize_rotation(d2)
+
+    for d in (d1, d2):
+        d.edge_index = radius_graph(d.pos, radius=2.0, max_neighbors=100)
+        d.edge_attr = None
+        add_edge_lengths(d)
+
+    assert d1.edge_index.shape == d2.edge_index.shape
+    # compare sorted edge-length multisets (node order preserved, so direct)
+    assert np.allclose(
+        np.sort(d1.edge_attr.ravel()), np.sort(d2.edge_attr.ravel()), atol=1e-4
+    )
