@@ -19,6 +19,8 @@ optimizer state (no recompilation).
 
 from typing import Callable, Optional
 
+import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -47,8 +49,6 @@ def _base_factory(opt_type: str) -> Callable:
 def freeze_mask_fn(params) -> dict:
     """Trainable-mask for ``freeze_conv_layers`` (``models/Base.py:132-136``):
     everything under the encoder conv/bn scope is frozen; heads stay live."""
-    import jax
-
     def mask_one(path, _):
         top = path[0].key if hasattr(path[0], "key") else str(path[0])
         return not str(top).startswith("encoder_")
@@ -69,8 +69,6 @@ def select_optimizer(
     if freeze_conv:
         assert params is not None, "freeze_conv requires params to build the mask"
         trainable = freeze_mask_fn(params)
-        import jax
-
         labels = jax.tree_util.tree_map(
             lambda t: "trainable" if t else "frozen", trainable
         )
@@ -97,8 +95,6 @@ def get_learning_rate(opt_state) -> float:
 
 
 def set_learning_rate(opt_state, lr: float):
-    import jax.numpy as jnp
-
     hp = dict(opt_state.hyperparams)
     hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
     return opt_state._replace(hyperparams=hp)

@@ -79,3 +79,5 @@ def pytest_freeze_conv():
         )
         if str(key).startswith("encoder_"):
             assert not changed, f"frozen {key} changed"
+        else:
+            assert changed, f"head {key} did not change"

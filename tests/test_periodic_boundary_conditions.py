@@ -42,6 +42,10 @@ def pytest_bcc_second_shell():
     edge_index, lengths = radius_graph_pbc(pos, cell, radius=1.05, max_neighbors=100)
     per_atom = edge_index.shape[1] / pos.shape[0]
     assert per_atom == 8 + 6
+    n_first = int(np.sum(np.isclose(lengths, np.sqrt(3) / 2, atol=1e-6)))
+    n_second = int(np.sum(np.isclose(lengths, 1.0, atol=1e-6)))
+    assert n_first == 8 * pos.shape[0]
+    assert n_second == 6 * pos.shape[0]
 
 
 def pytest_dimer_in_vacuum_cell():
