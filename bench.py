@@ -233,7 +233,7 @@ def main():
                 "metric": "pna_multihead_train_graphs_per_sec",
                 "value": round(ours, 2),
                 "unit": "graphs/sec",
-                "vs_baseline": round(ours / base, 3) if base else 1.0,
+                "vs_baseline": round(ours / base, 3) if base else None,
             }
         )
     )

@@ -1,0 +1,221 @@
+"""Sharded GraphPack dataset — the ADIOS2 data-plane replacement.
+
+API parity with ``AdiosWriter``/``AdiosDataset``
+(``hydragnn/utils/adiosdataset.py:77-278, 281-789``): a writer that each
+process calls with its local samples (``add``), plus global attributes; a
+dataset that presents the union of all shards with O(1) ``get(i)`` by global
+index. Differences by design (TPU-native):
+
+- Each process writes its OWN shard file (``<label>/shard.<rank>.gpk``) — no
+  MPI-collective global write; the "global shape/offset" bookkeeping the
+  reference assembles with allgathers (``adiosdataset.py:207-270``) is
+  recovered at open time from the per-shard count/offset indexes.
+- The reference's node-local SharedMemory mode (``:458-506``) is free here:
+  shard files are mmap'd MAP_SHARED, so all trainer processes on one host
+  share the same page-cache pages. ``preload=True`` copies into RAM instead
+  (slow remote filesystems).
+"""
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.native.graphpack import PackReader, PackWriter
+
+
+class ShardWriter:
+    """Per-process shard writer.
+
+    >>> w = ShardWriter("dataset/trainset", rank=rank)
+    >>> w.add(samples)           # list[GraphData], this process's share
+    >>> w.add_global("pna_deg", deg_hist)
+    >>> w.save()
+    """
+
+    def __init__(self, label: str, rank: int = 0):
+        self.label = label
+        self.rank = rank
+        self.samples: List[GraphData] = []
+        self.attrs: Dict[str, object] = {}
+
+    def add(self, samples):
+        if isinstance(samples, GraphData):
+            self.samples.append(samples)
+        else:
+            self.samples.extend(samples)
+
+    def add_global(self, name: str, value):
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        self.attrs[name] = value
+
+    def save(self):
+        os.makedirs(self.label, exist_ok=True)
+        n = len(self.samples)
+        path = os.path.join(self.label, f"shard.{self.rank:05d}.gpk")
+        tmp = path + ".partial"
+        w = PackWriter(tmp, n)
+        try:
+            self._pack(w)
+            w.finish()
+            os.replace(tmp, path)
+        except Exception:
+            w.abort()
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+        if self.rank == 0:
+            meta = dict(self.attrs)
+            s0 = self.samples[0] if self.samples else None
+            if s0 is not None:
+                meta.setdefault("target_types", list(s0.target_types))
+                meta.setdefault(
+                    "target_dims",
+                    [int(np.atleast_2d(t).shape[-1]) for t in s0.targets],
+                )
+            with open(os.path.join(self.label, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1)
+
+    def _pack(self, w: PackWriter):
+        ss = self.samples
+        n = len(ss)
+        nodes = np.array([s.num_nodes for s in ss], dtype=np.int64)
+        edges = np.array([s.num_edges for s in ss], dtype=np.int64)
+        w.add(
+            "x",
+            np.concatenate([s.x for s in ss]).astype(np.float32)
+            if n
+            else np.zeros((0, 1), np.float32),
+            counts=nodes,
+        )
+        if n and all(s.pos is not None for s in ss):
+            w.add(
+                "pos",
+                np.concatenate([s.pos for s in ss]).astype(np.float32),
+                counts=nodes,
+            )
+        # edge_index stored edge-major [E, 2] so samples are contiguous
+        w.add(
+            "edge_index",
+            np.concatenate([s.edge_index.T for s in ss]).astype(np.int64)
+            if n
+            else np.zeros((0, 2), np.int64),
+            counts=edges,
+        )
+        if n and all(s.edge_attr is not None for s in ss):
+            w.add(
+                "edge_attr",
+                np.concatenate([s.edge_attr for s in ss]).astype(np.float32),
+                counts=edges,
+            )
+        if all(s.y is not None for s in ss) and n:
+            w.add(
+                "y",
+                np.stack([np.ravel(s.y) for s in ss]).astype(np.float32),
+            )
+        if all(s.supercell_size is not None for s in ss) and n:
+            w.add(
+                "supercell_size",
+                np.stack(
+                    [np.asarray(s.supercell_size, np.float32) for s in ss]
+                ),
+            )
+        num_heads = len(ss[0].targets) if n else 0
+        for ih in range(num_heads):
+            ttype = ss[0].target_types[ih]
+            if ttype == "graph":
+                w.add(
+                    f"target{ih}",
+                    np.stack(
+                        [np.ravel(s.targets[ih]) for s in ss]
+                    ).astype(np.float32),
+                )
+            else:
+                w.add(
+                    f"target{ih}",
+                    np.concatenate(
+                        [
+                            np.asarray(s.targets[ih], np.float32).reshape(
+                                s.num_nodes, -1
+                            )
+                            for s in ss
+                        ]
+                    ),
+                    counts=nodes,
+                )
+
+
+class ShardDataset:
+    """Reads every shard under ``label/``; presents a flat global index.
+
+    ``get(i)`` is two array slices out of the mmap per variable — no pickle,
+    no per-sample files, no remote fetch needed on a single host.
+    """
+
+    def __init__(self, label: str, preload: bool = False):
+        self.label = label
+        paths = sorted(glob.glob(os.path.join(label, "shard.*.gpk")))
+        if not paths:
+            raise FileNotFoundError(f"no GraphPack shards under {label}")
+        self.readers = [PackReader(p, preload=preload) for p in paths]
+        self._cum = np.cumsum([r.num_samples for r in self.readers])
+        meta_path = os.path.join(label, "meta.json")
+        self.meta: Dict[str, object] = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                self.meta = json.load(f)
+        self.target_types = list(self.meta.get("target_types", []))
+
+    def __len__(self) -> int:
+        return int(self._cum[-1]) if len(self._cum) else 0
+
+    def _locate(self, idx: int):
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise IndexError(idx)
+        shard = int(np.searchsorted(self._cum, idx, side="right"))
+        local = idx - (int(self._cum[shard - 1]) if shard else 0)
+        return self.readers[shard], local
+
+    def get(self, idx: int) -> GraphData:
+        r, i = self._locate(idx)
+        d = GraphData()
+        d.x = np.array(r.read("x", i))
+        if "pos" in r.vars:
+            d.pos = np.array(r.read("pos", i))
+        d.edge_index = np.array(r.read("edge_index", i)).T
+        if "edge_attr" in r.vars:
+            d.edge_attr = np.array(r.read("edge_attr", i))
+        if "y" in r.vars:
+            d.y = np.array(r.read("y", i)).ravel()
+        if "supercell_size" in r.vars:
+            d.supercell_size = np.array(r.read("supercell_size", i)).reshape(
+                3, 3
+            )
+        ih = 0
+        d.target_types = []
+        while f"target{ih}" in r.vars:
+            t = np.array(r.read(f"target{ih}", i))
+            # variable-dim target vars (dims[0] == -1) are node heads
+            is_node = r.vars[f"target{ih}"][2][0] == -1
+            d.targets.append(t if is_node else t.reshape(-1))
+            d.target_types.append("node" if is_node else "graph")
+            ih += 1
+        return d
+
+    def __getitem__(self, idx: int) -> GraphData:
+        return self.get(idx)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self.get(i)
+
+    def close(self):
+        for r in self.readers:
+            r.close()
+        self.readers = []

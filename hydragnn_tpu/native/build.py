@@ -1,0 +1,52 @@
+"""Compile-on-first-use build for the native (C++) runtime components.
+
+No pip/pybind11 in the image, so bindings are ctypes over plain C ABIs and
+the shared objects are built lazily with g++ into ``native/_build/``, keyed
+by source mtime so edits trigger a rebuild.
+"""
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+
+
+def build_library(name: str, sources, extra_flags=()) -> str:
+    """Build ``lib<name>.so`` from ``sources`` (paths relative to native/)
+    if missing or stale; returns the .so path."""
+    os.makedirs(_BUILD, exist_ok=True)
+    out = os.path.join(_BUILD, f"lib{name}.so")
+    srcs = [os.path.join(_HERE, s) for s in sources]
+    with _LOCK:
+        if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
+        ):
+            return out
+        # pid-unique tmp + atomic replace: concurrent trainer processes on
+        # one host may race to build the same library on a cold cache
+        tmp = f"{out}.{os.getpid()}.tmp"
+        cmd = [
+            "g++",
+            "-O3",
+            "-std=c++17",
+            "-shared",
+            "-fPIC",
+            "-Wall",
+            *extra_flags,
+            *srcs,
+            "-o",
+            tmp,
+            "-lpthread",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    return out
+
+
+def load_library(name: str, sources, extra_flags=()):
+    import ctypes
+
+    return ctypes.CDLL(build_library(name, sources, extra_flags))

@@ -159,6 +159,19 @@ def host_allreduce(arr: np.ndarray, op: str = "sum") -> np.ndarray:
     raise ValueError(f"unknown op {op}")
 
 
+def host_allgather_int(value: int):
+    """Per-process int -> list over all processes (ordered by process id)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [int(value)]
+    from jax.experimental import multihost_utils
+    import jax.numpy as jnp
+
+    out = multihost_utils.process_allgather(jnp.asarray([value]))
+    return [int(v) for v in np.asarray(out).ravel()]
+
+
 def print_peak_memory(verbosity: int = 0, prefix: str = ""):
     """Device-memory report (analog of ``print_peak_memory``,
     ``distributed.py:277-284``)."""

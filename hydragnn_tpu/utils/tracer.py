@@ -81,12 +81,22 @@ class JaxProfilerTracer:
         pass
 
 
-def initialize(trace_backends=("timer",), verbosity: int = 0):
+def initialize(trace_backends=("native",), verbosity: int = 0):
     for b in trace_backends:
         if b == "timer":
             _tracers["timer"] = TimerTracer()
         elif b == "jax":
             _tracers["jax"] = JaxProfilerTracer()
+        elif b == "native":
+            # C++ region timer (GPTL analog) with call-tree attribution and
+            # chrome-trace export; falls back to the Python timer if the
+            # toolchain is unavailable.
+            try:
+                from hydragnn_tpu.native.regiontimer import NativeRegionTimer
+
+                _tracers["native"] = NativeRegionTimer()
+            except Exception:
+                _tracers["timer"] = TimerTracer()
     return list(_tracers)
 
 
@@ -153,10 +163,13 @@ def profile(name):
 
 
 def save(prefix: str = "./logs/trace"):
-    """Per-host region dump (GPTL ``gp.pr_file`` analog)."""
+    """Per-host region dump (GPTL ``gp.pr_file`` analog). The native backend
+    additionally writes a chrome://tracing JSON (`<prefix>.<rank>.trace.json`,
+    loadable in perfetto)."""
     from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
     _, rank = get_comm_size_and_rank()
-    t = _tracers.get("timer")
-    if t is not None:
+    for t in _tracers.values():
         t.pr_file(f"{prefix}.{rank}")
+        if hasattr(t, "chrome_trace"):
+            t.chrome_trace(f"{prefix}.{rank}.trace.json", pid=rank)

@@ -1,0 +1,179 @@
+"""Native (C++) components: GraphPack shard store round-trip, DistStore
+remote fetch over TCP, region-timer call-tree (reference analogs: ADIOS2
+AdiosWriter/AdiosDataset, pyddstore DistDataset, gptl4py tracer —
+SURVEY.md §2.4)."""
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+
+
+def _mk(rng, n):
+    d = GraphData()
+    d.x = rng.random((n, 2)).astype(np.float32)
+    d.pos = rng.random((n, 3)).astype(np.float32)
+    e = 2 * n
+    d.edge_index = rng.integers(0, n, (2, e)).astype(np.int64)
+    d.edge_attr = rng.random((e, 1)).astype(np.float32)
+    d.y = rng.random(4).astype(np.float32)
+    d.supercell_size = np.eye(3, dtype=np.float32)
+    d.targets = [
+        rng.random(2).astype(np.float32),
+        rng.random((n, 1)).astype(np.float32),
+    ]
+    d.target_types = ["graph", "node"]
+    return d
+
+
+def _assert_same(a, b):
+    assert np.allclose(a.x, b.x)
+    assert np.allclose(a.pos, b.pos)
+    assert np.array_equal(a.edge_index, b.edge_index)
+    assert np.allclose(a.edge_attr, b.edge_attr)
+    assert np.allclose(a.y, b.y)
+    assert b.target_types == ["graph", "node"]
+    assert np.allclose(a.targets[0], b.targets[0])
+    assert np.allclose(a.targets[1], b.targets[1])
+
+
+def pytest_graphpack_roundtrip():
+    from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
+
+    rng = np.random.default_rng(0)
+    samples = [_mk(rng, int(rng.integers(3, 9))) for _ in range(40)]
+    with tempfile.TemporaryDirectory() as tmp:
+        label = os.path.join(tmp, "trainset")
+        w0 = ShardWriter(label, rank=0)
+        w0.add(samples[:25])
+        w0.add_global("pna_deg", np.array([1, 2, 3]))
+        w0.save()
+        w1 = ShardWriter(label, rank=1)
+        w1.add(samples[25:])
+        w1.save()
+
+        for preload in (False, True):
+            ds = ShardDataset(label, preload=preload)
+            assert len(ds) == 40
+            assert ds.meta["pna_deg"] == [1, 2, 3]
+            for i in (0, 13, 24, 25, 39):
+                _assert_same(samples[i], ds.get(i))
+            assert np.allclose(
+                ds.get(7).supercell_size, samples[7].supercell_size
+            )
+            ds.close()
+
+
+def pytest_graphpack_bulk_view():
+    from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
+
+    rng = np.random.default_rng(1)
+    samples = [_mk(rng, 5) for _ in range(8)]
+    with tempfile.TemporaryDirectory() as tmp:
+        label = os.path.join(tmp, "set")
+        w = ShardWriter(label, rank=0)
+        w.add(samples)
+        w.save()
+        ds = ShardDataset(label)
+        xs = ds.readers[0].read_all("x")
+        assert xs.shape == (40, 2)
+        assert not xs.flags.writeable  # zero-copy mmap view
+        assert np.allclose(xs[:5], samples[0].x)
+        counts = ds.readers[0].counts("x")
+        assert counts.tolist() == [5] * 8
+        ds.close()
+
+
+def pytest_graphpack_empty_shard():
+    """A rank with zero local samples still writes a valid (empty) shard."""
+    from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
+
+    rng = np.random.default_rng(3)
+    with tempfile.TemporaryDirectory() as tmp:
+        label = os.path.join(tmp, "s")
+        w1 = ShardWriter(label, rank=1)
+        w1.add([])
+        w1.save()
+        w0 = ShardWriter(label, rank=0)
+        w0.add([_mk(rng, 4)])
+        w0.save()
+        ds = ShardDataset(label)
+        assert len(ds) == 1
+        assert ds.get(0).num_nodes == 4
+        ds.close()
+
+
+def pytest_diststore_remote_fetch():
+    from hydragnn_tpu.data.distdataset import DistDataset
+
+    rng = np.random.default_rng(2)
+    all_samples = [_mk(rng, int(rng.integers(3, 9))) for _ in range(30)]
+    # single-process twin-store test: the host-side allgather of per-rank
+    # maxima can't run (one jax process), so pass the global maxima directly
+    mc = {"nodes": 8, "edges": 16}
+    ds0 = DistDataset(
+        all_samples[:20], rank=0, world=2, samples_per_rank=[20, 10],
+        base_port=23810, max_counts=mc,
+    )
+    ds1 = DistDataset(
+        all_samples[20:], rank=1, world=2, samples_per_rank=[20, 10],
+        base_port=23810, max_counts=mc,
+    )
+    try:
+        assert len(ds0) == 30 and len(ds1) == 30
+        ds0.epoch_begin()
+        ds1.epoch_begin()
+        for idx in (0, 19, 20, 29):  # local + remote both directions
+            _assert_same(all_samples[idx], ds0.get(idx))
+        _assert_same(all_samples[5], ds1.get(5))
+        ds0.epoch_end()
+        ds1.epoch_end()
+        # window reopens
+        ds0.epoch_begin()
+        ds1.epoch_begin()
+        _assert_same(all_samples[25], ds0.get(25))
+        ds0.epoch_end()
+        ds1.epoch_end()
+    finally:
+        ds0.close()
+        ds1.close()
+
+
+def pytest_region_timer_calltree():
+    from hydragnn_tpu.native.regiontimer import NativeRegionTimer
+
+    t = NativeRegionTimer()
+    for _ in range(2):
+        t.start("train")
+        t.start("forward")
+        time.sleep(0.002)
+        t.stop("forward")
+        t.stop("train")
+    assert t.count("train") == 2
+    assert t.count("train/forward") == 2
+    assert t.total("train") >= t.total("train/forward") > 0
+    with tempfile.TemporaryDirectory() as tmp:
+        t.pr_file(os.path.join(tmp, "trace.0"))
+        text = open(os.path.join(tmp, "trace.0")).read()
+        assert "forward" in text and "train" in text
+        t.chrome_trace(os.path.join(tmp, "trace.json"))
+        events = json.load(open(os.path.join(tmp, "trace.json")))
+        assert len(events) == 4
+        assert all(e["ph"] == "X" for e in events)
+
+
+def pytest_tracer_facade_native_backend():
+    from hydragnn_tpu.utils import tracer as tr
+
+    tr.initialize(("native",))
+    tr.start("epoch")
+    tr.stop("epoch")
+    with tempfile.TemporaryDirectory() as tmp:
+        tr.save(os.path.join(tmp, "t"))
+        assert os.path.exists(os.path.join(tmp, "t.0"))
+        assert os.path.exists(os.path.join(tmp, "t.0.trace.json"))
+    tr.reset()
