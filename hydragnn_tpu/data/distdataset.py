@@ -201,7 +201,9 @@ def _resolve_schema(ss: List[GraphData]) -> Dict[str, object]:
     with zero local samples adopts the schema the others agree on; presence
     flags are AND-reduced across processes, dims/num_heads MAX-reduced."""
     n = len(ss)
-    local = np.zeros(48, np.int64)
+    num_heads_local = len(ss[0].targets) if n else 0
+    slots = _reduce_max(num_heads_local)
+    local = np.zeros(8 + 2 * max(slots, 1), np.int64)
     if n:
         local[0] = int(all(s.pos is not None for s in ss))
         local[1] = int(all(s.edge_attr is not None for s in ss))
@@ -212,7 +214,7 @@ def _resolve_schema(ss: List[GraphData]) -> Dict[str, object]:
             ss[0].edge_attr.shape[1] if ss[0].edge_attr is not None else 0
         )
         local[6] = np.ravel(ss[0].y).shape[0] if ss[0].y is not None else 0
-        for ih in range(min(len(ss[0].targets), 20)):
+        for ih in range(num_heads_local):
             local[8 + 2 * ih] = int(ss[0].target_types[ih] == "node")
             local[8 + 2 * ih + 1] = int(
                 np.atleast_2d(ss[0].targets[ih]).shape[-1]

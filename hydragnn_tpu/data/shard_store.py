@@ -185,22 +185,20 @@ class ShardDataset:
     def get(self, idx: int) -> GraphData:
         r, i = self._locate(idx)
         d = GraphData()
-        d.x = np.array(r.read("x", i))
+        d.x = r.read("x", i)
         if "pos" in r.vars:
-            d.pos = np.array(r.read("pos", i))
-        d.edge_index = np.array(r.read("edge_index", i)).T
+            d.pos = r.read("pos", i)
+        d.edge_index = r.read("edge_index", i).T
         if "edge_attr" in r.vars:
-            d.edge_attr = np.array(r.read("edge_attr", i))
+            d.edge_attr = r.read("edge_attr", i)
         if "y" in r.vars:
-            d.y = np.array(r.read("y", i)).ravel()
+            d.y = r.read("y", i).ravel()
         if "supercell_size" in r.vars:
-            d.supercell_size = np.array(r.read("supercell_size", i)).reshape(
-                3, 3
-            )
+            d.supercell_size = r.read("supercell_size", i).reshape(3, 3)
         ih = 0
         d.target_types = []
         while f"target{ih}" in r.vars:
-            t = np.array(r.read(f"target{ih}", i))
+            t = r.read(f"target{ih}", i)
             # variable-dim target vars (dims[0] == -1) are node heads
             is_node = r.vars[f"target{ih}"][2][0] == -1
             d.targets.append(t if is_node else t.reshape(-1))

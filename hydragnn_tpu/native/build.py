@@ -41,7 +41,11 @@ def build_library(name: str, sources, extra_flags=()) -> str:
             tmp,
             "-lpthread",
         ]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"g++ failed building lib{name}.so:\n{proc.stderr}"
+            )
         os.replace(tmp, out)
     return out
 

@@ -58,9 +58,9 @@ struct Store {
   int listen_fd = -1;
   std::thread server;
   std::atomic<bool> running{false};
-  std::vector<int> peer_fd;        // cached client connections
+  // cached client connections; peer_fd[r] is only touched under peer_mu[r]
+  std::vector<int> peer_fd;
   std::vector<std::unique_ptr<std::mutex>> peer_mu;
-  std::mutex connect_mu;
 };
 
 bool read_full(int fd, void* buf, size_t n) {
@@ -140,27 +140,48 @@ void server_loop(Store* s) {
     if (w.joinable()) w.join();
 }
 
+// caller must hold peer_mu[rank]
 int connect_peer(Store* s, int rank) {
-  std::lock_guard<std::mutex> lk(s->connect_mu);
   if (s->peer_fd[rank] >= 0) return s->peer_fd[rank];
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return -1;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)s->port[rank]);
   inet_pton(AF_INET, s->host[rank].c_str(), &addr.sin_addr);
-  // the peer's epoch_begin may lag ours: retry briefly
+  // the peer's epoch_begin may lag ours: retry briefly. A TCP socket is
+  // unusable after a failed connect(), so each attempt gets a fresh one.
   for (int attempt = 0; attempt < 100; ++attempt) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
     if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       s->peer_fd[rank] = fd;
       return fd;
     }
+    close(fd);
     usleep(50 * 1000);
   }
-  close(fd);
   return -1;
+}
+
+// Drop a cached peer connection after a protocol failure so the next get()
+// reconnects instead of reading a desynchronized stream. Caller must hold
+// peer_mu[rank].
+void invalidate_peer(Store* s, int rank, int fd) {
+  if (s->peer_fd[rank] == fd) s->peer_fd[rank] = -1;
+  close(fd);
+}
+
+// Read and discard n bytes (keeps the stream in sync when the caller's
+// buffer was too small). Returns false on socket error.
+bool drain(int fd, uint64_t n) {
+  uint8_t scratch[4096];
+  while (n) {
+    size_t chunk = n < sizeof(scratch) ? (size_t)n : sizeof(scratch);
+    if (!read_full(fd, scratch, chunk)) return false;
+    n -= chunk;
+  }
+  return true;
 }
 
 }  // namespace
@@ -239,8 +260,16 @@ int dds_epoch_begin(void* sp) {
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = INADDR_ANY;
   addr.sin_port = htons((uint16_t)s->port[s->rank]);
-  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0) return -2;
-  if (listen(s->listen_fd, 64) != 0) return -3;
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(s->listen_fd);
+    s->listen_fd = -1;
+    return -2;
+  }
+  if (listen(s->listen_fd, 64) != 0) {
+    close(s->listen_fd);
+    s->listen_fd = -1;
+    return -3;
+  }
   s->running.store(true);
   s->server = std::thread(server_loop, s);
   return 0;
@@ -253,9 +282,10 @@ int dds_epoch_end(void* sp) {
   if (s->server.joinable()) s->server.join();
   close(s->listen_fd);
   s->listen_fd = -1;
-  for (auto& fd : s->peer_fd) {
-    if (fd >= 0) close(fd);
-    fd = -1;
+  for (int r = 0; r < s->world; ++r) {
+    std::lock_guard<std::mutex> lk(*s->peer_mu[r]);
+    if (s->peer_fd[r] >= 0) close(s->peer_fd[r]);
+    s->peer_fd[r] = -1;
   }
   return 0;
 }
@@ -275,15 +305,26 @@ int64_t dds_get(void* sp, uint32_t vi, uint64_t gidx, void* out,
     memcpy(out, p, *nbytes);
     return rows;
   }
+  // the lock spans connect -> request -> response -> (maybe) invalidate, so
+  // the fd cannot be closed/reused by a concurrent get to the same owner
+  std::lock_guard<std::mutex> lk(*s->peer_mu[owner]);
   int fd = connect_peer(s, owner);
   if (fd < 0) return -3;
-  std::lock_guard<std::mutex> lk(*s->peer_mu[owner]);
   int64_t rows;
   if (!write_full(fd, &vi, 4) || !write_full(fd, &gidx, 8) ||
-      !read_full(fd, &rows, 8) || !read_full(fd, nbytes, 8))
+      !read_full(fd, &rows, 8) || !read_full(fd, nbytes, 8)) {
+    invalidate_peer(s, owner, fd);
     return -4;
-  if (*nbytes > out_cap) return -2;
-  if (!read_full(fd, out, *nbytes)) return -4;
+  }
+  if (*nbytes > out_cap) {
+    // consume the payload so the cached connection stays usable
+    if (!drain(fd, *nbytes)) invalidate_peer(s, owner, fd);
+    return -2;
+  }
+  if (!read_full(fd, out, *nbytes)) {
+    invalidate_peer(s, owner, fd);
+    return -4;
+  }
   return rows;
 }
 

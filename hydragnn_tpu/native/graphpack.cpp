@@ -193,25 +193,34 @@ int gpk_writer_finish(void* wp) {
   }
 
   FILE* f = fopen(w->path.c_str(), "wb");
-  if (!f) return -1;
+  if (!f) {
+    delete w;
+    return -1;
+  }
   int rc = 0;
   if (fwrite(header.data(), 1, header.size(), f) != header.size()) rc = -2;
   uint64_t written = header.size();
   for (auto& v : w->vars) {
     if (!v.variable()) continue;
-    fwrite(v.count.data(), sizeof(int64_t), v.count.size(), f);
-    fwrite(v.offset.data(), sizeof(int64_t), v.offset.size(), f);
+    if (fwrite(v.count.data(), sizeof(int64_t), v.count.size(), f) !=
+        v.count.size())
+      rc = -2;
+    if (fwrite(v.offset.data(), sizeof(int64_t), v.offset.size(), f) !=
+        v.offset.size())
+      rc = -2;
     written += 2 * sizeof(int64_t) * w->num_samples;
   }
   for (auto& v : w->vars) {
     uint64_t pad = align_up(written) - written;
     static const char zeros[kAlign] = {0};
-    if (pad) fwrite(zeros, 1, pad, f);
+    if (pad && fwrite(zeros, 1, pad, f) != pad) rc = -2;
     written += pad;
     if (fwrite(v.data, 1, v.data_bytes, f) != v.data_bytes) rc = -2;
     written += v.data_bytes;
   }
-  fclose(f);
+  // stdio buffering can defer a write failure (e.g. ENOSPC) to the final
+  // flush — a corrupt shard must not report success and get published.
+  if (fclose(f) != 0) rc = -2;
   delete w;
   return rc;
 }

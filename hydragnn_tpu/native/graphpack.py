@@ -179,8 +179,12 @@ class PackReader:
         if not ptr:
             raise IndexError(f"{name}[{sample}]")
         shape = (int(rows.value),) + dims[1:]
-        buf = ctypes.string_at(ptr, nbytes.value)
-        return np.frombuffer(buf, dtype=_NP_DTYPES[dt]).reshape(shape)
+        view = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(int(nbytes.value),),
+        )
+        # single copy out of the mmap, already writeable for downstream use
+        return view.view(_NP_DTYPES[dt]).reshape(shape).copy()
 
     def read_all(self, name: str) -> np.ndarray:
         """The whole concatenated blob, zero-copy view into the mmap."""
@@ -191,7 +195,12 @@ class PackReader:
             ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
             shape=(int(nbytes.value),),
         ).view(_NP_DTYPES[dt])
-        arr = arr.reshape((-1,) + dims[1:])
+        # variable-dim vars concatenate samples along dim 0; fixed-shape vars
+        # store dims as the per-sample shape, so samples stack in front of it
+        if dims and dims[0] == -1:
+            arr = arr.reshape((-1,) + dims[1:])
+        else:
+            arr = arr.reshape((-1,) + dims)
         # NOTE: view into the mmap — valid only while this reader is open;
         # the dataset layer holds the reader for its lifetime.
         arr.flags.writeable = False

@@ -41,16 +41,6 @@ struct Timer {
   std::vector<Event> events;
   size_t max_events = 1 << 20;
   double epoch = now_s();
-
-  std::string path_of(const char* name) const {
-    std::string p;
-    for (auto& s : stack) {
-      p += s.first;
-      p += '/';
-    }
-    p += name;
-    return p;
-  }
 };
 
 }  // namespace
@@ -128,6 +118,25 @@ int rt_print(void* h, const char* filename) {
   return 0;
 }
 
+// Region names are arbitrary caller strings: escape them for JSON.
+std::string json_escape(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (unsigned char c : in) {
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += (char)c;
+    } else if (c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out += (char)c;
+    }
+  }
+  return out;
+}
+
 // chrome://tracing / perfetto JSON ("X" complete events).
 int rt_chrome(void* h, const char* filename, int pid) {
   Timer* t = static_cast<Timer*>(h);
@@ -142,7 +151,7 @@ int rt_chrome(void* h, const char* filename, int pid) {
     fprintf(f,
             "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":0,"
             "\"ts\":%.3f,\"dur\":%.3f}",
-            e.path.c_str(), pid, 1e6 * (e.t0 - t->epoch),
+            json_escape(e.path).c_str(), pid, 1e6 * (e.t0 - t->epoch),
             1e6 * (e.t1 - e.t0));
   }
   fprintf(f, "\n]\n");

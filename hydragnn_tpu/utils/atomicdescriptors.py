@@ -1,0 +1,152 @@
+"""Periodic-table atomic descriptor embeddings.
+
+Parity with ``hydragnn/utils/atomicdescriptors.py:12-243``: per-element
+feature vectors built from element-type one-hot, group, period, covalent
+radius, electron affinity, block one-hot, atomic volume, atomic number,
+atomic weight, electronegativity, valence-electron count and first ionization
+energy — real-valued properties min–max normalized over the chosen element
+set, with an optional one-hot (binned) encoding of each property. Embeddings
+are cached to a JSON file keyed by atomic number, exactly like the reference.
+
+Implemented in numpy over the embedded periodic table
+(:mod:`hydragnn_tpu.utils.periodic_table`) instead of mendeleev + torch: the
+output feeds host-side preprocessing, never the XLA graph.
+"""
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from hydragnn_tpu.utils import periodic_table as pt
+
+_BLOCKS = ["s", "p", "d", "f"]
+
+
+def _one_hot(indices: np.ndarray, num_classes: int) -> np.ndarray:
+    out = np.zeros((indices.shape[0], num_classes), dtype=np.float32)
+    out[np.arange(indices.shape[0]), indices.astype(int)] = 1.0
+    return out
+
+
+def _normalize(values: List[Optional[float]], prop_name: str) -> np.ndarray:
+    none_elements = [i for i, v in enumerate(values) if v is None]
+    if none_elements:
+        raise ValueError(
+            f"undefined property {prop_name!r} for element indices {none_elements}"
+        )
+    arr = np.asarray(values, dtype=np.float32)
+    span = arr.max() - arr.min()
+    return (arr - arr.min()) / (span if span > 0 else 1.0)
+
+
+def _real_to_categorical(values: np.ndarray, num_classes: int = 10) -> np.ndarray:
+    delta = (values.max() - values.min()) / num_classes
+    if delta == 0:
+        return np.zeros_like(values)
+    return np.minimum((values - values.min()) / delta, num_classes - 1)
+
+
+class atomicdescriptors:
+    def __init__(
+        self,
+        embeddingfilename: str,
+        overwritten: bool = True,
+        element_types=("C", "H", "O", "N", "F", "S"),
+        one_hot: bool = False,
+    ):
+        if os.path.exists(embeddingfilename) and not overwritten:
+            with open(embeddingfilename, "r") as f:
+                self.atom_embeddings = json.load(f)
+            return
+
+        if element_types is None:
+            self.element_types = [e.symbol for e in pt.get_all_elements()]
+        else:
+            self.element_types = [
+                e.symbol for e in pt.get_all_elements() if e.symbol in element_types
+            ]
+        self.one_hot = one_hot
+        n = len(self.element_types)
+        elems = [pt.element(s) for s in self.element_types]
+
+        type_id = _one_hot(np.arange(n), n)
+        group_id = np.asarray(
+            [[e.group_id - 1] for e in elems], dtype=np.float32
+        )
+        period = np.asarray([[e.period - 1] for e in elems], dtype=np.float32)
+        covalent_radius = _normalize(
+            [e.covalent_radius for e in elems], "covalent_radius"
+        ).reshape(n, 1)
+        electron_affinity = _normalize(
+            [e.electron_affinity for e in elems], "electron_affinity"
+        ).reshape(n, 1)
+        block = _one_hot(
+            np.asarray([_BLOCKS.index(e.block) for e in elems]), len(_BLOCKS)
+        )
+        atomic_volume = _normalize(
+            [e.atomic_volume for e in elems], "atomic_volume"
+        ).reshape(n, 1)
+        atomic_number = np.asarray(
+            [[e.atomic_number] for e in elems], dtype=np.float32
+        )
+        atomic_weight = _normalize(
+            [e.atomic_weight for e in elems], "atomic_weight"
+        ).reshape(n, 1)
+        electronegativity = _normalize(
+            [e.en_pauling for e in elems], "en_pauling"
+        ).reshape(n, 1)
+        valenceelectrons = np.asarray(
+            [[e.nvalence] for e in elems], dtype=np.float32
+        )
+        ionenergies = _normalize(
+            [e.ionenergy for e in elems], "ionenergies"
+        ).reshape(n, 1)
+
+        if one_hot:
+            def int_onehot(prop):
+                flat = prop.reshape(-1)
+                return _one_hot(flat, int(flat.max()) + 1)
+
+            def real_onehot(prop, num_classes=10):
+                cats = _real_to_categorical(prop.reshape(-1), num_classes)
+                return _one_hot(cats, num_classes)
+
+            group_id = int_onehot(group_id)
+            period = int_onehot(period)
+            atomic_number = int_onehot(atomic_number)
+            valenceelectrons = int_onehot(valenceelectrons)
+            covalent_radius = real_onehot(covalent_radius)
+            electron_affinity = real_onehot(electron_affinity)
+            atomic_volume = real_onehot(atomic_volume)
+            atomic_weight = real_onehot(atomic_weight)
+            electronegativity = real_onehot(electronegativity)
+            ionenergies = real_onehot(ionenergies)
+
+        self.atom_embeddings = {}
+        columns = [
+            type_id,
+            group_id,
+            period,
+            covalent_radius,
+            electron_affinity,
+            block,
+            atomic_volume,
+            atomic_number,
+            atomic_weight,
+            electronegativity,
+            valenceelectrons,
+            ionenergies,
+        ]
+        for i, e in enumerate(elems):
+            self.atom_embeddings[str(e.atomic_number)] = [
+                float(v) for col in columns for v in np.atleast_2d(col)[i]
+            ]
+        with open(embeddingfilename, "w") as f:
+            json.dump(self.atom_embeddings, f)
+
+    def get_atom_features(self, atomtype) -> np.ndarray:
+        if isinstance(atomtype, str):
+            atomtype = pt.element(atomtype).atomic_number
+        return np.asarray(self.atom_embeddings[str(atomtype)], dtype=np.float32)

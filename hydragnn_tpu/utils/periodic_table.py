@@ -1,0 +1,155 @@
+"""Embedded periodic-table property data (mendeleev analog).
+
+The reference pulls element properties from the ``mendeleev`` package at
+runtime (``hydragnn/utils/atomicdescriptors.py:12-243``). That package is not
+available here and descriptor generation is pure host-side preprocessing, so
+the property table is embedded: standard physical constants for the elements
+H–Xe plus common heavy elements used in atomistic ML datasets.
+
+Per element: group (IUPAC 1-18), period, block (s/p/d/f), atomic weight,
+covalent radius (pm), Pauling electronegativity, electron affinity (eV),
+atomic volume (cm^3/mol), valence-electron count, first ionization energy
+(eV). ``None`` marks properties that are undefined for an element (e.g.
+Pauling electronegativity of light noble gases); consumers raise on ``None``
+exactly like the reference does for mendeleev's ``None`` returns.
+"""
+
+from typing import Dict, Optional
+
+# fmt: off
+# symbol: (Z, group, period, block, weight, cov_radius_pm, en_pauling,
+#          electron_affinity_eV, atomic_volume_cm3mol, n_valence, ion_energy_eV)
+_ELEMENTS = {
+    "H":  (1,  1,  1, "s", 1.008,   31,  2.20, 0.754, 14.1,  1, 13.598),
+    "He": (2,  18, 1, "s", 4.003,   28,  None, None,  31.8,  2, 24.587),
+    "Li": (3,  1,  2, "s", 6.940,   128, 0.98, 0.618, 13.1,  1, 5.392),
+    "Be": (4,  2,  2, "s", 9.012,   96,  1.57, None,  5.0,   2, 9.323),
+    "B":  (5,  13, 2, "p", 10.810,  84,  2.04, 0.277, 4.6,   3, 8.298),
+    "C":  (6,  14, 2, "p", 12.011,  76,  2.55, 1.263, 5.3,   4, 11.260),
+    "N":  (7,  15, 2, "p", 14.007,  71,  3.04, -0.07, 17.3,  5, 14.534),
+    "O":  (8,  16, 2, "p", 15.999,  66,  3.44, 1.461, 14.0,  6, 13.618),
+    "F":  (9,  17, 2, "p", 18.998,  57,  3.98, 3.401, 17.1,  7, 17.423),
+    "Ne": (10, 18, 2, "p", 20.180,  58,  None, None,  16.8,  8, 21.565),
+    "Na": (11, 1,  3, "s", 22.990,  166, 0.93, 0.548, 23.7,  1, 5.139),
+    "Mg": (12, 2,  3, "s", 24.305,  141, 1.31, None,  14.0,  2, 7.646),
+    "Al": (13, 13, 3, "p", 26.982,  121, 1.61, 0.441, 10.0,  3, 5.986),
+    "Si": (14, 14, 3, "p", 28.085,  111, 1.90, 1.385, 12.1,  4, 8.152),
+    "P":  (15, 15, 3, "p", 30.974,  107, 2.19, 0.746, 17.0,  5, 10.487),
+    "S":  (16, 16, 3, "p", 32.060,  105, 2.58, 2.077, 15.5,  6, 10.360),
+    "Cl": (17, 17, 3, "p", 35.450,  102, 3.16, 3.613, 18.7,  7, 12.968),
+    "Ar": (18, 18, 3, "p", 39.948,  106, None, None,  24.2,  8, 15.760),
+    "K":  (19, 1,  4, "s", 39.098,  203, 0.82, 0.501, 45.3,  1, 4.341),
+    "Ca": (20, 2,  4, "s", 40.078,  176, 1.00, 0.025, 29.9,  2, 6.113),
+    "Sc": (21, 3,  4, "d", 44.956,  170, 1.36, 0.188, 15.0,  3, 6.561),
+    "Ti": (22, 4,  4, "d", 47.867,  160, 1.54, 0.079, 10.6,  4, 6.828),
+    "V":  (23, 5,  4, "d", 50.942,  153, 1.63, 0.525, 8.35,  5, 6.746),
+    "Cr": (24, 6,  4, "d", 51.996,  139, 1.66, 0.666, 7.23,  6, 6.767),
+    "Mn": (25, 7,  4, "d", 54.938,  139, 1.55, None,  7.39,  7, 7.434),
+    "Fe": (26, 8,  4, "d", 55.845,  132, 1.83, 0.151, 7.1,   8, 7.902),
+    "Co": (27, 9,  4, "d", 58.933,  126, 1.88, 0.662, 6.7,   9, 7.881),
+    "Ni": (28, 10, 4, "d", 58.693,  124, 1.91, 1.156, 6.6,  10, 7.640),
+    "Cu": (29, 11, 4, "d", 63.546,  132, 1.90, 1.235, 7.1,  11, 7.726),
+    "Zn": (30, 12, 4, "d", 65.380,  122, 1.65, None,  9.2,  12, 9.394),
+    "Ga": (31, 13, 4, "p", 69.723,  122, 1.81, 0.301, 11.8,  3, 5.999),
+    "Ge": (32, 14, 4, "p", 72.630,  120, 2.01, 1.233, 13.6,  4, 7.899),
+    "As": (33, 15, 4, "p", 74.922,  119, 2.18, 0.804, 13.1,  5, 9.789),
+    "Se": (34, 16, 4, "p", 78.971,  120, 2.55, 2.021, 16.5,  6, 9.752),
+    "Br": (35, 17, 4, "p", 79.904,  120, 2.96, 3.364, 23.5,  7, 11.814),
+    "Kr": (36, 18, 4, "p", 83.798,  116, 3.00, None,  32.2,  8, 13.999),
+    "Rb": (37, 1,  5, "s", 85.468,  220, 0.82, 0.486, 55.9,  1, 4.177),
+    "Sr": (38, 2,  5, "s", 87.620,  195, 0.95, 0.048, 33.7,  2, 5.695),
+    "Y":  (39, 3,  5, "d", 88.906,  190, 1.22, 0.307, 19.8,  3, 6.217),
+    "Zr": (40, 4,  5, "d", 91.224,  175, 1.33, 0.426, 14.1,  4, 6.634),
+    "Nb": (41, 5,  5, "d", 92.906,  164, 1.60, 0.893, 10.8,  5, 6.759),
+    "Mo": (42, 6,  5, "d", 95.950,  154, 2.16, 0.748, 9.4,   6, 7.092),
+    "Tc": (43, 7,  5, "d", 98.000,  147, 1.90, 0.550, 8.5,   7, 7.280),
+    "Ru": (44, 8,  5, "d", 101.070, 146, 2.20, 1.050, 8.3,   8, 7.360),
+    "Rh": (45, 9,  5, "d", 102.906, 142, 2.28, 1.137, 8.3,   9, 7.459),
+    "Pd": (46, 10, 5, "d", 106.420, 139, 2.20, 0.562, 8.9,  10, 8.337),
+    "Ag": (47, 11, 5, "d", 107.868, 145, 1.93, 1.302, 10.3, 11, 7.576),
+    "Cd": (48, 12, 5, "d", 112.414, 144, 1.69, None,  13.1, 12, 8.994),
+    "In": (49, 13, 5, "p", 114.818, 142, 1.78, 0.300, 15.7,  3, 5.786),
+    "Sn": (50, 14, 5, "p", 118.710, 139, 1.96, 1.112, 16.3,  4, 7.344),
+    "Sb": (51, 15, 5, "p", 121.760, 139, 2.05, 1.047, 18.4,  5, 8.608),
+    "Te": (52, 16, 5, "p", 127.600, 138, 2.10, 1.971, 20.5,  6, 9.010),
+    "I":  (53, 17, 5, "p", 126.904, 139, 2.66, 3.059, 25.7,  7, 10.451),
+    "Xe": (54, 18, 5, "p", 131.293, 140, 2.60, None,  42.9,  8, 12.130),
+    "Cs": (55, 1,  6, "s", 132.905, 244, 0.79, 0.472, 70.0,  1, 3.894),
+    "Ba": (56, 2,  6, "s", 137.327, 215, 0.89, 0.145, 39.0,  2, 5.212),
+    "W":  (74, 6,  6, "d", 183.840, 162, 2.36, 0.816, 9.47,  6, 7.864),
+    "Pt": (78, 10, 6, "d", 195.084, 136, 2.28, 2.128, 9.10, 10, 8.959),
+    "Au": (79, 11, 6, "d", 196.967, 136, 2.54, 2.309, 10.2, 11, 9.226),
+    "Hg": (80, 12, 6, "d", 200.592, 132, 2.00, None,  14.8, 12, 10.438),
+    "Pb": (82, 14, 6, "p", 207.200, 146, 2.33, 0.356, 18.3,  4, 7.417),
+    "Bi": (83, 15, 6, "p", 208.980, 148, 2.02, 0.942, 21.3,  5, 7.286),
+}
+# fmt: on
+
+_FIELDS = (
+    "atomic_number",
+    "group_id",
+    "period",
+    "block",
+    "atomic_weight",
+    "covalent_radius",
+    "en_pauling",
+    "electron_affinity",
+    "atomic_volume",
+    "nvalence",
+    "ionenergy",
+)
+
+_BY_NUMBER = {v[0]: k for k, v in _ELEMENTS.items()}
+
+
+class Element:
+    """Property record for one element (mendeleev ``element()`` analog)."""
+
+    def __init__(self, symbol: str):
+        if symbol not in _ELEMENTS:
+            raise KeyError(f"element {symbol!r} not in embedded periodic table")
+        self.symbol = symbol
+        for name, value in zip(_FIELDS, _ELEMENTS[symbol]):
+            setattr(self, name, value)
+
+    def __repr__(self):
+        return f"Element({self.symbol}, Z={self.atomic_number})"
+
+
+def element(key) -> Element:
+    """Look up by symbol or atomic number."""
+    if isinstance(key, str):
+        return Element(key)
+    return Element(_BY_NUMBER[int(key)])
+
+
+def get_all_elements():
+    return [Element(s) for s in _ELEMENTS]
+
+
+def atomic_number(symbol: str) -> int:
+    return _ELEMENTS[symbol][0]
+
+
+def symbol_of(z: int) -> str:
+    return _BY_NUMBER[int(z)]
+
+
+def standard_valences(symbol: str):
+    """Allowed bonding valences for implicit-hydrogen filling (organic
+    subset), lowest first — the rule rdkit applies for SMILES atoms outside
+    brackets."""
+    table: Dict[str, tuple] = {
+        "B": (3,),
+        "C": (4,),
+        "N": (3, 5),
+        "O": (2,),
+        "P": (3, 5),
+        "S": (2, 4, 6),
+        "F": (1,),
+        "Cl": (1,),
+        "Br": (1,),
+        "I": (1,),
+        "H": (1,),
+    }
+    return table.get(symbol, ())

@@ -1,0 +1,326 @@
+"""SMILES -> GraphData featurization.
+
+Parity with ``hydragnn/utils/smiles_utils.py:35-121``: node features are
+[one-hot atom type | atomic number, aromaticity, SP, SP2, SP3, #bonded-H],
+edge features a 4-way one-hot over {single, double, triple, aromatic}, both
+directions per bond, edges sorted by ``src*N+dst``; hydrogens are added as
+explicit atoms (rdkit ``AddHs`` analog).
+
+Backends: rdkit when importable; otherwise a built-in minimal SMILES parser
+(organic subset, branches, ring closures incl. ``%nn``, bracket atoms with
+explicit H/charge, aromatic lowercase atoms) so SMILES workloads (CSCE/OGB
+band-gap) run in this image, which has no rdkit. The fallback approximates
+rdkit on default bonds between aromatic atoms (aromatic only when the bond
+lies on a cycle) and on hybridization flags (triple/cumulated -> SP,
+double/aromatic -> SP2, else SP3 for heavy atoms).
+"""
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.utils.periodic_table import atomic_number, standard_valences
+
+try:
+    from rdkit import Chem  # noqa: F401
+
+    _HAVE_RDKIT = True
+except ImportError:
+    _HAVE_RDKIT = False
+
+# bond-type one-hot layout (reference ``smiles_utils.py:51``)
+_BOND_TYPES = {"single": 0, "double": 1, "triple": 2, "aromatic": 3}
+
+_ORGANIC = ["Cl", "Br", "B", "C", "N", "O", "P", "S", "F", "I"]
+_AROMATIC = {"b": "B", "c": "C", "n": "N", "o": "O", "p": "P", "s": "S"}
+_BRACKET_RE = re.compile(
+    r"\[(?P<isotope>\d+)?(?P<symbol>[A-Z][a-z]?|[bcnops])"
+    r"(?P<chiral>@{1,2})?(?P<hcount>H\d*)?(?P<charge>[+-]\d*|[+]+|[-]+)?"
+    r"(?::\d+)?\]"
+)
+
+
+class _Atom:
+    def __init__(self, symbol, aromatic, explicit_h=None):
+        self.symbol = symbol
+        self.aromatic = aromatic
+        self.explicit_h = explicit_h  # None => implicit by valence
+
+
+def _parse_smiles(smiles: str) -> Tuple[List[_Atom], List[Tuple[int, int, str]]]:
+    """Minimal SMILES parser: atoms + bonds with order labels."""
+    atoms: List[_Atom] = []
+    bonds: List[Tuple[int, int, str]] = []
+    stack: List[int] = []
+    ring: Dict[int, Tuple[int, Optional[str]]] = {}
+    prev: Optional[int] = None
+    pending_bond: Optional[str] = None
+    bond_symbols = {"-": "single", "=": "double", "#": "triple", ":": "aromatic",
+                    "/": "single", "\\": "single"}
+
+    def add_bond(a: int, b: int, symbol: Optional[str]):
+        if symbol is not None:
+            order = symbol
+        elif atoms[a].aromatic and atoms[b].aromatic:
+            order = "aromatic?"  # provisional: demoted later if not on a cycle
+        else:
+            order = "single"
+        bonds.append((a, b, order))
+
+    i = 0
+    while i < len(smiles):
+        ch = smiles[i]
+        if ch in bond_symbols:
+            pending_bond = bond_symbols[ch]
+            i += 1
+            continue
+        if ch == "(":
+            stack.append(prev)
+            i += 1
+            continue
+        if ch == ")":
+            prev = stack.pop()
+            i += 1
+            continue
+        if ch == ".":
+            prev = None
+            pending_bond = None
+            i += 1
+            continue
+        if ch.isdigit() or ch == "%":
+            if ch == "%":
+                num = int(smiles[i + 1 : i + 3])
+                i += 3
+            else:
+                num = int(ch)
+                i += 1
+            if num in ring:
+                other, sym = ring.pop(num)
+                add_bond(other, prev, pending_bond or sym)
+            else:
+                ring[num] = (prev, pending_bond)
+            pending_bond = None
+            continue
+        if ch == "[":
+            m = _BRACKET_RE.match(smiles, i)
+            if not m:
+                raise ValueError(f"bad bracket atom in {smiles!r} at {i}")
+            sym = m.group("symbol")
+            aromatic = sym in _AROMATIC
+            if aromatic:
+                sym = _AROMATIC[sym]
+            h = m.group("hcount")
+            explicit_h = 0 if h is None else (1 if h == "H" else int(h[1:]))
+            atoms.append(_Atom(sym, aromatic, explicit_h=explicit_h))
+            idx = len(atoms) - 1
+            if prev is not None:
+                add_bond(prev, idx, pending_bond)
+            pending_bond = None
+            prev = idx
+            i = m.end()
+            continue
+        matched = None
+        for sym in _ORGANIC:
+            if smiles.startswith(sym, i):
+                matched = sym
+                break
+        if matched is not None:
+            atoms.append(_Atom(matched, aromatic=False))
+        elif ch in _AROMATIC:
+            atoms.append(_Atom(_AROMATIC[ch], aromatic=True))
+        else:
+            raise ValueError(f"unsupported SMILES token {ch!r} in {smiles!r}")
+        idx = len(atoms) - 1
+        if prev is not None:
+            add_bond(prev, idx, pending_bond)
+        pending_bond = None
+        prev = idx
+        i += len(matched) if matched is not None else 1
+    if ring:
+        raise ValueError(f"unclosed ring bond(s) {sorted(ring)} in {smiles!r}")
+
+    # demote provisional aromatic bonds that are not on any cycle (biphenyl
+    # single bond between two aromatic atoms)
+    def on_cycle(bi):
+        a, b, _ = bonds[bi]
+        adj: Dict[int, List[int]] = {}
+        for j, (u, v, _o) in enumerate(bonds):
+            if j == bi:
+                continue
+            adj.setdefault(u, []).append(v)
+            adj.setdefault(v, []).append(u)
+        seen, frontier = {a}, [a]
+        while frontier:
+            u = frontier.pop()
+            for v in adj.get(u, ()):  # reachable without this bond?
+                if v == b:
+                    return True
+                if v not in seen:
+                    seen.add(v)
+                    frontier.append(v)
+        return False
+
+    bonds = [
+        (a, b, ("aromatic" if on_cycle(j) else "single") if o == "aromatic?" else o)
+        for j, (a, b, o) in enumerate(bonds)
+    ]
+    return atoms, bonds
+
+
+_ORDER_VALUE = {"single": 1.0, "double": 2.0, "triple": 3.0, "aromatic": 1.5}
+
+
+def _mol_from_smiles_builtin(smiles: str):
+    """(symbols, aromatic, sp, sp2, sp3, bonds) with hydrogens explicit."""
+    atoms, bonds = _parse_smiles(smiles)
+    n_heavy = len(atoms)
+    order_sum = [0.0] * n_heavy
+    for a, b, o in bonds:
+        order_sum[a] += _ORDER_VALUE[o]
+        order_sum[b] += _ORDER_VALUE[o]
+
+    symbols = [a.symbol for a in atoms]
+    aromatic = [a.aromatic for a in atoms]
+    all_bonds = list(bonds)
+    for idx, atom in enumerate(atoms):
+        if atom.explicit_h is not None:
+            nh = atom.explicit_h
+        else:
+            need = math.ceil(order_sum[idx] - 1e-6)
+            nh = 0
+            for v in standard_valences(atom.symbol):
+                if v >= need:
+                    nh = v - need
+                    break
+        for _ in range(nh):
+            symbols.append("H")
+            aromatic.append(False)
+            all_bonds.append((idx, len(symbols) - 1, "single"))
+
+    n = len(symbols)
+    has_triple = [False] * n
+    n_double = [0] * n
+    for a, b, o in all_bonds:
+        if o == "triple":
+            has_triple[a] = has_triple[b] = True
+        if o == "double":
+            n_double[a] += 1
+            n_double[b] += 1
+    sp = [has_triple[i] or n_double[i] >= 2 for i in range(n)]
+    sp2 = [
+        not sp[i] and (n_double[i] == 1 or aromatic[i]) and symbols[i] != "H"
+        for i in range(n)
+    ]
+    sp3 = [
+        symbols[i] != "H" and not sp[i] and not sp2[i] for i in range(n)
+    ]
+    return symbols, aromatic, sp, sp2, sp3, all_bonds
+
+
+def _mol_from_smiles_rdkit(smiles: str):
+    from rdkit import Chem
+    from rdkit.Chem.rdchem import BondType as BT
+    from rdkit.Chem.rdchem import HybridizationType
+
+    ps = Chem.SmilesParserParams()
+    ps.removeHs = False
+    mol = Chem.AddHs(Chem.MolFromSmiles(smiles, ps))
+    bt_names = {BT.SINGLE: "single", BT.DOUBLE: "double",
+                BT.TRIPLE: "triple", BT.AROMATIC: "aromatic"}
+    symbols, aromatic, sp, sp2, sp3 = [], [], [], [], []
+    for atom in mol.GetAtoms():
+        symbols.append(atom.GetSymbol())
+        aromatic.append(atom.GetIsAromatic())
+        h = atom.GetHybridization()
+        sp.append(h == HybridizationType.SP)
+        sp2.append(h == HybridizationType.SP2)
+        sp3.append(h == HybridizationType.SP3)
+    bonds = [
+        (b.GetBeginAtomIdx(), b.GetEndAtomIdx(), bt_names[b.GetBondType()])
+        for b in mol.GetBonds()
+    ]
+    return symbols, aromatic, sp, sp2, sp3, bonds
+
+
+def get_node_attribute_name(types: Dict[str, int]):
+    """(names, dims) of the generated node features (``smiles_utils.py:18-32``)."""
+    names = ["atom" + k for k in types] + [
+        "atomicnumber",
+        "IsAromatic",
+        "HSP",
+        "HSP2",
+        "HSP3",
+        "Hprop",
+    ]
+    return names, [1] * len(names)
+
+
+def generate_graphdata_from_smilestr(
+    smilestr: str,
+    ytarget,
+    types: Dict[str, int],
+    var_config: Optional[dict] = None,
+) -> GraphData:
+    """Build a featurized molecular graph from a SMILES string.
+
+    ``types`` maps atom symbol -> one-hot slot (must include ``"H"`` since
+    hydrogens become explicit nodes).
+    """
+    if _HAVE_RDKIT:
+        symbols, aromatic, sp, sp2, sp3, bonds = _mol_from_smiles_rdkit(smilestr)
+    else:
+        symbols, aromatic, sp, sp2, sp3, bonds = _mol_from_smiles_builtin(smilestr)
+
+    n = len(symbols)
+    z = np.asarray([atomic_number(s) for s in symbols], dtype=np.int64)
+    row, col, etype = [], [], []
+    for a, b, o in bonds:
+        row += [a, b]
+        col += [b, a]
+        etype += 2 * [_BOND_TYPES[o]]
+    edge_index = np.asarray([row, col], dtype=np.int64)
+    etype = np.asarray(etype, dtype=np.int64)
+    perm = np.argsort(edge_index[0] * n + edge_index[1], kind="stable")
+    edge_index = edge_index[:, perm]
+    edge_attr = np.zeros((etype.shape[0], len(_BOND_TYPES)), dtype=np.float32)
+    edge_attr[np.arange(etype.shape[0]), etype[perm]] = 1.0
+
+    num_hs = np.zeros(n, dtype=np.float32)
+    np.add.at(num_hs, edge_index[1], (z == 1).astype(np.float32)[edge_index[0]])
+
+    x1 = np.zeros((n, len(types)), dtype=np.float32)
+    x1[np.arange(n), [types[s] for s in symbols]] = 1.0
+    x2 = np.stack(
+        [
+            z.astype(np.float32),
+            np.asarray(aromatic, dtype=np.float32),
+            np.asarray(sp, dtype=np.float32),
+            np.asarray(sp2, dtype=np.float32),
+            np.asarray(sp3, dtype=np.float32),
+            num_hs,
+        ],
+        axis=1,
+    )
+    x = np.concatenate([x1, x2], axis=1)
+
+    data = GraphData(
+        x=x,
+        pos=np.zeros((n, 3), dtype=np.float32),
+        y=np.asarray(ytarget, dtype=np.float32).reshape(-1),
+        edge_index=edge_index,
+        edge_attr=edge_attr,
+    )
+    if var_config is not None:
+        from hydragnn_tpu.data.serialized import extract_targets
+
+        extract_targets(
+            var_config["type"],
+            var_config["output_index"],
+            var_config["graph_feature_dims"],
+            var_config["input_node_feature_dims"],
+            data,
+        )
+    return data

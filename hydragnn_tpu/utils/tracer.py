@@ -169,7 +169,10 @@ def save(prefix: str = "./logs/trace"):
     from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
     _, rank = get_comm_size_and_rank()
-    for t in _tracers.values():
-        t.pr_file(f"{prefix}.{rank}")
+    for name, t in _tracers.items():
+        # with several file-writing backends registered, each gets its own
+        # file so one dump cannot clobber another
+        tag = f".{name}" if len(_tracers) > 1 else ""
+        t.pr_file(f"{prefix}{tag}.{rank}")
         if hasattr(t, "chrome_trace"):
-            t.chrome_trace(f"{prefix}.{rank}.trace.json", pid=rank)
+            t.chrome_trace(f"{prefix}{tag}.{rank}.trace.json", pid=rank)

@@ -1,0 +1,117 @@
+"""Atomic descriptors + SMILES featurization.
+
+Mirrors ``tests/test_atomicdescriptors.py`` in the reference plus structural
+checks of the SMILES graph builder (``hydragnn/utils/smiles_utils.py``) on
+molecules with known composition.
+"""
+
+import numpy as np
+
+from hydragnn_tpu.utils.atomicdescriptors import atomicdescriptors
+from hydragnn_tpu.utils.smiles import (
+    generate_graphdata_from_smilestr,
+    get_node_attribute_name,
+)
+
+TYPES = {"C": 0, "H": 1, "O": 2, "N": 3, "F": 4, "S": 5}
+
+
+def _counts(data):
+    z = data.x[:, len(TYPES)].astype(int)
+    return {el: int((z == n).sum()) for el, n in
+            [("H", 1), ("C", 6), ("N", 7), ("O", 8)]}
+
+
+def pytest_atomicdescriptors(tmp_path):
+    desc = atomicdescriptors(
+        str(tmp_path / "emb.json"), element_types=["C", "H", "S"]
+    )
+    f = desc.get_atom_features("C")
+    # 3 type one-hot + 10 scalar properties + 4 block one-hot
+    assert f.shape == (17,)
+    assert np.isfinite(f).all()
+    assert desc.get_atom_features(16).shape == (17,)  # lookup by Z
+
+    # cached file is reused verbatim when not overwritten
+    desc2 = atomicdescriptors(str(tmp_path / "emb.json"), overwritten=False)
+    assert np.allclose(desc2.get_atom_features("H"), desc.get_atom_features("H"))
+
+
+def pytest_atomicdescriptors_onehot(tmp_path):
+    desc = atomicdescriptors(
+        str(tmp_path / "emb1h.json"), element_types=["C", "H", "S"], one_hot=True
+    )
+    f = desc.get_atom_features("S")
+    assert set(np.unique(f)).issubset({0.0, 1.0})
+
+
+def pytest_node_attribute_names():
+    names, dims = get_node_attribute_name(TYPES)
+    assert names[: len(TYPES)] == ["atomC", "atomH", "atomO", "atomN", "atomF",
+                                   "atomS"]
+    assert names[-1] == "Hprop"
+    assert dims == [1] * (len(TYPES) + 6)
+
+
+def pytest_smiles_methane():
+    data = generate_graphdata_from_smilestr("C", [0.5], TYPES)
+    assert data.num_nodes == 5  # C + 4 explicit H
+    assert data.num_edges == 8  # 4 bonds, both directions
+    c = _counts(data)
+    assert c["C"] == 1 and c["H"] == 4
+    off = len(TYPES)
+    carbon = data.x[data.x[:, off] == 6][0]
+    assert carbon[off + 4] == 1.0  # SP3
+    assert carbon[off + 5] == 4.0  # bonded hydrogens
+
+
+def pytest_smiles_ethene_bonds():
+    data = generate_graphdata_from_smilestr("C=C", [1.0], TYPES)
+    c = _counts(data)
+    assert c["C"] == 2 and c["H"] == 4
+    off = len(TYPES)
+    carbons = data.x[data.x[:, off] == 6]
+    assert (carbons[:, off + 3] == 1.0).all()  # SP2
+    # one double bond -> exactly 2 directed edges one-hot at slot "double"
+    assert int(data.edge_attr[:, 1].sum()) == 2
+
+
+def pytest_smiles_benzene_aromatic():
+    data = generate_graphdata_from_smilestr("c1ccccc1", [0.0], TYPES)
+    c = _counts(data)
+    assert c["C"] == 6 and c["H"] == 6
+    off = len(TYPES)
+    carbons = data.x[data.x[:, off] == 6]
+    assert (carbons[:, off + 1] == 1.0).all()  # aromatic flag
+    assert (carbons[:, off + 5] == 1.0).all()  # 1 H each
+    assert int(data.edge_attr[:, 3].sum()) == 12  # 6 aromatic ring bonds
+
+
+def pytest_smiles_pyrrole_bracket_h():
+    data = generate_graphdata_from_smilestr("c1cc[nH]c1", [0.0], TYPES)
+    c = _counts(data)
+    assert c["C"] == 4 and c["N"] == 1 and c["H"] == 5
+
+
+def pytest_smiles_branches_rings():
+    # acetic acid: branch + double bond + hydroxyl
+    data = generate_graphdata_from_smilestr("CC(=O)O", [0.0], TYPES)
+    c = _counts(data)
+    assert c["C"] == 2 and c["O"] == 2 and c["H"] == 4
+    # biphenyl: the inter-ring default bond between aromatic atoms must be
+    # SINGLE (not on an aromatic cycle)
+    data = generate_graphdata_from_smilestr("c1ccc(c2ccccc2)cc1", [0.0], TYPES)
+    assert int(data.edge_attr[:, 3].sum()) == 24  # 12 ring bonds
+    assert _counts(data)["H"] == 10
+
+
+def pytest_smiles_var_config_targets():
+    var_config = {
+        "type": ["graph"],
+        "output_index": [0],
+        "graph_feature_dims": [1],
+        "input_node_feature_dims": [1] * (len(TYPES) + 6),
+    }
+    data = generate_graphdata_from_smilestr("CCO", [2.5], TYPES, var_config)
+    assert len(data.targets) == 1
+    assert np.allclose(data.targets[0], [2.5])
