@@ -1,0 +1,182 @@
+"""Shared glue for the example workloads.
+
+Every reference example follows one shape (``examples/md17/md17.py:36-105``):
+load the JSON config next to the script, build/load a dataset, split it,
+make loaders, derive config fields from the data, build the model, train,
+save. This module is that shape for the TPU framework so each example stays
+focused on its dataset.
+
+All examples run OFFLINE: this environment has no network egress, so each
+example ships a deterministic synthetic generator producing data in the same
+schema as the real workload (drop real data in the same directory layout to
+use it instead). Generators are seeded — reruns are reproducible.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+# examples run from a checkout without installation: repo root on the path
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import hydragnn_tpu
+from hydragnn_tpu.data import create_dataloaders, split_dataset
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.parallel.distributed import setup_distributed
+from hydragnn_tpu.parallel.mesh import default_mesh
+from hydragnn_tpu.train import Trainer, save_model, train_validate_test
+from hydragnn_tpu.utils import print_utils
+from hydragnn_tpu.utils.config import save_config, update_config
+
+
+def load_config(example_file: str, name: str) -> dict:
+    with open(os.path.join(os.path.dirname(os.path.abspath(example_file)), name)) as f:
+        return apply_cli_overrides(json.load(f))
+
+
+def apply_cli_overrides(config: dict) -> dict:
+    """Map hyperparameter CLI flags into the config — the flag set the
+    reference's HPO trial launcher passes to its training scripts
+    (``gfm_deephyper_multi.py:70-80``), so ``TrialLauncher`` works against
+    any example unchanged."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"]["Training"]
+    v = example_arg("model_type")
+    if v:
+        arch["model_type"] = v
+    for key in ("hidden_dim", "num_conv_layers"):
+        v = example_arg(key)
+        if v is not None:
+            arch[key] = int(v)
+    num_headlayers = example_arg("num_headlayers")
+    dim_headlayers = example_arg("dim_headlayers")
+    if num_headlayers is not None or dim_headlayers is not None:
+        for head in arch["output_heads"].values():
+            if num_headlayers is not None:
+                head["num_headlayers"] = int(num_headlayers)
+            n = int(num_headlayers or head["num_headlayers"])
+            if dim_headlayers is not None:
+                head["dim_headlayers"] = [int(dim_headlayers)] * n
+            elif len(head["dim_headlayers"]) != n:
+                head["dim_headlayers"] = [head["dim_headlayers"][0]] * n
+    v = example_arg("learning_rate")
+    if v is not None:
+        training["Optimizer"]["learning_rate"] = float(v)
+    for key in ("num_epoch", "batch_size"):
+        v = example_arg(key)
+        if v is not None:
+            training[key] = int(v)
+    return config
+
+
+def example_arg(flag: str, default=None):
+    """Tiny argv reader for ``--key=value`` flags (examples use a handful)."""
+    prefix = f"--{flag}="
+    for a in sys.argv[1:]:
+        if a == f"--{flag}":
+            return True
+        if a.startswith(prefix):
+            return a[len(prefix):]
+    return default
+
+
+def train_example(config: dict, dataset, log_name: str, seed: int = 0):
+    """Split -> loaders -> derived config -> model -> train -> save.
+
+    Returns (state, trainer, val_loss). Prints ``Val Loss: <x>`` at the end —
+    the HPO launcher greps exactly that (the reference's DeepHyper trial
+    parser, ``gfm_deephyper_multi.py:34-40``).
+    """
+    setup_distributed()
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+    suffix = example_arg("log_name_suffix")
+    if suffix:
+        log_name = f"{log_name}_{suffix}"
+    print_utils.setup_log(log_name)
+
+    training = config["NeuralNetwork"]["Training"]
+    trainset, valset, testset = split_dataset(
+        dataset, training["perc_train"], False
+    )
+    need_triplets = (
+        config["NeuralNetwork"]["Architecture"].get("model_type") == "DimeNet"
+    )
+    train_loader, val_loader, test_loader = create_dataloaders(
+        trainset, valset, testset, training["batch_size"], need_triplets
+    )
+    config = update_config(config, train_loader, val_loader, test_loader)
+    save_config(config, log_name)
+
+    arch = dict(config["NeuralNetwork"]["Architecture"])
+    arch["loss_function_type"] = training.get("loss_function_type", "mse")
+    arch["conv_checkpointing"] = training.get("conv_checkpointing", False)
+    model = create_model_config(arch, verbosity)
+    trainer = Trainer(model, training, mesh=default_mesh(), verbosity=verbosity)
+    state = trainer.init_state(next(iter(train_loader)), seed=seed)
+
+    state = train_validate_test(
+        trainer,
+        state,
+        train_loader,
+        val_loader,
+        test_loader,
+        config["NeuralNetwork"],
+        log_name,
+        verbosity,
+    )
+    save_model(state, log_name)
+    val_loss, _ = trainer.evaluate(state, val_loader)
+    print(f"Val Loss: {val_loss}")
+    return state, trainer, float(val_loss)
+
+
+# ---------------------------------------------------------------------------
+# Synthetic molecule/crystal builders shared by several examples.
+# ---------------------------------------------------------------------------
+
+def random_molecule(rng, elements, n_atoms, spread=1.5):
+    """Random cloud molecule: atomic numbers z and jittered positions with a
+    minimum-distance relaxation so radius graphs are well conditioned."""
+    z = rng.choice(elements, size=n_atoms)
+    pos = rng.normal(0.0, spread, (n_atoms, 3))
+    for _ in range(10):  # push overlapping atoms apart
+        d = pos[:, None, :] - pos[None, :, :]
+        dist = np.linalg.norm(d, axis=-1) + np.eye(n_atoms)
+        push = (dist < 0.8) & ~np.eye(n_atoms, dtype=bool)
+        if not push.any():
+            break
+        pos += 0.25 * (d / dist[..., None] * push[..., None]).sum(axis=1)
+    return z.astype(np.float32), pos.astype(np.float32)
+
+
+def molecule_graph(z, pos, radius, max_neighbours=None, targets=(),
+                   target_types=()):
+    """GraphData with radius-graph edges and per-head targets."""
+    from hydragnn_tpu.data import GraphData, radius_graph
+
+    d = GraphData(
+        x=np.asarray(z, np.float32).reshape(-1, 1),
+        pos=np.asarray(pos, np.float32),
+    )
+    d.edge_index = radius_graph(
+        d.pos, radius, max_neighbours if max_neighbours else 32
+    )
+    d.targets = [np.asarray(t, np.float32) for t in targets]
+    d.target_types = list(target_types)
+    return d
+
+
+def pairwise_energy(z, pos, cutoff=3.0):
+    """Deterministic smooth 'potential': element-weighted pair interaction
+    within a cutoff. Learnable from (z, pos); plays the role of a real label."""
+    zz = np.asarray(z, np.float64)
+    d = np.linalg.norm(pos[:, None, :] - pos[None, :, :], axis=-1)
+    n = len(zz)
+    mask = (d < cutoff) & ~np.eye(n, dtype=bool)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        contrib = np.where(mask, np.sqrt(zz[:, None] * zz[None, :]) / (d + 1.0), 0.0)
+    return float(contrib.sum() / (2 * n))

@@ -1,0 +1,92 @@
+"""EAM workload: NiNb alloy supercells in extended-CFG format, formation
+energy prediction.
+
+Mirrors ``examples/eam/eam.py``: AtomEye ``.cfg`` files (H0 supercell,
+scaled coordinates, mass/symbol lines) with graph features in the sibling
+``.bulk`` file, driven through ``run_training`` with format "CFG".
+
+Offline data: FCC NiNb solid solutions; formation energy is an
+EAM-flavoured embedding function of local coordination.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from common import example_arg, load_config
+
+import hydragnn_tpu
+
+NI, NB = 28, 41
+ALAT = 3.52
+
+
+def _fcc_positions(cells):
+    basis = np.array(
+        [[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]], np.float64
+    )
+    pos = []
+    for x in range(cells):
+        for y in range(cells):
+            for z in range(cells):
+                for b in basis:
+                    pos.append((np.array([x, y, z]) + b))
+    return np.asarray(pos) / cells  # scaled coordinates in [0,1)
+
+
+def _eam_energy(z, scaled, cell):
+    """Embedded-atom flavour: E = sum_i F(rho_i), rho from neighbor density."""
+    pos = scaled @ cell
+    d = np.linalg.norm(pos[:, None] - pos[None, :], axis=-1)
+    np.fill_diagonal(d, np.inf)
+    w = np.where(z == NI, 1.0, 1.6)  # Nb contributes more electron density
+    rho = (np.exp(-d / 2.5) * w[None, :]).sum(1)
+    return float((-np.sqrt(rho) + 0.05 * rho).sum() / len(z))
+
+
+def write_cfg_dataset(path, num_configs, cells=2, seed=0):
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    scaled = _fcc_positions(cells)
+    n = len(scaled)
+    cell = np.eye(3) * ALAT * cells
+    for c in range(num_configs):
+        z = np.where(rng.random(n) < rng.uniform(0.3, 0.9), NI, NB)
+        jitter = scaled + rng.normal(0, 0.004, scaled.shape)
+        energy = _eam_energy(z, jitter, cell)
+        lines = [f"Number of particles = {n}", "A = 1.0 Angstrom"]
+        for i in range(3):
+            for j in range(3):
+                lines.append(f"H0({i+1},{j+1}) = {cell[i, j]:.6f} A")
+        lines += [".NO_VELOCITY.", "entry_count = 3"]
+        for i in np.argsort(z):  # group by species for mass/symbol blocks
+            sym = "Ni" if z[i] == NI else "Nb"
+            mass = "58.693" if z[i] == NI else "92.906"
+            lines.append(mass)
+            lines.append(sym)
+            lines.append(
+                f"{jitter[i,0]:.6f} {jitter[i,1]:.6f} {jitter[i,2]:.6f}"
+            )
+        base = os.path.join(path, f"config{c}")
+        with open(base + ".cfg", "w") as f:
+            f.write("\n".join(lines))
+        with open(base + ".bulk", "w") as f:
+            f.write(f"{energy:.8f}\n")
+
+
+def main():
+    config = load_config(
+        __file__, str(example_arg("config", "NiNb_EAM_energy.json"))
+    )
+    os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
+    raw_path = config["Dataset"]["path"]["total"]
+    num_configs = int(example_arg("num_samples", 300))
+    if not os.path.exists(raw_path) or not os.listdir(raw_path):
+        write_cfg_dataset(raw_path, num_configs)
+    hydragnn_tpu.run_training(config)
+
+
+if __name__ == "__main__":
+    main()

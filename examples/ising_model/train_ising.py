@@ -1,0 +1,29 @@
+"""Ising workload: predict total energy of 3D spin lattices.
+
+Mirrors ``examples/ising_model/train_ising.py``: generated configurations
+are written as raw text, converted through the serialized-pkl pipeline, and
+trained through the full ``run_training`` path.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from common import example_arg, load_config
+from create_configurations import create_dataset
+
+import hydragnn_tpu
+
+
+def main():
+    config = load_config(__file__, "ising_model.json")
+    os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
+    raw_path = config["Dataset"]["path"]["total"]
+    num_configs = int(example_arg("num_samples", 400))
+    if not os.path.exists(raw_path) or not os.listdir(raw_path):
+        create_dataset(raw_path, num_configs)
+    hydragnn_tpu.run_training(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -4,9 +4,11 @@ Parity with ``hydragnn/preprocess/cfg_raw_dataset_loader.py:26-107``, but
 parsed directly (no ase dependency): reads particle count, H0 supercell
 matrix, and per-atom rows (mass / symbol lines followed by scaled
 coordinates + auxiliary columns). Positions are unscaled via the H0 cell;
-graph features come from the filename-adjacent ``.txt`` convention or the
-aux columns per config.
+graph features come from the first line of the sibling ``.bulk`` file
+(``cfg_raw_dataset_loader.py:92-100``), zeros when absent.
 """
+
+import os
 
 import numpy as np
 
@@ -79,10 +81,24 @@ class CFGDataset(AbstractRawDataset):
                 node_features.append(full[:, col])
         x = np.stack(node_features, axis=1) if node_features else z
 
+        # graph features live in a sibling ".bulk" file, first line
+        # (``cfg_raw_dataset_loader.py:92-100``)
+        y = np.zeros((sum(self.graph_feature_dim),), dtype=np.float32)
+        bulk = os.path.splitext(filepath)[0] + ".bulk"
+        if os.path.exists(bulk):
+            with open(bulk, "r", encoding="utf-8") as f:
+                graph_feat = f.readline().split()
+            vals = []
+            for item in range(len(self.graph_feature_dim)):
+                for icomp in range(self.graph_feature_dim[item]):
+                    col = self.graph_feature_col[item] + icomp
+                    vals.append(float(graph_feat[col]))
+            y = np.asarray(vals, dtype=np.float32)
+
         data = GraphData(
             x=x.astype(np.float32),
             pos=pos,
-            y=np.zeros((sum(self.graph_feature_dim),), dtype=np.float32),
+            y=y,
             supercell_size=cell,
         )
         return data

@@ -1,0 +1,15 @@
+"""Hyperparameter optimization (DeepHyper/Optuna analog).
+
+The reference drives HPO through DeepHyper CBO and Optuna
+(``examples/qm9_hpo/qm9_deephyper.py:29-120``, ``qm9_optuna.py``,
+``examples/multidataset_hpo/gfm_deephyper_multi.py:22-70``). Neither package
+is available in this image, so the same API surface is implemented natively:
+an Optuna-style ``Study``/``Trial`` with random and TPE samplers plus a
+median pruner, and a multi-node trial launcher that runs each trial as a
+subprocess (srun or plain python) and parses the validation loss from its
+output — the reference's launch pattern. If ``optuna`` is importable its
+study can be used instead; nothing here requires it.
+"""
+
+from hydragnn_tpu.hpo.search import Study, Trial, TrialPruned, create_study
+from hydragnn_tpu.hpo.launcher import TrialLauncher, parse_val_loss

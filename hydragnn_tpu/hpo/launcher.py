@@ -1,0 +1,99 @@
+"""Multi-node trial launcher: one training subprocess per trial.
+
+Parity with the reference's DeepHyper multi-node pattern
+(``examples/multidataset_hpo/gfm_deephyper_multi.py:22-70``): trial geometry
+comes from environment variables, each trial launches an ``srun`` (or plain
+``python`` when no scheduler is present) subprocess with hyperparameters as
+CLI flags, and the trial metric is the last ``Val Loss: <x>`` printed by the
+training script. On TPU pods the launch prefix targets TPU-VM hosts instead
+of GPUs-per-node, but the orchestration shape is identical.
+"""
+
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+_VAL_LOSS_RE = re.compile(r"Val Loss: ([-+]?(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][-+]?\d+)?)")
+
+
+def parse_val_loss(output: str) -> Optional[float]:
+    """Last validation loss a training subprocess printed, or None."""
+    matches = _VAL_LOSS_RE.findall(output)
+    return float(matches[-1]) if matches else None
+
+
+class TrialLauncher:
+    """Builds and runs per-trial training commands.
+
+    Geometry (all optional, env-driven like the reference):
+      ``HPO_NNODES_PER_TRIAL``  nodes per trial (srun -N)
+      ``HPO_NRANKS_PER_TRIAL``  processes per trial (srun -n)
+      ``HPO_LOG_DIR``           where per-trial stdout/stderr land
+    ``use_srun`` defaults to auto-detection via ``SLURM_JOB_ID``.
+    """
+
+    def __init__(
+        self,
+        script: str,
+        log_dir: Optional[str] = None,
+        use_srun: Optional[bool] = None,
+        base_env: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
+    ):
+        self.script = script
+        self.log_dir = log_dir or os.environ.get("HPO_LOG_DIR", "./logs/hpo")
+        self.nnodes = int(os.environ.get("HPO_NNODES_PER_TRIAL", "1"))
+        self.nranks = int(os.environ.get("HPO_NRANKS_PER_TRIAL", "1"))
+        self.use_srun = (
+            use_srun
+            if use_srun is not None
+            else "SLURM_JOB_ID" in os.environ
+        )
+        self.base_env = dict(base_env or {})
+        self.timeout = timeout
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def build_command(self, trial_id: int, params: Dict[str, object],
+                      nodelist: Optional[List[str]] = None) -> List[str]:
+        cmd: List[str] = []
+        if self.use_srun:
+            cmd += ["srun", "-N", str(self.nnodes), "-n", str(self.nranks)]
+            if nodelist:
+                cmd += [f"--nodelist={','.join(nodelist)}"]
+        cmd += [sys.executable, "-u", self.script]
+        for k, v in params.items():
+            cmd.append(f"--{k}={v}")
+        cmd.append(f"--log_name_suffix=trial_{trial_id}")
+        return cmd
+
+    def run(self, trial, nodelist: Optional[List[str]] = None) -> float:
+        """Launch the trial subprocess; returns val loss (inf on failure).
+
+        The reference returns the string "F" for a failed trial and lets
+        DeepHyper discard it; here failures map to +inf so a minimize-study
+        never selects them.
+        """
+        cmd = self.build_command(trial.number, trial.params, nodelist)
+        env = {**os.environ, **self.base_env}
+        out_path = os.path.join(self.log_dir, f"output_{trial.number}.txt")
+        with open(out_path, "w") as out:
+            try:
+                proc = subprocess.run(
+                    cmd,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                    timeout=self.timeout,
+                )
+            except subprocess.TimeoutExpired as e:
+                out.write((e.output or b"").decode(errors="replace"))
+                out.write("\n[launcher] trial timed out\n")
+                return float("inf")
+            text = proc.stdout.decode(errors="replace")
+            out.write(text)
+        if proc.returncode != 0:
+            return float("inf")
+        val = parse_val_loss(text)
+        return float("inf") if val is None else val

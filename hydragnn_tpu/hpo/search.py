@@ -1,0 +1,248 @@
+"""Native search engine: Optuna-style Study/Trial, random + TPE samplers,
+median pruner.
+
+API parity with the subset of Optuna the reference uses
+(``examples/qm9_hpo/qm9_optuna.py``): ``create_study`` →
+``study.optimize(objective, n_trials)`` → ``study.best_trial`` /
+``best_params`` / ``best_value``; inside the objective,
+``trial.suggest_float/suggest_int/suggest_categorical`` and
+``trial.report(value, step)`` + ``trial.should_prune()``.
+
+The TPE sampler is the standard tree-structured Parzen estimator recipe:
+after ``n_startup`` random trials, observations are split into the top
+``gamma`` fraction ("good") and the rest; candidates are drawn from a
+Gaussian KDE over the good values and ranked by the good/bad density ratio.
+Parameters are treated independently (univariate TPE), which is what Optuna
+does by default.
+"""
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class TrialPruned(Exception):
+    """Raised by an objective to abandon a trial early (Optuna analog)."""
+
+
+class _ParamSpec:
+    def __init__(self, kind, low=None, high=None, log=False, choices=None):
+        self.kind = kind  # "float" | "int" | "cat"
+        self.low = low
+        self.high = high
+        self.log = log
+        self.choices = choices
+
+    def key(self):
+        return (self.kind, self.low, self.high, self.log,
+                tuple(self.choices) if self.choices else None)
+
+
+class Trial:
+    def __init__(self, study: "Study", number: int):
+        self.study = study
+        self.number = number
+        self.id = number  # DeepHyper-style alias used by the reference
+        self.params: Dict[str, Any] = {}
+        self.intermediate: Dict[int, float] = {}
+        self.value: Optional[float] = None
+        self.state = "running"  # running | complete | pruned | failed
+
+    # -- suggest API ------------------------------------------------------
+    def suggest_float(self, name, low, high, log=False):
+        return self._suggest(name, _ParamSpec("float", low, high, log))
+
+    def suggest_int(self, name, low, high, log=False):
+        return int(round(self._suggest(name, _ParamSpec("int", low, high, log))))
+
+    def suggest_categorical(self, name, choices):
+        return self._suggest(name, _ParamSpec("cat", choices=list(choices)))
+
+    def _suggest(self, name, spec):
+        if name in self.params:
+            return self.params[name]
+        value = self.study._sample(name, spec)
+        self.params[name] = value
+        return value
+
+    # -- pruning API ------------------------------------------------------
+    def report(self, value, step):
+        self.intermediate[int(step)] = float(value)
+
+    def should_prune(self) -> bool:
+        return self.study._should_prune(self)
+
+
+class Study:
+    def __init__(self, direction="minimize", sampler="tpe", seed=0,
+                 n_startup=10, gamma=0.25, n_candidates=24,
+                 pruner_warmup_trials=4, pruner_warmup_steps=1):
+        assert direction in ("minimize", "maximize")
+        assert sampler in ("random", "tpe")
+        self.direction = direction
+        self.sampler = sampler
+        self.rng = np.random.default_rng(seed)
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.pruner_warmup_trials = pruner_warmup_trials
+        self.pruner_warmup_steps = pruner_warmup_steps
+        self.trials: List[Trial] = []
+        self._specs: Dict[str, _ParamSpec] = {}
+
+    # -- public API -------------------------------------------------------
+    def ask(self) -> Trial:
+        t = Trial(self, len(self.trials))
+        self.trials.append(t)
+        return t
+
+    def tell(self, trial: Trial, value, state="complete"):
+        trial.state = state
+        if value is not None:
+            trial.value = float(value)
+        # a completed trial must carry a comparable value: diverged (NaN)
+        # or valueless objectives would otherwise poison best_trial
+        if state == "complete" and (
+            trial.value is None or math.isnan(trial.value)
+        ):
+            trial.state = "failed"
+
+    def optimize(self, objective, n_trials: int):
+        for _ in range(n_trials):
+            trial = self.ask()
+            try:
+                value = objective(trial)
+                self.tell(trial, value)
+            except TrialPruned:
+                self.tell(trial, None, state="pruned")
+            except Exception:
+                self.tell(trial, None, state="failed")
+                raise
+        return self.best_trial
+
+    @property
+    def completed(self) -> List[Trial]:
+        return [t for t in self.trials if t.state == "complete"]
+
+    @property
+    def best_trial(self) -> Optional[Trial]:
+        done = self.completed
+        if not done:
+            return None
+        keyfn = (lambda t: t.value) if self.direction == "minimize" else (
+            lambda t: -t.value
+        )
+        return min(done, key=keyfn)
+
+    @property
+    def best_value(self):
+        t = self.best_trial
+        return None if t is None else t.value
+
+    @property
+    def best_params(self):
+        t = self.best_trial
+        return None if t is None else dict(t.params)
+
+    # -- sampling ---------------------------------------------------------
+    def _sample(self, name, spec: _ParamSpec):
+        prev = self._specs.get(name)
+        if prev is not None and prev.key() != spec.key():
+            raise ValueError(f"parameter {name!r} redefined with a new space")
+        self._specs[name] = spec
+        history = [
+            (t.params[name], t.value)
+            for t in self.completed
+            if name in t.params and t.value is not None
+        ]
+        if (
+            self.sampler == "random"
+            or len(history) < self.n_startup
+            or spec.kind == "cat" and len(spec.choices) == 1
+        ):
+            return self._sample_random(spec)
+        return self._sample_tpe(spec, history)
+
+    def _sample_random(self, spec):
+        if spec.kind == "cat":
+            return spec.choices[int(self.rng.integers(len(spec.choices)))]
+        lo, hi = float(spec.low), float(spec.high)
+        if spec.log:
+            v = math.exp(self.rng.uniform(math.log(lo), math.log(hi)))
+        else:
+            v = self.rng.uniform(lo, hi)
+        return v if spec.kind == "float" else int(round(v))
+
+    def _split_good_bad(self, history):
+        vals = np.asarray([v for _, v in history], dtype=np.float64)
+        order = np.argsort(vals if self.direction == "minimize" else -vals)
+        n_good = max(1, int(math.ceil(self.gamma * len(history))))
+        good = [history[i][0] for i in order[:n_good]]
+        bad = [history[i][0] for i in order[n_good:]] or good
+        return good, bad
+
+    def _sample_tpe(self, spec, history):
+        good, bad = self._split_good_bad(history)
+        if spec.kind == "cat":
+            # weighted categorical: smoothed counts in good vs bad
+            def probs(obs):
+                counts = np.ones(len(spec.choices))
+                for o in obs:
+                    counts[spec.choices.index(o)] += 1
+                return counts / counts.sum()
+
+            ratio = probs(good) / probs(bad)
+            return spec.choices[int(np.argmax(ratio * self.rng.random(len(ratio))))]
+
+        def to_u(x):
+            return math.log(x) if spec.log else float(x)
+
+        lo_u, hi_u = to_u(spec.low), to_u(spec.high)
+        width = (hi_u - lo_u) or 1.0
+        good_u = np.asarray([to_u(g) for g in good])
+        bad_u = np.asarray([to_u(b) for b in bad])
+        # Parzen bandwidth ~ range / n^(1/1.2), floored to keep exploration
+        bw_g = max(width / max(len(good_u), 1) ** 0.83, 1e-3 * width)
+        bw_b = max(width / max(len(bad_u), 1) ** 0.83, 1e-3 * width)
+
+        def kde(xs, centers, bw):
+            d = (xs[:, None] - centers[None, :]) / bw
+            return np.exp(-0.5 * d * d).sum(axis=1) / (len(centers) * bw) + 1e-12
+
+        # candidates from the good KDE, clipped to the search interval
+        idx = self.rng.integers(len(good_u), size=self.n_candidates)
+        cand = np.clip(
+            good_u[idx] + self.rng.normal(0, bw_g, self.n_candidates),
+            lo_u, hi_u,
+        )
+        score = kde(cand, good_u, bw_g) / kde(cand, bad_u, bw_b)
+        v_u = float(cand[int(np.argmax(score))])
+        v = math.exp(v_u) if spec.log else v_u
+        return v if spec.kind == "float" else int(round(v))
+
+    # -- pruning ----------------------------------------------------------
+    def _should_prune(self, trial: Trial) -> bool:
+        """Median rule: prune when the trial's latest intermediate value is
+        worse than the median of completed trials at the same step."""
+        if not trial.intermediate:
+            return False
+        if len(self.completed) < self.pruner_warmup_trials:
+            return False
+        step = max(trial.intermediate)
+        if step < self.pruner_warmup_steps:
+            return False
+        peers = [
+            t.intermediate[step]
+            for t in self.completed
+            if step in t.intermediate
+        ]
+        if not peers:
+            return False
+        median = float(np.median(peers))
+        value = trial.intermediate[step]
+        return value > median if self.direction == "minimize" else value < median
+
+
+def create_study(direction="minimize", sampler="tpe", seed=0, **kwargs) -> Study:
+    return Study(direction=direction, sampler=sampler, seed=seed, **kwargs)

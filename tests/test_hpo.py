@@ -1,0 +1,128 @@
+"""HPO engine: samplers converge, pruner prunes, launcher parses.
+
+Mirrors the role the reference's DeepHyper/Optuna drivers play
+(``examples/qm9_hpo``, ``examples/multidataset_hpo``) with the native
+implementation in ``hydragnn_tpu/hpo``.
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+
+from hydragnn_tpu.hpo import TrialLauncher, TrialPruned, create_study, parse_val_loss
+
+
+def pytest_random_search_quadratic():
+    study = create_study(sampler="random", seed=1)
+
+    def objective(trial):
+        x = trial.suggest_float("x", -5.0, 5.0)
+        return (x - 2.0) ** 2
+
+    study.optimize(objective, n_trials=60)
+    assert abs(study.best_params["x"] - 2.0) < 1.0
+    assert study.best_value < 1.0
+
+
+def pytest_tpe_beats_pure_chance():
+    # TPE should concentrate samples near the optimum after startup
+    study = create_study(sampler="tpe", seed=3, n_startup=10)
+
+    def objective(trial):
+        x = trial.suggest_float("x", 0.0, 10.0)
+        y = trial.suggest_float("y", 1e-3, 10.0, log=True)
+        return (x - 7.0) ** 2 + (np.log(y) - np.log(0.1)) ** 2
+
+    study.optimize(objective, n_trials=80)
+    assert study.best_value < 0.5
+    late = [t.params["x"] for t in study.completed[40:]]
+    assert abs(np.median(late) - 7.0) < 2.0  # concentrated, not uniform
+
+
+def pytest_categorical_and_int_spaces():
+    study = create_study(sampler="tpe", seed=0, n_startup=8)
+
+    def objective(trial):
+        m = trial.suggest_categorical("model", ["PNA", "GIN", "SAGE"])
+        h = trial.suggest_int("hidden", 16, 256)
+        base = {"PNA": 0.0, "GIN": 1.0, "SAGE": 2.0}[m]
+        return base + abs(h - 64) / 64.0
+
+    study.optimize(objective, n_trials=50)
+    assert study.best_params["model"] == "PNA"
+    assert isinstance(study.best_params["hidden"], int)
+    assert abs(study.best_params["hidden"] - 64) < 48
+
+
+def pytest_redefining_param_space_rejected():
+    study = create_study(seed=0)
+    t = study.ask()
+    t.suggest_float("x", 0.0, 1.0)
+    t2 = study.ask()
+    try:
+        t2.suggest_float("x", 0.0, 2.0)
+        raise AssertionError("expected ValueError for redefined space")
+    except ValueError:
+        pass
+
+
+def pytest_median_pruner():
+    study = create_study(sampler="random", seed=0, pruner_warmup_trials=3)
+
+    def objective(trial):
+        x = trial.suggest_float("x", 0.0, 1.0)
+        for step in range(1, 4):
+            trial.report(x * step, step)
+            if trial.should_prune():
+                raise TrialPruned()
+        return x
+
+    study.optimize(objective, n_trials=30)
+    pruned = [t for t in study.trials if t.state == "pruned"]
+    completed = study.completed
+    assert pruned, "median pruner never fired"
+    # pruned trials must be the worse half at their final reported step
+    assert np.median([t.params["x"] for t in pruned]) > np.median(
+        [t.params["x"] for t in completed]
+    )
+
+
+def pytest_launcher_parses_and_runs(tmp_path):
+    assert parse_val_loss("Epoch 1\nVal Loss: 0.5\nVal Loss: 1.25e-2\n") == 0.0125
+    assert parse_val_loss("no metric here") is None
+
+    script = tmp_path / "fake_train.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import sys
+            args = dict(a.split("=", 1) for a in sys.argv[1:] if "=" in a)
+            x = float(args["--x"])
+            print(f"Val Loss: {(x - 3.0) ** 2}")
+            """
+        )
+    )
+    os.environ.pop("SLURM_JOB_ID", None)
+    launcher = TrialLauncher(str(script), log_dir=str(tmp_path / "logs"))
+    study = create_study(sampler="random", seed=0)
+
+    def objective(trial):
+        trial.suggest_float("x", 0.0, 6.0)
+        return launcher.run(trial)
+
+    study.optimize(objective, n_trials=8)
+    assert study.best_value < 4.0
+    # per-trial output files land in the log dir
+    assert (tmp_path / "logs" / "output_0.txt").exists()
+
+
+def pytest_launcher_failure_is_inf(tmp_path):
+    script = tmp_path / "crash.py"
+    script.write_text("raise SystemExit(1)\n")
+    launcher = TrialLauncher(str(script), log_dir=str(tmp_path / "logs"))
+    study = create_study(sampler="random", seed=0)
+    t = study.ask()
+    t.suggest_float("x", 0.0, 1.0)
+    assert launcher.run(t) == float("inf")
