@@ -74,21 +74,38 @@ def apply_cli_overrides(config: dict) -> dict:
 
 
 def example_arg(flag: str, default=None):
-    """Tiny argv reader for ``--key=value`` flags (examples use a handful)."""
+    """Tiny argv reader: ``--key=value``, ``--key value``, or bare ``--key``
+    (boolean). Examples use a handful of flags; both spellings work."""
     prefix = f"--{flag}="
-    for a in sys.argv[1:]:
-        if a == f"--{flag}":
-            return True
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
         if a.startswith(prefix):
             return a[len(prefix):]
+        if a == f"--{flag}":
+            nxt = argv[i + 1] if i + 1 < len(argv) else None
+            if nxt is not None and not nxt.startswith("--"):
+                return nxt
+            return True
     return default
 
 
 def train_example(config: dict, dataset, log_name: str, seed: int = 0):
-    """Split -> loaders -> derived config -> model -> train -> save.
+    """Split -> loaders -> train. See :func:`train_with_loaders`."""
+    training = config["NeuralNetwork"]["Training"]
+    trainset, valset, testset = split_dataset(
+        dataset, training["perc_train"], False
+    )
+    return train_with_loaders(
+        config, trainset, valset, testset, log_name, seed=seed
+    )
 
-    Returns (state, trainer, val_loss). Prints ``Val Loss: <x>`` at the end —
-    the HPO launcher greps exactly that (the reference's DeepHyper trial
+
+def train_with_loaders(config, trainset, valset, testset, log_name, seed=0):
+    """Loaders -> derived config -> model -> train -> save.
+
+    Accepts pre-split datasets (lists or shard/dist datasets). Returns
+    (state, trainer, val_loss). Prints ``Val Loss: <x>`` at the end — the
+    HPO launcher greps exactly that (the reference's DeepHyper trial
     parser, ``gfm_deephyper_multi.py:34-40``).
     """
     setup_distributed()
@@ -99,9 +116,6 @@ def train_example(config: dict, dataset, log_name: str, seed: int = 0):
     print_utils.setup_log(log_name)
 
     training = config["NeuralNetwork"]["Training"]
-    trainset, valset, testset = split_dataset(
-        dataset, training["perc_train"], False
-    )
     need_triplets = (
         config["NeuralNetwork"]["Architecture"].get("model_type") == "DimeNet"
     )
@@ -168,6 +182,26 @@ def molecule_graph(z, pos, radius, max_neighbours=None, targets=(),
     d.targets = [np.asarray(t, np.float32) for t in targets]
     d.target_types = list(target_types)
     return d
+
+
+_SMILES_CORES = ["C", "CC", "CCC", "CCCC", "c1ccccc1", "C1CCCCC1",
+                 "c1ccncc1", "C1CCOC1"]
+_SMILES_SUBS = ["", "O", "N", "F", "C#N", "C(=O)O", "CO", "C=C", "S"]
+
+
+def random_smiles(rng, max_subs=2):
+    """Small random organic molecule as a SMILES string (offline stand-in
+    for a real SMILES CSV; parseable by the built-in parser)."""
+    core = _SMILES_CORES[int(rng.integers(len(_SMILES_CORES)))]
+    subs = [
+        _SMILES_SUBS[int(rng.integers(len(_SMILES_SUBS)))]
+        for _ in range(int(rng.integers(0, max_subs + 1)))
+    ]
+    out = core
+    for s in subs:
+        if s:
+            out += f"({s})" if out[-1].isalnum() else s
+    return out
 
 
 def pairwise_energy(z, pos, cutoff=3.0):

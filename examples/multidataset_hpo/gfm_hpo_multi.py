@@ -1,0 +1,49 @@
+"""Multi-node HPO over the multi-dataset GFM workload — one training
+SUBPROCESS per trial.
+
+Mirrors ``examples/multidataset_hpo/gfm_deephyper_multi.py:22-70``: trial
+geometry is env-driven (``HPO_NNODES_PER_TRIAL`` / ``HPO_NRANKS_PER_TRIAL``,
+srun auto-detected via ``SLURM_JOB_ID``), hyperparameters travel as CLI
+flags, and the trial metric is the last ``Val Loss:`` the training script
+prints. Run ``examples/multidataset/train.py --preonly`` once first.
+"""
+
+import os
+import sys
+
+_EXAMPLES = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _EXAMPLES)
+sys.path.insert(0, os.path.dirname(_EXAMPLES))  # repo root
+
+from hydragnn_tpu.hpo import TrialLauncher, create_study
+
+TRAIN_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "multidataset", "train.py",
+)
+
+
+def main():
+    n_trials = int(os.environ.get("HPO_NUM_TRIALS", "6"))
+    launcher = TrialLauncher(
+        TRAIN_SCRIPT,
+        log_dir=os.environ.get("HPO_LOG_DIR", "./logs/gfm_hpo"),
+    )
+    study = create_study(direction="minimize", sampler="tpe", n_startup=3)
+
+    def objective(trial):
+        trial.suggest_categorical("model_type", ["PNA", "GIN", "SAGE"])
+        trial.suggest_int("hidden_dim", 32, 128)
+        trial.suggest_int("num_conv_layers", 2, 5)
+        trial.suggest_int("num_headlayers", 1, 3)
+        trial.suggest_int("dim_headlayers", 32, 128)
+        trial.params["num_epoch"] = int(os.environ.get("HPO_TRIAL_EPOCHS", "3"))
+        return launcher.run(trial)
+
+    study.optimize(objective, n_trials=n_trials)
+    print(f"best params: {study.best_params}")
+    print(f"best value: {study.best_value}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Open Catalyst 2020 workload — the canonical sharded data-plane pipeline.
+
+Mirrors ``examples/open_catalyst_2020/train.py`` in the reference:
+
+  ``--preonly``   parallel preprocessing: every rank converts its ``nsplit``
+                  share of structures to graphs, splits locally 0.9/0.05/0.05,
+                  and writes its own shard (AdiosWriter analog,
+                  ``train.py:227-301``);
+  (default)       training reads the shard store mmap'd (shmem analog);
+  ``--preload``   copy shards into RAM (slow filesystems);
+  ``--ddstore``   wrap the shards in the distributed in-memory sample store
+                  so each process holds one partition and fetches remote
+                  samples on demand (``train.py:308-347``).
+
+Offline data: FCC metal slabs (Cu/Pt/Ag) with a small adsorbate (H, O, C)
+above the surface, periodic in-plane; adsorption 'energy' is a deterministic
+function of adsorbate identity and local coordination.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from common import example_arg, load_config, train_with_loaders
+
+from hydragnn_tpu.data import GraphData, radius_graph_pbc, split_dataset
+from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
+from hydragnn_tpu.parallel.distributed import (
+    get_comm_size_and_rank,
+    nsplit,
+    setup_distributed,
+)
+
+METALS = [29, 78, 47]  # Cu Pt Ag
+ADSORBATES = [1, 8, 6]  # H O C
+ALAT = 3.6
+VACUUM = 15.0
+
+
+def make_structure(rng, radius, max_neighbours):
+    """2-layer 2x2 FCC(100) slab + one adsorbate in the vacuum gap."""
+    metal = METALS[int(rng.integers(len(METALS)))]
+    ads = ADSORBATES[int(rng.integers(len(ADSORBATES)))]
+    pos, z = [], []
+    for layer in range(2):
+        for i in range(2):
+            for j in range(2):
+                off = 0.5 if layer % 2 else 0.0
+                pos.append([(i + off) * ALAT, (j + off) * ALAT,
+                            layer * ALAT * 0.5])
+                z.append(metal)
+    site = rng.integers(2, size=2)
+    pos.append([site[0] * ALAT + 0.5 * ALAT, site[1] * ALAT + 0.5 * ALAT,
+                ALAT * 0.5 + 1.6 + rng.uniform(-0.2, 0.4)])
+    z.append(ads)
+    pos = np.asarray(pos, np.float64) + rng.normal(0, 0.05, (9, 3))
+    cell = np.diag([2 * ALAT, 2 * ALAT, ALAT + VACUUM])
+
+    d = GraphData(
+        x=np.asarray(z, np.float32).reshape(-1, 1),
+        pos=pos.astype(np.float32),
+        supercell_size=cell,
+    )
+    d.edge_index, _ = radius_graph_pbc(pos, cell, radius, max_neighbours)
+    # adsorption energy: species term + coordination of the adsorbate
+    ads_coord = int((d.edge_index[1] == 8).sum())
+    energy = {1: -0.5, 8: -1.2, 6: -0.9}[ads] * (1 + 0.15 * ads_coord) + {
+        29: 0.1, 78: -0.3, 47: 0.2
+    }[metal]
+    d.targets = [np.asarray([energy], np.float32)]
+    d.target_types = ["graph"]
+    return d
+
+
+def preonly(config, modelname, num_samples):
+    world, rank = get_comm_size_and_rank()
+    arch = config["NeuralNetwork"]["Architecture"]
+    my_ids = list(nsplit(range(num_samples), world))[rank]
+    rng = np.random.default_rng(42 + rank)
+    samples = [
+        make_structure(rng, arch["radius"], arch["max_neighbours"])
+        for _ in my_ids
+    ]
+    # local 0.9 split, like the reference (train.py:237-242)
+    trainset, valset, testset = split_dataset(samples, 0.9, False)
+    for name, ds in [("trainset", trainset), ("valset", valset),
+                     ("testset", testset)]:
+        w = ShardWriter(f"dataset/{modelname}_{name}", rank=rank)
+        w.add(ds)
+        w.save()
+    print(f"rank {rank}: wrote {len(trainset)}/{len(valset)}/{len(testset)}")
+
+
+def load_split(modelname, name, preload=False, ddstore=False):
+    base = ShardDataset(f"dataset/{modelname}_{name}", preload=preload)
+    if ddstore:
+        from hydragnn_tpu.data.distdataset import DistDataset
+
+        # each process serves ITS contiguous partition; get() on any other
+        # index fetches from the owning process over the store's transport
+        world, rank = get_comm_size_and_rank()
+        mine = list(nsplit(range(len(base)), world))[rank]
+        local = [base[i] for i in mine]
+        return DistDataset(local, rank=rank, world=world)
+    return base
+
+
+def main():
+    config = load_config(__file__, str(example_arg("config", "oc20.json")))
+    modelname = str(example_arg("modelname", "OC2020"))
+    num_samples = int(example_arg("num_samples", 1000))
+    setup_distributed()
+
+    if example_arg("preonly"):
+        preonly(config, modelname, num_samples)
+        return
+
+    preload = bool(example_arg("preload"))
+    ddstore = bool(example_arg("ddstore"))
+    trainset = load_split(modelname, "trainset", preload, ddstore)
+    valset = load_split(modelname, "valset", preload, ddstore)
+    testset = load_split(modelname, "testset", preload, ddstore)
+    if ddstore:
+        for ds in (trainset, valset, testset):
+            ds.epoch_begin()
+    try:
+        train_with_loaders(
+            config, trainset, valset, testset, log_name=modelname.lower()
+        )
+    finally:
+        if ddstore:
+            for ds in (trainset, valset, testset):
+                ds.epoch_end()
+
+
+if __name__ == "__main__":
+    main()

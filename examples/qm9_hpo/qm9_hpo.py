@@ -1,0 +1,61 @@
+"""QM9 hyperparameter search with the native HPO engine.
+
+Mirrors ``examples/qm9_hpo/qm9_optuna.py`` / ``qm9_deephyper.py``: the same
+search space (model type, hidden dim, conv depth, head geometry) over the
+QM9 workload, trials running in-process and returning validation loss.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "qm9"),
+)
+from common import example_arg, load_config, train_example
+from qm9 import qm9_dataset
+
+from hydragnn_tpu.hpo import create_study
+
+
+def main():
+    base = load_config(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "qm9", "qm9.py"), "qm9.json")
+    arch = base["NeuralNetwork"]["Architecture"]
+    num_samples = int(example_arg("num_samples", 400))
+    n_trials = int(example_arg("n_trials", 8))
+    dataset = qm9_dataset(num_samples, arch["radius"], arch["max_neighbours"])
+
+    def objective(trial):
+        import copy
+
+        config = copy.deepcopy(base)
+        a = config["NeuralNetwork"]["Architecture"]
+        a["model_type"] = trial.suggest_categorical(
+            "model_type", ["PNA", "GIN", "SAGE"]
+        )
+        a["hidden_dim"] = trial.suggest_int("hidden_dim", 16, 96)
+        a["num_conv_layers"] = trial.suggest_int("num_conv_layers", 1, 5)
+        nh = trial.suggest_int("num_headlayers", 1, 3)
+        dh = trial.suggest_int("dim_headlayers", 16, 96)
+        for head in a["output_heads"].values():
+            head["num_headlayers"] = nh
+            head["dim_headlayers"] = [dh] * nh
+        config["NeuralNetwork"]["Training"]["num_epoch"] = int(
+            example_arg("num_epoch", 3)
+        )
+        _, _, val_loss = train_example(
+            config, dataset, log_name=f"qm9_hpo_{trial.id}"
+        )
+        return val_loss
+
+    study = create_study(direction="minimize", sampler="tpe", n_startup=4)
+    study.optimize(objective, n_trials=n_trials)
+    print(f"best params: {study.best_params}")
+    print(f"best value: {study.best_value}")
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,7 @@ from hydragnn_tpu.data.dataobj import GraphData
 from hydragnn_tpu.data.radius_graph import radius_graph, radius_graph_pbc
 from hydragnn_tpu.data.loaders import (
     BatchLayout,
+    ConcatDataset,
     GraphLoader,
     compute_layout,
     create_dataloaders,

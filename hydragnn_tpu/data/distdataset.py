@@ -63,6 +63,12 @@ def _load():
     return lib
 
 
+# Each store instance needs its own port block (one port per rank). The
+# counter is deterministic, so SPMD processes creating stores in the same
+# order (train/val/test) agree on every instance's ports.
+_PORT_BLOCKS = iter(range(10_000))
+
+
 class DistSampleStore:
     """Low-level variable-oriented store (pyddstore.PyDDStore parity)."""
 
@@ -71,9 +77,11 @@ class DistSampleStore:
         rank: int,
         world: int,
         addresses: Optional[List[str]] = None,
-        base_port: int = 23450,
+        base_port: Optional[int] = None,
     ):
         self._lib = _load()
+        if base_port is None:
+            base_port = 23450 + next(_PORT_BLOCKS) * world
         if addresses is None:
             addresses = [f"127.0.0.1:{base_port + r}" for r in range(world)]
         self.rank = rank
@@ -264,7 +272,7 @@ class DistDataset:
         world: int = 1,
         addresses: Optional[List[str]] = None,
         samples_per_rank: Optional[List[int]] = None,
-        base_port: int = 23450,
+        base_port: Optional[int] = None,
         max_counts: Optional[Dict[str, int]] = None,
     ):
         self.store = DistSampleStore(rank, world, addresses, base_port)
@@ -368,6 +376,13 @@ class DistDataset:
         return len(self.store)
 
     def get(self, idx: int) -> GraphData:
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            # IndexError (not RuntimeError) so sequence-protocol iteration
+            # terminates like any list-ish dataset
+            raise IndexError(idx)
         d = GraphData()
         d.x = self.store.get("x", idx)
         if self._has["pos"]:

@@ -140,6 +140,33 @@ def _collate_with_extras(samples, layout: BatchLayout):
     return batch
 
 
+class ConcatDataset:
+    """Read-only concatenation of list-like datasets (the multi-dataset
+    GFM training pattern, ``examples/multidataset/train.py`` in the
+    reference). Works over in-memory lists, ShardDatasets, DistDatasets."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self._cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __len__(self):
+        return int(self._cum[-1]) if len(self._cum) else 0
+
+    def __getitem__(self, idx):
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        which = int(np.searchsorted(self._cum, idx, side="right"))
+        local = idx - (int(self._cum[which - 1]) if which else 0)
+        return self.datasets[which][local]
+
+    def __iter__(self):
+        for ds in self.datasets:
+            yield from ds
+
+
 class GraphLoader:
     """Iterates padded batches; DistributedSampler-style sharding + epoch
     shuffling (``load_data.py:237-245``, ``train_validate_test.py:151-153``)."""

@@ -62,7 +62,12 @@ class TrialLauncher:
             cmd += ["srun", "-N", str(self.nnodes), "-n", str(self.nranks)]
             if nodelist:
                 cmd += [f"--nodelist={','.join(nodelist)}"]
-        cmd += [sys.executable, "-u", self.script]
+        cmd += [sys.executable, "-u"]
+        if sys.flags.no_site:
+            # parent launched with -S (site init skipped): children must
+            # match or they re-run the site hooks the caller avoided
+            cmd.append("-S")
+        cmd += [self.script]
         for k, v in params.items():
             cmd.append(f"--{k}={v}")
         cmd.append(f"--log_name_suffix=trial_{trial_id}")

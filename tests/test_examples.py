@@ -1,0 +1,59 @@
+"""Example smoke tests: run example workloads as subprocesses, assert exit 0.
+
+Mirrors ``tests/test_examples.py:18-26`` in the reference (qm9 + md17 run
+as subprocesses). Children run with ``-S`` + explicit paths so they get the
+CPU backend deterministically regardless of the container's site hooks.
+"""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(script, *flags, cwd):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": sysconfig.get_paths()["purelib"] + os.pathsep + _REPO,
+    }
+    return subprocess.run(
+        [sys.executable, "-S", "-u", os.path.join(_REPO, script), *flags],
+        cwd=cwd,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+
+
+@pytest.mark.parametrize("example", ["qm9", "md17"])
+def pytest_example_smoke(example, tmp_path):
+    script = {
+        "qm9": "examples/qm9/qm9.py",
+        "md17": "examples/md17/md17.py",
+    }[example]
+    res = _run_example(
+        script, "--num_samples=60", "--num_epoch=2", cwd=str(tmp_path)
+    )
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "Val Loss:" in res.stdout
+
+
+def pytest_example_shard_pipeline(tmp_path):
+    """open_catalyst: preonly shard write then a training run reading it."""
+    res = _run_example(
+        "examples/open_catalyst_2020/train.py",
+        "--preonly", "--num_samples=80", cwd=str(tmp_path),
+    )
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    res = _run_example(
+        "examples/open_catalyst_2020/train.py",
+        "--num_epoch=2", cwd=str(tmp_path),
+    )
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "Val Loss:" in res.stdout

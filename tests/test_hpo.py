@@ -89,7 +89,7 @@ def pytest_median_pruner():
     )
 
 
-def pytest_launcher_parses_and_runs(tmp_path):
+def pytest_launcher_parses_and_runs(tmp_path, monkeypatch):
     assert parse_val_loss("Epoch 1\nVal Loss: 0.5\nVal Loss: 1.25e-2\n") == 0.0125
     assert parse_val_loss("no metric here") is None
 
@@ -104,7 +104,7 @@ def pytest_launcher_parses_and_runs(tmp_path):
             """
         )
     )
-    os.environ.pop("SLURM_JOB_ID", None)
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
     launcher = TrialLauncher(str(script), log_dir=str(tmp_path / "logs"))
     study = create_study(sampler="random", seed=0)
 
