@@ -18,6 +18,10 @@ _BIG = 1e9  # sentinel for min/max identities; float32-safe
 
 
 def segment_sum(data, segment_ids, num_segments):
+    from hydragnn_tpu.ops import pallas_segments_enabled, segment_sum_onehot
+
+    if data.ndim == 2 and pallas_segments_enabled(num_segments, data.shape[1]):
+        return segment_sum_onehot(data, segment_ids, num_segments)
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
 

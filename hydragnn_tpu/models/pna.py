@@ -63,20 +63,33 @@ class PNAConv(nn.Module):
         h = TorchLinear(self.in_dim, name="pre_nn")(h)
         h = jnp.where(batch.edge_mask[:, None], h, 0.0)
 
+        from hydragnn_tpu.ops import pallas_segments_enabled, segment_moments
+
+        if pallas_segments_enabled(n, h.shape[1], n_outputs=2):
+            # fused kernel: mean/std/degree from ONE pass over the messages
+            # (padded edges target the padding node, so real-node statistics
+            # are untouched and the padding node is masked downstream)
+            s, cnt, sq = segment_moments(h, batch.receivers, n)
+            cnt = jnp.maximum(cnt, 1.0)
+            mean = s / cnt
+            std = jnp.sqrt(jnp.maximum(sq / cnt - mean * mean, 0.0) + 1e-5)
+            deg = cnt
+        else:
+            mean = segment_mean(h, batch.receivers, n)
+            std = segment_std(h, batch.receivers, n)
+            deg = segment_count(
+                batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
+            )
+            deg = jnp.maximum(deg, 1.0)[:, None]
         aggr = jnp.concatenate(
             [
-                segment_mean(h, batch.receivers, n),
+                mean,
                 segment_min(h, batch.receivers, n),
                 segment_max(h, batch.receivers, n),
-                segment_std(h, batch.receivers, n),
+                std,
             ],
             axis=-1,
         )
-
-        deg = segment_count(
-            batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
-        )
-        deg = jnp.maximum(deg, 1.0)[:, None]
         log_deg = jnp.log(deg + 1.0)
         scaled = jnp.concatenate(
             [

@@ -1,0 +1,5 @@
+from hydragnn_tpu.ops.pallas_segment import (
+    pallas_segments_enabled,
+    segment_moments,
+    segment_sum_onehot,
+)

@@ -1,0 +1,214 @@
+"""Pallas TPU kernels for the message-passing aggregation hot path.
+
+The reference's aggregation runs on torch_scatter CUDA kernels
+(SURVEY.md §2.4). On TPU, XLA lowers ``jax.ops.segment_*`` to scatter-adds,
+which serialize on duplicate indices and re-read the ``[E, D]`` message
+array once per requested statistic — PNA wants mean, std AND the degree
+count, i.e. three passes over HBM.
+
+These kernels make aggregation MXU work instead of scatter work: the output
+``[N, D]`` accumulator lives in VMEM across the whole grid; each step loads
+one block of edges and accumulates ``onehot(receivers)^T @ messages`` — a
+dense matmul the systolic array eats — so the messages are read from HBM
+exactly ONCE. ``segment_moments`` produces sum, count and sum-of-squares in
+that single pass (mean/std/degree all derive from it).
+
+Enablement: ``HYDRAGNN_PALLAS=1`` opts in (with the accumulator-fits-VMEM
+guard), ``0``/unset keeps the XLA path. Off by default until the kernel is
+benchmarked against XLA's scatter on real hardware — flip the default in
+``pallas_segments_enabled`` once measured. Gradients are provided via
+custom VJPs (gather-based, XLA-fused).
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+_EDGE_BLOCK = 256
+_VMEM_ACC_BUDGET = 6 * 1024 * 1024  # bytes of VMEM we allow the accumulators
+
+
+def pallas_segments_enabled(num_segments: int, dim: int, n_outputs: int = 1):
+    """Decide kernel vs XLA fallback for a [num_segments, dim] accumulation."""
+    if os.getenv("HYDRAGNN_PALLAS", "0") != "1":
+        return False
+    acc_bytes = n_outputs * num_segments * max(dim, 1) * 4
+    return acc_bytes <= _VMEM_ACC_BUDGET
+
+
+def _interpret(requested: bool) -> bool:
+    """Compiled pallas is TPU-only; other backends run the interpreter (so
+    HYDRAGNN_PALLAS=1 is testable on CPU)."""
+    if requested:
+        return True
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _pad_edges(data, segment_ids, block):
+    """Pad the edge axis to a block multiple; padded ids point past the last
+    segment so their one-hot row is all zeros (no contribution)."""
+    e = data.shape[0]
+    pad = (-e) % block
+    if pad:
+        data = jnp.pad(data, ((0, pad), (0, 0)))
+        segment_ids = jnp.pad(
+            segment_ids, (0, pad), constant_values=jnp.iinfo(jnp.int32).max
+        )
+    return data, segment_ids
+
+
+def _onehot(ids_block, num_segments):
+    """[E_blk, N] float32 indicator; out-of-range ids give a zero row."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, (ids_block.shape[0], num_segments), 1)
+    return (ids_block[:, None] == cols).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# segment_sum
+# ---------------------------------------------------------------------------
+
+def _sum_kernel(ids_ref, data_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    onehot = _onehot(ids_ref[:], out_ref.shape[0])
+    out_ref[:] += jax.lax.dot_general(
+        onehot, data_ref[:],
+        dimension_numbers=(((0,), (0,)), ((), ())),  # onehot^T @ data
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _segment_sum_fwd_impl(data, segment_ids, num_segments, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = _interpret(interpret)
+    data = data.astype(jnp.float32)
+    data, ids = _pad_edges(data, segment_ids.astype(jnp.int32), _EDGE_BLOCK)
+    e_pad, dim = data.shape
+    grid = e_pad // _EDGE_BLOCK
+    return pl.pallas_call(
+        _sum_kernel,
+        out_shape=jax.ShapeDtypeStruct((num_segments, dim), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_EDGE_BLOCK,), lambda i: (i,)),
+            pl.BlockSpec((_EDGE_BLOCK, dim), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((num_segments, dim), lambda i: (0, 0)),
+        interpret=interpret,
+    )(ids, data)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def segment_sum_onehot(data, segment_ids, num_segments, interpret=False):
+    """Pallas segment-sum: ``out[n] = sum_{e: ids[e]==n} data[e]``.
+
+    ``data`` must be 2-D ``[E, D]``. Same contract as
+    ``jax.ops.segment_sum`` with static ``num_segments``.
+    """
+    return _segment_sum_fwd_impl(data, segment_ids, num_segments, interpret)
+
+
+def _segment_sum_fwd(data, segment_ids, num_segments, interpret):
+    out = _segment_sum_fwd_impl(data, segment_ids, num_segments, interpret)
+    return out, (segment_ids, data.shape[0])
+
+
+def _segment_sum_bwd(num_segments, interpret, res, g):
+    segment_ids, _ = res
+    # d/d_data = g gathered at each edge's segment; padded/out-of-range ids
+    # never reach here (they only exist inside the kernel)
+    return g[segment_ids], None
+
+
+segment_sum_onehot.defvjp(_segment_sum_fwd, _segment_sum_bwd)
+
+
+# ---------------------------------------------------------------------------
+# segment_moments: sum / count / sum-of-squares in ONE pass
+# ---------------------------------------------------------------------------
+
+def _moments_kernel(ids_ref, data_ref, sum_ref, cnt_ref, sq_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+        sq_ref[:] = jnp.zeros_like(sq_ref)
+
+    data = data_ref[:]
+    onehot = _onehot(ids_ref[:], sum_ref.shape[0])
+    tdot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    sum_ref[:] += tdot(onehot, data)
+    sq_ref[:] += tdot(onehot, data * data)
+    cnt_ref[:] += jnp.sum(onehot, axis=0, keepdims=True).T
+
+
+def _moments_impl(data, segment_ids, num_segments, interpret=False):
+    from jax.experimental import pallas as pl
+
+    interpret = _interpret(interpret)
+    data = data.astype(jnp.float32)
+    data, ids = _pad_edges(data, segment_ids.astype(jnp.int32), _EDGE_BLOCK)
+    e_pad, dim = data.shape
+    grid = e_pad // _EDGE_BLOCK
+    return pl.pallas_call(
+        _moments_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((num_segments, dim), jnp.float32),
+            jax.ShapeDtypeStruct((num_segments, 1), jnp.float32),
+            jax.ShapeDtypeStruct((num_segments, dim), jnp.float32),
+        ),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_EDGE_BLOCK,), lambda i: (i,)),
+            pl.BlockSpec((_EDGE_BLOCK, dim), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((num_segments, dim), lambda i: (0, 0)),
+            pl.BlockSpec((num_segments, 1), lambda i: (0, 0)),
+            pl.BlockSpec((num_segments, dim), lambda i: (0, 0)),
+        ),
+        interpret=interpret,
+    )(ids, data)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def segment_moments(data, segment_ids, num_segments, interpret=False):
+    """(sum, count, sum_of_squares) per segment in one pass over the edges.
+
+    mean = sum / max(count, 1); var = sq/count - mean^2 — the PNA aggregator
+    statistics (``models/PNAStack.py:28-34`` in the reference) from a single
+    HBM read of the messages.
+    """
+    return _moments_impl(data, segment_ids, num_segments, interpret)
+
+
+def _moments_fwd(data, segment_ids, num_segments, interpret):
+    out = _moments_impl(data, segment_ids, num_segments, interpret)
+    return out, (data, segment_ids)
+
+
+def _moments_bwd(num_segments, interpret, res, grads):
+    data, segment_ids = res
+    g_sum, _g_cnt, g_sq = grads  # count is piecewise constant: no gradient
+    d_data = g_sum[segment_ids] + 2.0 * data * g_sq[segment_ids]
+    return d_data, None
+
+
+segment_moments.defvjp(_moments_fwd, _moments_bwd)
