@@ -104,6 +104,13 @@ class HydraBase(nn.Module):
     conv_checkpointing: bool = False
     initial_bias: Optional[float] = None
     dropout: float = 0.25
+    # Graph-partition parallelism (the long-context analog, SURVEY.md §5):
+    # when set, the batch is ONE giant graph whose nodes/edges are sharded
+    # over this mesh axis (see ``hydragnn_tpu/parallel/graph_partition``).
+    # Convs see a halo-extended node table refreshed by all_to_all before
+    # every layer; BatchNorm/pooling/loss psum over the axis so numerics
+    # match the unpartitioned model exactly.
+    partition_axis: Optional[str] = None
 
     @property
     def use_edge_attr(self) -> bool:
@@ -149,6 +156,9 @@ class HydraBase(nn.Module):
         return specs
 
     def _node_index_in_graph(self, batch: GraphBatch):
+        if batch.extras is not None and "node_index_in_graph" in batch.extras:
+            # partitioned giant graph: global position precomputed host-side
+            return batch.extras["node_index_in_graph"]
         starts = jnp.cumsum(batch.n_node) - batch.n_node
         return jnp.arange(batch.num_nodes, dtype=jnp.int32) - starts[batch.node_graph]
 
@@ -161,7 +171,36 @@ class HydraBase(nn.Module):
         return cls
 
     def _apply_conv(self, conv, x, pos, batch, train):
-        return conv(x, pos, batch, train)
+        if self.partition_axis is None:
+            return conv(x, pos, batch, train)
+        # Partitioned message passing: refresh the halo (remote-sender rows)
+        # from their owner shards via all_to_all, run the conv on the
+        # extended table, keep the local rows. The analog of exchanging KV
+        # blocks in ring attention — features ride ICI, compute stays local.
+        from hydragnn_tpu.parallel.graph_partition import halo_extend
+
+        send_idx = batch.extras["halo_send"]
+        nl = x.shape[0]
+        # ONE all_to_all for features+positions (small collectives are
+        # latency-bound on ICI; fuse, then split)
+        both = halo_extend(
+            jnp.concatenate([x, pos], axis=-1), send_idx, self.partition_axis
+        )
+        xe, pe = both[:, : x.shape[1]], both[:, x.shape[1] :]
+        # convs that build per-node virtual edges (GAT self-loops) consult
+        # node_mask at the extended size; halo rows are masked off since
+        # their aggregations happen on the owner shard.
+        ext = xe.shape[0] - nl
+        batch_ext = batch.replace(
+            node_mask=jnp.concatenate(
+                [batch.node_mask, jnp.zeros((ext,), dtype=batch.node_mask.dtype)]
+            )
+        )
+        c, p = conv(xe, pe, batch_ext, train)
+        c = c[:nl]
+        if p is not None and p.shape[0] != nl:
+            p = p[:nl]
+        return c, p
 
     @nn.compact
     def __call__(self, batch: GraphBatch, train: bool = False):
@@ -178,13 +217,18 @@ class HydraBase(nn.Module):
             conv = self.get_conv(in_dim, out_dim, name=f"encoder_conv_{i}", **kw)
             c, pos = self._apply_conv(conv, x, pos, batch, train)
             if use_bn:
-                c = MaskedBatchNorm(bn_dim, name=f"encoder_bn_{i}")(
-                    c, batch.node_mask, not train
-                )
+                c = MaskedBatchNorm(
+                    bn_dim, name=f"encoder_bn_{i}", axis_name=self.partition_axis
+                )(c, batch.node_mask, not train)
             x = act(c)
 
         # ---- decoder: multihead (Base.py:205-283,304-327) ---------------
         x_graph = global_mean_pool(x, batch.node_graph, batch.n_node, batch.num_graphs)
+        if self.partition_axis is not None:
+            # nodes of the (single partitioned) graph live on every shard;
+            # n_node[0] holds the GLOBAL real-node count, so the psum of the
+            # local sums/count yields the exact global mean.
+            x_graph = jax.lax.psum(x_graph, self.partition_axis)
 
         graph_shared = None
         if "graph" in heads_cfg:
@@ -244,9 +288,11 @@ class HydraBase(nn.Module):
                             in_dim, od, name=f"head_{ihead}_conv_{il}", **kw
                         )
                         c, p = self._apply_conv(conv, h, p, batch, train)
-                        c = MaskedBatchNorm(bn_dim, name=f"head_{ihead}_bn_{il}")(
-                            c, batch.node_mask, not train
-                        )
+                        c = MaskedBatchNorm(
+                            bn_dim,
+                            name=f"head_{ihead}_bn_{il}",
+                            axis_name=self.partition_axis,
+                        )(c, batch.node_mask, not train)
                         h = act(c)
                     outputs.append(h)
                 else:
@@ -275,7 +321,13 @@ class HydraBase(nn.Module):
                 if self.output_type[ihead] == "graph"
                 else batch.node_mask
             )
-            err = masked_error(pred, target, mask, self.loss_function_type)
+            err = masked_error(
+                pred,
+                target,
+                mask,
+                self.loss_function_type,
+                axis_name=self.partition_axis,
+            )
             tasks.append(err)
             tot = tot + self.loss_weights[ihead] * err
         return tot, tasks

@@ -64,27 +64,40 @@ def get_activation(name: str) -> Callable:
     return table[name]
 
 
-def masked_error(pred, target, mask, kind: str = "mse"):
+def masked_error(pred, target, mask, kind: str = "mse", axis_name: Optional[str] = None):
     """Masked elementwise loss, mean over real rows x features.
 
     Matches ``loss_function_selection`` (``utils/model.py:49-57``) applied to
     unpadded tensors: padding rows contribute nothing to numerator or count.
+
+    ``axis_name``: when the rows of ``pred`` are sharded over a mesh axis
+    (graph-partition parallelism), numerator and count are ``psum``'d over it
+    so the result is the exact global mean — same numerics as unsharded.
     """
     m = mask.reshape(mask.shape + (1,) * (pred.ndim - 1)).astype(pred.dtype)
     # where (not multiply) so NaN/inf garbage in padded rows cannot leak in
     diff = jnp.where(m > 0, pred - target, 0.0)
-    count = jnp.maximum(m.sum() * pred.shape[-1], 1.0)
+    count = m.sum() * pred.shape[-1]
     if kind == "mse":
-        return (diff * diff).sum() / count
-    if kind == "mae":
-        return jnp.abs(diff).sum() / count
-    if kind == "rmse":
-        return jnp.sqrt((diff * diff).sum() / count)
-    if kind == "smooth_l1":
+        numer = (diff * diff).sum()
+    elif kind == "mae":
+        numer = jnp.abs(diff).sum()
+    elif kind == "rmse":
+        numer = (diff * diff).sum()
+    elif kind == "smooth_l1":
         a = jnp.abs(diff)
         val = jnp.where(a < 1.0, 0.5 * diff * diff, a - 0.5)
-        return (val * m).sum() / count
-    raise ValueError(f"Unknown loss function: {kind}")
+        numer = (val * m).sum()
+    else:
+        raise ValueError(f"Unknown loss function: {kind}")
+    if axis_name is not None:
+        numer = jax.lax.psum(numer, axis_name)
+        count = jax.lax.psum(count, axis_name)
+    count = jnp.maximum(count, 1.0)
+    out = numer / count
+    if kind == "rmse":
+        out = jnp.sqrt(out)
+    return out
 
 
 class MaskedBatchNorm(nn.Module):
@@ -101,6 +114,10 @@ class MaskedBatchNorm(nn.Module):
     features: int
     momentum: float = 0.1
     eps: float = 1e-5
+    # set when node rows are sharded over a mesh axis (graph-partition
+    # parallelism): statistics are psum'd so every shard normalizes with the
+    # exact global mean/var — SyncBatchNorm semantics across partitions.
+    axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask, use_running_average: bool):
@@ -115,6 +132,28 @@ class MaskedBatchNorm(nn.Module):
 
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
+        elif self.axis_name is not None:
+            # two-pass (centered) like the local branch: E[x^2]-E[x]^2 would
+            # catastrophically cancel in float32 for large-mean features
+            m = mask.astype(x.dtype)[:, None]
+            count = m.sum()
+            s = (x * m).sum(axis=0)
+            count, s = jax.lax.psum((count, s), self.axis_name)
+            count = jnp.maximum(count, 1.0)
+            mean = s / count
+            centered = (x - mean) * m
+            var = (
+                jax.lax.psum((centered * centered).sum(axis=0), self.axis_name)
+                / count
+            )
+            if not self.is_initializing():
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_mean.value = (
+                    1.0 - self.momentum
+                ) * ra_mean.value + self.momentum * mean
+                ra_var.value = (
+                    1.0 - self.momentum
+                ) * ra_var.value + self.momentum * unbiased
         else:
             m = mask.astype(x.dtype)[:, None]
             count = jnp.maximum(m.sum(), 1.0)

@@ -67,8 +67,16 @@ def create_model_config(config: dict, verbosity: int = 0) -> HydraBase:
         num_nodes=config.get("num_nodes"),
         conv_checkpointing=config.get("conv_checkpointing", False),
         initial_bias=config.get("initial_bias"),
+        # graph-partition parallelism over one giant graph (config key
+        # "partition_axis" names the mesh axis; see parallel/graph_partition)
+        partition_axis=config.get("partition_axis"),
     )
     edge_dim = config.get("edge_dim")
+    if common["partition_axis"] is not None and model_type == "DimeNet":
+        raise ValueError(
+            "DimeNet triplets need 2-hop halos; graph-partition mode is not "
+            "supported for DimeNet yet"
+        )
 
     if model_type == "GIN":
         return GINStack(**common)

@@ -23,6 +23,18 @@ class E_GCL(nn.Module):
     hidden_dim: int
     edge_attr_dim: int
     equivariant: bool
+    # graph-partition mode: aggregations at the SENDER index land partly on
+    # halo rows (edges are owned by the receiver's shard) and must be folded
+    # back onto their owner via all_to_all (halo_reduce).
+    partition_axis: str = None
+
+    def _sender_sum(self, data, row, n, batch):
+        out = segment_sum(data, row, n)
+        if self.partition_axis is not None:
+            from hydragnn_tpu.parallel.graph_partition import halo_reduce
+
+            out = halo_reduce(out, batch.extras["halo_send"], self.partition_axis)
+        return out
 
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
@@ -51,12 +63,20 @@ class E_GCL(nn.Module):
             cw = jnp.tanh(cw)  # tanh=True bounds the update
             trans = jnp.clip(coord_diff * cw, -100.0, 100.0)
             trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
-            agg = segment_sum(trans, row, n)
-            cnt = segment_sum(batch.edge_mask.astype(trans.dtype), row, n)
+            # trans and the count share one segment pass + one halo_reduce
+            both = self._sender_sum(
+                jnp.concatenate(
+                    [trans, batch.edge_mask.astype(trans.dtype)[:, None]], -1
+                ),
+                row,
+                n,
+                batch,
+            )
+            agg, cnt = both[:, :3], both[:, 3]
             pos = pos + agg / jnp.maximum(cnt, 1.0)[:, None]
 
         # node model: aggregate edge features at the sender index (row)
-        agg = segment_sum(e, row, n)
+        agg = self._sender_sum(e, row, n, batch)
         h = jnp.concatenate([x, agg], axis=-1)
         h = jax.nn.relu(TorchLinear(self.hidden_dim, name="node_mlp_0")(h))
         h = TorchLinear(self.out_dim, name="node_mlp_1")(h)
@@ -74,6 +94,7 @@ class EGCLStack(HydraBase):
             hidden_dim=self.hidden_dim,
             edge_attr_dim=self.edge_dim if self.edge_dim else 0,
             equivariant=self.equivariance and not last_layer,
+            partition_axis=self.partition_axis,
         )
 
     def _conv_layer_specs(self):

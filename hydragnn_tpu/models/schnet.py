@@ -51,6 +51,9 @@ class CFConv(nn.Module):
     cutoff: float
     equivariant: bool
     use_edge_attr: bool
+    # graph-partition mode: the coord update aggregates at SENDERS — partials
+    # on halo rows are folded back to their owner shard (see egnn.py).
+    partition_axis: str = None
 
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
@@ -92,8 +95,21 @@ class CFConv(nn.Module):
             cw = cw @ self.param("coord_mlp_1", small, (self.num_filters, 1))
             trans = jnp.clip(coord_diff * cw, -100.0, 100.0)
             trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
-            agg = segment_sum(trans, send, n)
-            cnt = segment_sum(batch.edge_mask.astype(trans.dtype), send, n)
+            # trans and the count share one segment pass + one halo_reduce
+            both = segment_sum(
+                jnp.concatenate(
+                    [trans, batch.edge_mask.astype(trans.dtype)[:, None]], -1
+                ),
+                send,
+                n,
+            )
+            if self.partition_axis is not None:
+                from hydragnn_tpu.parallel.graph_partition import halo_reduce
+
+                both = halo_reduce(
+                    both, batch.extras["halo_send"], self.partition_axis
+                )
+            agg, cnt = both[:, :3], both[:, 3]
             pos = pos + agg / jnp.maximum(cnt, 1.0)[:, None]
 
         msg = h[send] * w
@@ -120,6 +136,7 @@ class SCFStack(HydraBase):
             cutoff=self.radius,
             equivariant=self.equivariance and not last_layer,
             use_edge_attr=self.use_edge_attr,
+            partition_axis=self.partition_axis,
         )
 
     def _conv_layer_specs(self):

@@ -8,3 +8,13 @@ from hydragnn_tpu.parallel.distributed import (
     setup_distributed,
 )
 from hydragnn_tpu.parallel.mesh import default_mesh, make_mesh, shard_optimizer_state
+from hydragnn_tpu.parallel.graph_partition import (
+    PartitionInfo,
+    halo_extend,
+    halo_reduce,
+    make_partitioned_apply,
+    make_partitioned_eval_step,
+    make_partitioned_train_step,
+    partition_graph,
+    put_partitioned_batch,
+)

@@ -1,0 +1,476 @@
+"""Graph-partition parallelism — the long-context analog for GNNs.
+
+The reference cannot split one graph across devices at all: its scaling axis
+is data parallelism over many small graphs (DDP, ``utils/distributed.py``),
+and "large" means *many samples* (DDStore/ADIOS streaming). The TPU-native
+framework goes further: ONE giant graph (a large atomistic system, a mesh, a
+polymer) is sharded node-wise over a mesh axis, the exact structural analog of
+sequence/context parallelism for transformers (ring attention's KV exchange
+becomes halo exchange of remote-sender node features; SURVEY.md §5 names
+static-shape bucketing as the in-domain replacement — this module is the
+scale-out half of that story).
+
+Design:
+
+* **Ownership** — nodes are split into ``P`` contiguous shards after a
+  locality-preserving reorder (Morton/Z-curve over positions, so radius-graph
+  neighbors tend to share a shard and the halo stays small). Every directed
+  edge is owned by its *receiver's* shard, so all receiver-side aggregations
+  (the message-passing hot path) are shard-local segment ops.
+* **Halo exchange** (``halo_extend``) — before every conv layer, each shard
+  gathers the rows remote peers need (a host-precomputed, statically padded
+  send list) and trades them with ONE ``lax.all_to_all`` over ICI. Convs run
+  unmodified on the extended table ``[local ; halo]``; the local slice is
+  kept. Autodiff through the collective yields the reverse scatter-add —
+  gradients flow across shards with no hand-written backward.
+* **Halo reduce** (``halo_reduce``) — the transpose operation, for the two
+  stacks that aggregate at *senders* (EGNN / equivariant SchNet coordinate
+  updates): partial sums landing on halo rows are all_to_all'd back to their
+  owner shard and scatter-added into the local rows.
+* **Exact numerics** — BatchNorm statistics, global pooling and every loss
+  numerator/denominator are ``psum``'d over the axis (``models/common.py``,
+  ``models/base.py``), so a partitioned model computes bit-for-bit the same
+  math as the unpartitioned one; the tests assert output/gradient parity.
+
+No counterpart exists in the reference (capability superset); the closest
+public pattern is jraph's sharded_graphnet / DGL's DistDGL halo design.
+"""
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.graph.batch import GraphBatch
+
+
+# --------------------------------------------------------------------------
+# device-side collectives (called inside shard_map / the model)
+# --------------------------------------------------------------------------
+
+
+def halo_extend(x, halo_send, axis_name):
+    """Extend the local node table with fresh halo rows from peer shards.
+
+    ``x``: ``[NL, ...]`` local rows. ``halo_send``: ``[P, H]`` int32 — row ids
+    this shard must send to each peer (padded entries point at the dummy
+    row). Returns ``[NL + P*H, ...]``: local rows, then peer ``p``'s rows at
+    ``NL + p*H + h`` — the layout the partitioner's remapped sender indices
+    reference.
+    """
+    sends = x[halo_send]  # [P, H, ...]
+    recv = jax.lax.all_to_all(sends, axis_name, split_axis=0, concat_axis=0)
+    return jnp.concatenate([x, recv.reshape((-1,) + x.shape[1:])], axis=0)
+
+
+def halo_reduce(y_ext, halo_send, axis_name):
+    """Fold sender-side partial aggregations back onto their owner shards.
+
+    ``y_ext``: ``[NL + P*H, ...]`` — a segment reduction over the extended
+    table where rows ``NL + p*H + h`` hold partial sums belonging to peer
+    ``p``'s node ``halo_send[p, h]`` (as seen on peer ``p``). Sends each halo
+    block to its owner and scatter-adds into the local rows. Returns
+    ``[NL + P*H, ...]`` with complete local rows and a zeroed halo region.
+    """
+    p, h = halo_send.shape
+    nl = y_ext.shape[0] - p * h
+    local = y_ext[:nl]
+    halo = y_ext[nl:].reshape((p, h) + y_ext.shape[1:])
+    back = jax.lax.all_to_all(halo, axis_name, split_axis=0, concat_axis=0)
+    local = local.at[halo_send.reshape(-1)].add(
+        back.reshape((p * h,) + y_ext.shape[1:])
+    )
+    return jnp.concatenate([local, jnp.zeros_like(y_ext[nl:])], axis=0)
+
+
+# --------------------------------------------------------------------------
+# host-side partitioner
+# --------------------------------------------------------------------------
+
+
+def _morton_order(pos: np.ndarray) -> np.ndarray:
+    """Z-curve ordering of 3-D positions — cheap locality-preserving reorder
+    so contiguous node chunks are spatially compact (small halo cut)."""
+    q = pos - pos.min(axis=0, keepdims=True)
+    denom = np.maximum(q.max(axis=0, keepdims=True), 1e-12)
+    bits = 10
+    cells = np.minimum((q / denom * ((1 << bits) - 1)).astype(np.uint64), (1 << bits) - 1)
+
+    def spread(v):
+        v = v & np.uint64(0x3FF)
+        v = (v | (v << np.uint64(16))) & np.uint64(0x030000FF)
+        v = (v | (v << np.uint64(8))) & np.uint64(0x0300F00F)
+        v = (v | (v << np.uint64(4))) & np.uint64(0x030C30C3)
+        v = (v | (v << np.uint64(2))) & np.uint64(0x09249249)
+        return v
+
+    code = spread(cells[:, 0]) | (spread(cells[:, 1]) << np.uint64(1)) | (
+        spread(cells[:, 2]) << np.uint64(2)
+    )
+    return np.argsort(code, kind="stable")
+
+
+class PartitionInfo:
+    """Static partition geometry + the inverse maps to un-partition outputs."""
+
+    def __init__(self, num_parts, nl, el, halo, node_perm, part_of_node, local_of_node, n_real):
+        self.num_parts = num_parts
+        self.nl = nl  # local node budget (incl. 1 dummy row)
+        self.el = el  # local edge budget
+        self.halo = halo  # per-peer halo budget H
+        self.node_perm = node_perm  # [n] global node id -> (implicit) order
+        self.part_of_node = part_of_node  # [n] owning shard per global node
+        self.local_of_node = local_of_node  # [n] local row per global node
+        self.n_real = n_real
+
+    def gather_nodes(self, per_part_rows: np.ndarray) -> np.ndarray:
+        """``[P*NL, ...]`` stacked per-part rows -> ``[n, ...]`` in the
+        original global node order (drops dummy/halo padding)."""
+        flat_idx = self.part_of_node * self.nl + self.local_of_node
+        return np.asarray(per_part_rows)[flat_idx]
+
+
+def partition_graph(
+    sample,
+    num_parts: int,
+    head_types: Tuple[str, ...] = (),
+    head_dims: Tuple[int, ...] = (),
+    order: str = "morton",
+    node_multiple: int = 8,
+    edge_multiple: int = 8,
+    halo_multiple: int = 8,
+) -> Tuple[GraphBatch, PartitionInfo]:
+    """Split one giant graph into ``num_parts`` static-shape shards.
+
+    ``sample`` exposes numpy ``x [n,F]``, ``pos [n,3]``, ``edge_index [2,e]``,
+    optional ``edge_attr``, and (per ``head_types``) ``targets``. Returns a
+    ``GraphBatch`` whose leading axes concatenate the per-part arrays (part
+    ``p`` owns rows ``[p*NL, (p+1)*NL)`` etc.) — sharding every leaf on axis 0
+    over a ``num_parts``-sized mesh axis gives each device exactly its shard.
+
+    Per-shard layout: rows ``[0, NL-1)`` local nodes (dummy at ``NL-1``);
+    edges are owned by the receiver's shard; remapped sender ids >= NL
+    reference the halo region filled by ``halo_extend`` at run time. The
+    local graph id 0 is the real graph (``n_node[0]`` = GLOBAL real node
+    count, see ``HydraBase.__call__``), id 1 absorbs padding.
+    """
+    x = np.asarray(sample.x, dtype=np.float32)
+    pos = (
+        np.asarray(sample.pos, dtype=np.float32)
+        if getattr(sample, "pos", None) is not None
+        else np.zeros((x.shape[0], 3), np.float32)
+    )
+    edge_index = np.asarray(sample.edge_index)
+    edge_attr = getattr(sample, "edge_attr", None)
+    if edge_attr is not None:
+        edge_attr = np.asarray(edge_attr, dtype=np.float32)
+    n = x.shape[0]
+    e = edge_index.shape[1]
+    P = int(num_parts)
+
+    if order == "morton" and pos is not None:
+        perm = _morton_order(pos)
+    else:
+        perm = np.arange(n)
+
+    # contiguous chunks of the ordering -> parts
+    part_sizes = [(n + P - 1 - p) // P for p in range(P)]  # near-even
+    part_of_node = np.empty(n, dtype=np.int64)
+    local_of_node = np.empty(n, dtype=np.int64)
+    start = 0
+    for p, sz in enumerate(part_sizes):
+        ids = perm[start : start + sz]
+        part_of_node[ids] = p
+        local_of_node[ids] = np.arange(sz)
+        start += sz
+
+    def _round_up(v, m):
+        return int(-(-v // m) * m)
+
+    nl = _round_up(max(part_sizes) + 1, node_multiple)
+
+    # edge ownership by receiver
+    send_g, recv_g = edge_index[0], edge_index[1]
+    e_part = part_of_node[recv_g]
+    e_counts = np.bincount(e_part, minlength=P)
+    el = _round_up(max(int(e_counts.max()), 1), edge_multiple)
+
+    # halo: for each (owner p -> consumer q) the unique remote senders
+    remote = part_of_node[send_g] != e_part
+    halo_slot = {}  # (q, p, global sender) -> h
+    halo_lists = [[[] for _ in range(P)] for _ in range(P)]  # [p][q] -> locals of p
+    for idx in np.nonzero(remote)[0]:
+        q = int(e_part[idx])
+        p = int(part_of_node[send_g[idx]])
+        key = (q, p, int(send_g[idx]))
+        if key not in halo_slot:
+            halo_slot[key] = len(halo_lists[p][q])
+            halo_lists[p][q].append(int(local_of_node[send_g[idx]]))
+    max_h = max(
+        (len(halo_lists[p][q]) for p in range(P) for q in range(P)), default=0
+    )
+    halo = _round_up(max(max_h, 1), halo_multiple)
+
+    # ---- per-part arrays -------------------------------------------------
+    F = x.shape[1]
+    xs = np.zeros((P, nl, F), np.float32)
+    ps = np.zeros((P, nl, 3), np.float32)
+    node_graph = np.full((P, nl), 1, np.int32)
+    node_mask = np.zeros((P, nl), bool)
+    n_node = np.zeros((P, 2), np.int32)
+    n_edge = np.zeros((P, 2), np.int32)
+    graph_mask = np.zeros((P, 2), bool)
+    senders = np.full((P, el), nl - 1, np.int32)
+    receivers = np.full((P, el), nl - 1, np.int32)
+    edge_mask = np.zeros((P, el), bool)
+    e_attr = (
+        np.zeros((P, el, edge_attr.shape[1]), np.float32)
+        if edge_attr is not None
+        else None
+    )
+    # padded slots point at the dummy row so halo_reduce's scatter-add and
+    # halo_extend's sends never touch a real node
+    halo_send = np.full((P, P, halo), nl - 1, np.int32)
+    nig = np.zeros((P, nl), np.int32)  # node_index_in_graph (global position)
+
+    for p in range(P):
+        ids = np.nonzero(part_of_node == p)[0]
+        order_ids = ids[np.argsort(local_of_node[ids])]
+        sz = order_ids.shape[0]
+        xs[p, :sz] = x[order_ids]
+        ps[p, :sz] = pos[order_ids]
+        node_graph[p, :sz] = 0
+        node_mask[p, :sz] = True
+        nig[p, :sz] = order_ids
+        n_node[p, 0] = n  # GLOBAL count: local pool sums psum to the true mean
+        n_node[p, 1] = nl - sz
+        graph_mask[p, 0] = True
+        for q in range(P):
+            lst = halo_lists[p][q]
+            if lst:
+                halo_send[p, q, : len(lst)] = np.asarray(lst, np.int32)
+
+    for p in range(P):
+        eidx = np.nonzero(e_part == p)[0]
+        k = eidx.shape[0]
+        r_loc = local_of_node[recv_g[eidx]].astype(np.int32)
+        s_parts = part_of_node[send_g[eidx]]
+        s_loc = np.empty(k, np.int32)
+        local_mask = s_parts == p
+        s_loc[local_mask] = local_of_node[send_g[eidx[local_mask]]].astype(np.int32)
+        for j in np.nonzero(~local_mask)[0]:
+            sp = int(s_parts[j])
+            h = halo_slot[(p, sp, int(send_g[eidx[j]]))]
+            s_loc[j] = nl + sp * halo + h
+        senders[p, :k] = s_loc
+        receivers[p, :k] = r_loc
+        edge_mask[p, :k] = True
+        n_edge[p, 0] = k
+        n_edge[p, 1] = el - k
+        if e_attr is not None:
+            e_attr[p, :k] = edge_attr[eidx]
+
+    # ---- targets ---------------------------------------------------------
+    targets = []
+    for ih, (t, d) in enumerate(zip(head_types, head_dims)):
+        tgt = np.asarray(sample.targets[ih], np.float32)
+        if t == "graph":
+            arr = np.zeros((P, 2, d), np.float32)
+            arr[:, 0] = tgt.reshape(-1)
+        else:
+            arr = np.zeros((P, nl, d), np.float32)
+            for p in range(P):
+                ids = np.nonzero(part_of_node == p)[0]
+                order_ids = ids[np.argsort(local_of_node[ids])]
+                arr[p, : order_ids.shape[0]] = tgt[order_ids].reshape(-1, d)
+        targets.append(arr)
+
+    def flat(a):
+        return a.reshape((-1,) + a.shape[2:])
+
+    batch = GraphBatch(
+        x=flat(xs),
+        pos=flat(ps),
+        senders=flat(senders),
+        receivers=flat(receivers),
+        edge_attr=flat(e_attr) if e_attr is not None else None,
+        node_graph=flat(node_graph),
+        n_node=flat(n_node),
+        n_edge=flat(n_edge),
+        node_mask=flat(node_mask),
+        edge_mask=flat(edge_mask),
+        graph_mask=flat(graph_mask),
+        targets=tuple(flat(t) for t in targets),
+        extras={
+            "halo_send": halo_send.reshape(P * P, halo),
+            "node_index_in_graph": flat(nig),
+        },
+    )
+    info = PartitionInfo(
+        P, nl, el, halo, perm, part_of_node, local_of_node, n
+    )
+    return batch, info
+
+
+# --------------------------------------------------------------------------
+# shard_map step builders
+# --------------------------------------------------------------------------
+
+
+def _batch_spec(batch, axis):
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(lambda _: P(axis), batch)
+
+
+def put_partitioned_batch(batch: GraphBatch, mesh, axis: str = "graph") -> GraphBatch:
+    """Device placement: every leaf sharded on axis 0 so each device holds
+    exactly its shard's rows."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(jnp.asarray(a), sharding), batch
+    )
+
+
+def make_partitioned_apply(model, mesh, axis: str = "graph"):
+    """Jitted partitioned forward: (variables, batch) -> per-shard outputs.
+
+    Graph-head rows come back replicated-identical on every shard; node-head
+    rows are per-shard (un-partition with ``PartitionInfo.gather_nodes``).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def fwd(variables, batch):
+        def shard_fn(variables, batch):
+            return model.apply(variables, batch, train=False)
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(), _batch_spec(batch, axis)),
+            out_specs=P(axis),
+            check_rep=False,
+        )(variables, batch)
+
+    return jax.jit(fwd)
+
+
+def make_partitioned_train_step(model, tx, mesh, axis: str = "graph"):
+    """One fused XLA program: partitioned forward + psum'd loss + backward
+    (all_to_all transposes inserted by AD) + grad psum + optimizer update.
+
+    The differentiated objective is the per-shard share ``loss / P`` — with
+    ``check_rep=False`` every collective transposes to its true adjoint, so
+    ``psum`` of the per-shard grads reconstructs the exact global gradient
+    (asserted against the single-device model in
+    ``tests/test_graph_partition.py``).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis_size = int(mesh.shape[axis])
+
+    def step(state, batch, rng):
+        def shard_fn(params, batch_stats, opt_state, step_no, batch, rng):
+            # decorrelate dropout masks across shards (rng enters replicated)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+            def loss_fn(p):
+                variables = {"params": p}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                    outputs, mut = model.apply(
+                        variables,
+                        batch,
+                        train=True,
+                        mutable=["batch_stats"],
+                        rngs={"dropout": rng},
+                    )
+                    new_bs = mut["batch_stats"]
+                else:
+                    outputs = model.apply(
+                        variables, batch, train=True, rngs={"dropout": rng}
+                    )
+                    new_bs = batch_stats
+                tot, tasks = model.loss(outputs, batch)
+                return tot / axis_size, (tuple(tasks), new_bs, tot)
+
+            (_, (tasks, new_bs, tot)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            grads = jax.lax.psum(grads, axis)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            import optax
+
+            new_params = optax.apply_updates(params, updates)
+            metrics = {
+                "loss": tot,
+                "tasks": jnp.stack(tasks) if tasks else jnp.zeros((0,)),
+            }
+            return new_params, new_bs, new_opt, step_no + 1, metrics
+
+        new_params, new_bs, new_opt, step_no, metrics = shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                P(),
+                P(),
+                P(),
+                P(),
+                _batch_spec(batch, axis),
+                P(),
+            ),
+            out_specs=(P(), P(), P(), P(), P()),
+            check_rep=False,
+        )(state.params, state.batch_stats, state.opt_state, state.step, batch, rng)
+        return (
+            state.replace(
+                params=new_params,
+                batch_stats=new_bs,
+                opt_state=new_opt,
+                step=step_no,
+            ),
+            metrics,
+        )
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_partitioned_eval_step(model, mesh, axis: str = "graph"):
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def eval_step(params, batch_stats, batch):
+        def shard_fn(params, batch_stats, batch):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+            outputs = model.apply(variables, batch, train=False)
+            tot, tasks = model.loss(outputs, batch)
+            return {
+                "loss": tot,
+                "tasks": jnp.stack(tasks) if tasks else jnp.zeros((0,)),
+                "outputs": outputs,
+            }
+
+        return shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(), P(), _batch_spec(batch, axis)),
+            out_specs={
+                "loss": P(),
+                "tasks": P(),
+                "outputs": jax.tree_util.tree_map(
+                    lambda _: P(axis), tuple(range(model.num_heads))
+                ),
+            },
+            check_rep=False,
+        )(params, batch_stats, batch)
+
+    return jax.jit(eval_step)
