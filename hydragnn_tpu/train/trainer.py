@@ -77,8 +77,14 @@ class Trainer:
 
     # ---- state ---------------------------------------------------------
     def init_state(self, example_batch: GraphBatch, seed: int = 0) -> TrainState:
-        example_batch = self.put_batch(example_batch)
-        variables = init_model_params(self.model, example_batch, seed=seed)
+        if self.mesh is None or jax.process_count() == 1:
+            init_batch = self.put_batch(example_batch)
+        else:
+            # multi-host: init on a process-local copy — parameters depend
+            # only on shapes and the seed, so every process derives identical
+            # values (flax init cannot trace non-addressable global shards)
+            init_batch = jax.tree_util.tree_map(jnp.asarray, example_batch)
+        variables = init_model_params(self.model, init_batch, seed=seed)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         self.tx = select_optimizer(
@@ -94,25 +100,48 @@ class Trainer:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            replicated = NamedSharding(self.mesh, P())
-            state = jax.device_put(state, replicated)
+            if jax.process_count() > 1:
+                # replicated GLOBAL arrays assembled from the (identical)
+                # host-local values on every process
+                from jax.experimental import multihost_utils
+
+                state = jax.tree_util.tree_map(np.asarray, state)
+                state = multihost_utils.host_local_array_to_global_array(
+                    state, self.mesh, P()
+                )
+            else:
+                state = jax.device_put(state, NamedSharding(self.mesh, P()))
         self._build_steps()
         return state
 
     def put_batch(self, batch: GraphBatch) -> GraphBatch:
         """Host batch -> device(s). Under a mesh, every leading axis (nodes /
         edges / graphs / triplets) is sharded over the ``data`` axis — the
-        layout pads each to a multiple of the axis size."""
-        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        layout pads each to a multiple of the axis size.
+
+        Multi-host (``jax.process_count() > 1``): each process passes ITS
+        loader's local shard (the DistributedSampler split) and the global
+        sharded batch is assembled with ``make_array_from_process_local_data``
+        — the reference's per-rank DataLoader semantics
+        (``preprocess/load_data.py:237-245``) with XLA owning the transport.
+        """
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             if self._batch_sharding is None:
                 self._batch_sharding = NamedSharding(self.mesh, P("data"))
-            batch = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, self._batch_sharding), batch
+            if jax.process_count() > 1:
+                return jax.tree_util.tree_map(
+                    lambda a: jax.make_array_from_process_local_data(
+                        self._batch_sharding, np.asarray(a)
+                    ),
+                    batch,
+                )
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
+                batch,
             )
-        return batch
+        return jax.tree_util.tree_map(jnp.asarray, batch)
 
     # ---- compiled steps ------------------------------------------------
     def _build_steps(self):
@@ -243,7 +272,21 @@ class Trainer:
             t = np.asarray(metrics["tasks"]) * g
             tasks = t if tasks is None else tasks + t
             n += g
-            outputs = jax.device_get(metrics["outputs"])
+            outputs = metrics["outputs"]
+            if self.mesh is not None and jax.process_count() > 1:
+                # global data-sharded arrays span non-addressable devices;
+                # bring back THIS process's shard — rows then line up with
+                # the local host batch masks (per-rank collection, like the
+                # reference's per-rank test() loop)
+                from jax.experimental import multihost_utils
+                from jax.sharding import PartitionSpec as P
+
+                outputs = multihost_utils.global_array_to_host_local_array(
+                    outputs, self.mesh, jax.tree_util.tree_map(
+                        lambda _: P("data"), outputs
+                    )
+                )
+            outputs = jax.device_get(outputs)
             graph_mask = np.asarray(batch.graph_mask)
             node_mask = np.asarray(batch.node_mask)
             for ihead in range(num_heads):

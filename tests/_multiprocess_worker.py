@@ -1,0 +1,146 @@
+"""Worker for the multi-process distributed test (NOT a pytest module).
+
+Each process: 2 virtual CPU devices, `jax.distributed` bootstrap through the
+framework's env-var path, host-side collectives, then a REAL data-parallel
+training step on the global cross-process mesh with per-process local batch
+shards — the reference's `mpirun -n 2 --with-mpi` CI story (SURVEY.md §4)
+without MPI.
+
+Usage: python _multiprocess_worker.py <proc_id> <num_procs> <port>
+"""
+
+import os
+import sys
+
+
+def main():
+    proc_id, num_procs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["HYDRAGNN_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["HYDRAGNN_TPU_NUM_PROCESSES"] = str(num_procs)
+    os.environ["HYDRAGNN_TPU_PROCESS_ID"] = str(proc_id)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+
+    from hydragnn_tpu.parallel.distributed import (
+        host_allreduce,
+        setup_distributed,
+    )
+
+    world, rank = setup_distributed()
+    assert world == num_procs, f"world {world} != {num_procs}"
+    assert rank == proc_id, f"rank {rank} != {proc_id}"
+    assert len(jax.devices()) == 2 * num_procs, jax.devices()
+
+    # host-side collective (data-plane statistics path)
+    total = host_allreduce(np.array([float(rank + 1)]), "sum")
+    expect = num_procs * (num_procs + 1) / 2
+    assert float(total[0]) == expect, (total, expect)
+
+    # ---- real sharded training step over the global mesh ----------------
+    from hydragnn_tpu.graph import collate_graphs, pad_sizes_for
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.train.trainer import Trainer
+
+    class _S:
+        pass
+
+    def samples(num, seed):
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(num):
+            n = 6
+            s = _S()
+            s.x = rng.random((n, 1)).astype(np.float32)
+            s.pos = rng.random((n, 3)).astype(np.float32)
+            src = np.arange(n)
+            dst = (src + 1) % n
+            s.edge_index = np.stack(
+                [np.concatenate([src, dst]), np.concatenate([dst, src])]
+            ).astype(np.int64)
+            s.edge_attr = None
+            s.targets = [np.array([s.x.sum()], np.float32), s.x.copy()]
+            out.append(s)
+        return out
+
+    # every process collates ITS OWN local shard (different data per rank);
+    # put_batch assembles the global array from the local shards
+    local_graphs = 4
+    n_pad, e_pad, g_pad = pad_sizes_for(
+        6, 12, local_graphs, node_multiple=8, edge_multiple=8, graph_multiple=8
+    )
+    batch = collate_graphs(
+        samples(local_graphs, seed=100 + rank),
+        n_pad,
+        e_pad,
+        g_pad,
+        head_types=("graph", "node"),
+        head_dims=(1, 1),
+    )
+
+    model = create_model_config(
+        {
+            "model_type": "GIN",
+            "input_dim": 1,
+            "hidden_dim": 8,
+            "output_dim": [1, 1],
+            "output_type": ["graph", "node"],
+            "output_heads": {
+                "graph": {
+                    "num_sharedlayers": 1,
+                    "dim_sharedlayers": 8,
+                    "num_headlayers": 1,
+                    "dim_headlayers": [8],
+                },
+                "node": {
+                    "num_headlayers": 1,
+                    "dim_headlayers": [8],
+                    "type": "mlp",
+                },
+            },
+            "task_weights": [1.0, 1.0],
+            "num_conv_layers": 2,
+        }
+    )
+    mesh = make_mesh(None, "data")  # all 2*num_procs global devices
+    trainer = Trainer(
+        model,
+        training_config={"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}},
+        mesh=mesh,
+    )
+    state = trainer.init_state(batch)
+    dev_batch = trainer.put_batch(batch)
+    state, metrics = trainer._train_step(state, dev_batch, jax.random.PRNGKey(0))
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), loss
+
+    # the loss is a global reduction — every process must agree exactly
+    agree = host_allreduce(np.array([loss]), "max")
+    assert abs(float(agree[0]) - loss) < 1e-6, (agree, loss)
+
+    # multi-host predict: each process collects its OWN shard's samples
+    class _Loader(list):
+        dataset = ()
+
+    _, _, true_vals, pred_vals = trainer.predict(state, _Loader([batch]))
+    assert true_vals[0].shape[0] == local_graphs, true_vals[0].shape
+    assert true_vals[1].shape[0] == local_graphs * 6, true_vals[1].shape
+    assert pred_vals[0].shape == true_vals[0].shape
+
+    print(f"MPOK rank={rank} world={world} loss={loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
