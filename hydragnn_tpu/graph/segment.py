@@ -42,18 +42,24 @@ def segment_mean(data, segment_ids, num_segments):
     return total / count.reshape((-1,) + (1,) * (data.ndim - 1))
 
 
-def segment_max(data, segment_ids, num_segments, fill=0.0):
+def segment_max(data, segment_ids, num_segments, fill=0.0, has=None):
     """Max per segment; empty segments get ``fill`` (reference semantics: padded
-    nodes should see 0, not -inf, so downstream matmuls stay finite)."""
+    nodes should see 0, not -inf, so downstream matmuls stay finite).
+
+    ``has``: optional precomputed [num_segments]-ish non-empty mask — callers
+    that already ran a counting scatter (PNA's fused moments pass) supply it
+    to avoid a redundant segment_count scatter."""
     out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
-    has = segment_count(segment_ids, num_segments) > 0
+    if has is None:
+        has = segment_count(segment_ids, num_segments) > 0
     has = has.reshape((-1,) + (1,) * (data.ndim - 1))
     return jnp.where(has, jnp.where(jnp.isfinite(out), out, fill), fill)
 
 
-def segment_min(data, segment_ids, num_segments, fill=0.0):
+def segment_min(data, segment_ids, num_segments, fill=0.0, has=None):
     out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
-    has = segment_count(segment_ids, num_segments) > 0
+    if has is None:
+        has = segment_count(segment_ids, num_segments) > 0
     has = has.reshape((-1,) + (1,) * (data.ndim - 1))
     return jnp.where(has, jnp.where(jnp.isfinite(out), out, fill), fill)
 
