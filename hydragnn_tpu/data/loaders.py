@@ -169,7 +169,14 @@ class ConcatDataset:
 
 class GraphLoader:
     """Iterates padded batches; DistributedSampler-style sharding + epoch
-    shuffling (``load_data.py:237-245``, ``train_validate_test.py:151-153``)."""
+    shuffling (``load_data.py:237-245``, ``train_validate_test.py:151-153``).
+
+    ``prefetch > 0`` collates ahead on a background thread (bounded queue) so
+    host-side batch assembly overlaps the device step — the role of the
+    reference's thread-pool ``HydraDataLoader`` (``load_data.py:94-204``);
+    XLA's async dispatch provides the other half of the overlap. The
+    ``HYDRAGNN_PREFETCH`` env var sets the default depth.
+    """
 
     def __init__(
         self,
@@ -180,6 +187,7 @@ class GraphLoader:
         seed: int = 42,
         num_shards: Optional[int] = None,
         shard_id: Optional[int] = None,
+        prefetch: Optional[int] = None,
     ):
         from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
@@ -192,6 +200,9 @@ class GraphLoader:
         self.epoch = 0
         self.num_shards = world if num_shards is None else num_shards
         self.shard_id = rank if shard_id is None else shard_id
+        if prefetch is None:
+            prefetch = int(os.getenv("HYDRAGNN_PREFETCH", "0"))
+        self.prefetch = prefetch
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -214,11 +225,69 @@ class GraphLoader:
         n = len(self._indices())
         return -(-n // self.batch_size)
 
-    def __iter__(self):
+    def _batches(self):
         idx = self._indices()
         for start in range(0, len(idx), self.batch_size):
             chunk = [self.dataset[i] for i in idx[start : start + self.batch_size]]
             yield _collate_with_extras(chunk, self.layout)
+
+    def __iter__(self):
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        stop = threading.Event()
+        err = []
+
+        def worker():
+            try:
+                for b in self._batches():
+                    # bounded put that notices consumer abandonment, so an
+                    # early `break` in the epoch loop (HYDRAGNN_MAX_NUM_BATCH
+                    # cap) cannot leak a thread pinning collated batches
+                    while not stop.is_set():
+                        try:
+                            q.put(b, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surface collate errors on the consumer
+                err.append(e)
+            finally:
+                # stop-aware sentinel delivery: on abandonment nobody reads
+                # it and a blocking put could wedge on a full queue
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=worker, daemon=True, name="graphloader-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            stop.set()
+            # unblock a worker stuck on a full queue, then reap it
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join()
+        if err:
+            raise err[0]
 
 
 def create_dataloaders(

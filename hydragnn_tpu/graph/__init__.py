@@ -6,5 +6,6 @@ from hydragnn_tpu.graph.segment import (
     segment_min,
     segment_std,
     segment_softmax,
+    segment_moments_fused,
     segment_count,
 )

@@ -76,6 +76,26 @@ def segment_std(data, segment_ids, num_segments, eps=1e-5):
     return jnp.sqrt(var + eps)
 
 
+def segment_moments_fused(data, segment_ids, num_segments, weights=None):
+    """(sum, count, sum_of_squares) per segment from ONE scatter pass.
+
+    XLA fallback counterpart of the pallas ``segment_moments`` kernel: packs
+    data / data^2 / count-weights on the feature axis so a single segment
+    scatter produces all three statistics (scatter passes, not flops, are
+    the hot cost at small-graph scale — measured on v5e, bench.py).
+    ``weights``: optional [E] count weights (e.g. an edge mask).
+    """
+    d = data.shape[1]
+    w = (
+        jnp.ones((data.shape[0],), jnp.float32)
+        if weights is None
+        else weights.astype(jnp.float32)
+    )
+    packed = jnp.concatenate([data, data * data, w[:, None]], axis=-1)
+    s = segment_sum(packed, segment_ids, num_segments)
+    return s[:, :d], s[:, -1:], s[:, d : 2 * d]
+
+
 def segment_softmax(logits, segment_ids, num_segments, mask=None):
     """Numerically-stable softmax within segments (GAT edge attention).
 

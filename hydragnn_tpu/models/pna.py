@@ -15,11 +15,10 @@ messages and the padded-degree clamp keeps the log-scalers finite.
 import math
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from hydragnn_tpu.graph import segment_max, segment_min, segment_sum
+from hydragnn_tpu.graph import segment_max, segment_min
 from hydragnn_tpu.models.base import HydraBase
 from hydragnn_tpu.models.common import TorchLinear
 
@@ -58,35 +57,23 @@ class PNAConv(nn.Module):
         h = TorchLinear(self.in_dim, name="pre_nn")(h)
         h = jnp.where(batch.edge_mask[:, None], h, 0.0)
 
+        from hydragnn_tpu.graph import segment_moments_fused
         from hydragnn_tpu.ops import pallas_segments_enabled, segment_moments
 
+        # mean/std/degree from ONE pass over the messages — pallas kernel or
+        # the packed-scatter XLA fallback (padded edges target the padding
+        # node / carry zero weight, so real-node statistics are untouched)
         if pallas_segments_enabled(n, h.shape[1], n_outputs=2):
-            # fused kernel: mean/std/degree from ONE pass over the messages
-            # (padded edges target the padding node, so real-node statistics
-            # are untouched and the padding node is masked downstream)
             s, cnt, sq = segment_moments(h, batch.receivers, n)
-            has = cnt > 0
-            cnt = jnp.maximum(cnt, 1.0)
-            mean = s / cnt
-            std = jnp.sqrt(jnp.maximum(sq / cnt - mean * mean, 0.0) + 1e-5)
-            deg = cnt
         else:
-            # ONE scatter pass for sum / sum-of-squares / degree (packed on
-            # the feature axis), instead of separate mean+std+count scatters
-            # — XLA's segment scatter is the hot op at QM9 scale, so pass
-            # count matters more than flop count.
-            d = h.shape[1]
-            packed = jnp.concatenate(
-                [h, h * h, batch.edge_mask.astype(jnp.float32)[:, None]], axis=-1
+            s, cnt, sq = segment_moments_fused(
+                h, batch.receivers, n, weights=batch.edge_mask
             )
-            s = segment_sum(packed, batch.receivers, n)
-            has = s[:, -1:] > 0
-            deg = jnp.maximum(s[:, -1:], 1.0)
-            mean = s[:, :d] / deg
-            # PNA std numerics: sqrt(relu(E[x^2]-E[x]^2)+eps), see segment_std
-            std = jnp.sqrt(
-                jax.nn.relu(s[:, d : 2 * d] / deg - mean * mean) + 1e-5
-            )
+        has = cnt > 0
+        deg = jnp.maximum(cnt, 1.0)
+        mean = s / deg
+        # PNA std numerics: sqrt(relu(E[x^2]-E[x]^2)+eps), see segment_std
+        std = jnp.sqrt(jnp.maximum(sq / deg - mean * mean, 0.0) + 1e-5)
         aggr = jnp.concatenate(
             [
                 mean,

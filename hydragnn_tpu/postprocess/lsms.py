@@ -1,0 +1,220 @@
+"""LSMS post-processing: formation-energy conversion + composition cutoff.
+
+Capability parity with the reference's top-level ``utils/lsms`` scripts
+(``convert_total_energy_to_formation_gibbs.py``,
+``compositional_histogram_cutoff.py``): binary-alloy LSMS text datasets
+(one header line holding the total energy, then one row per atom) are
+(a) rewritten with total energy replaced by formation Gibbs energy, and
+(b) down-selected to at most N samples per composition bin.
+
+Pure host-side numpy; plots are optional (matplotlib gated).
+"""
+
+import math
+import os
+import shutil
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# LSMS energies are Rydberg; entropy needs k_B in those units
+_KB_JOULE_PER_KELVIN = 1.380649e-23
+_JOULE_TO_RYDBERG = 4.5874208973812e17
+_KB_RYDBERG_PER_KELVIN = _KB_JOULE_PER_KELVIN * _JOULE_TO_RYDBERG
+
+
+def _read_lsms(path: str) -> Tuple[str, List[str], np.ndarray]:
+    """(total_energy_token, raw_lines, atoms[n, cols]) from an LSMS file:
+    header line starts with the total energy, atom rows follow."""
+    with open(path) as f:
+        lines = f.readlines()
+    energy_token = lines[0].split()[0]
+    atoms = np.loadtxt(lines[1:])
+    if atoms.ndim == 1:
+        atoms = atoms[None, :]
+    return energy_token, lines, atoms
+
+
+def _binary_composition(
+    atoms: np.ndarray, elements_list: Sequence[float]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(elements, counts) over the sorted binary element list, zero-filled
+    for missing (pure-phase) species; asserts no foreign elements."""
+    elements, counts = np.unique(atoms[:, 0], return_counts=True)
+    for e in elements:
+        assert e in elements_list, (
+            f"sample contains element {e} not in the binary {elements_list}"
+        )
+    for i, elem in enumerate(elements_list):
+        if elem not in elements:
+            elements = np.insert(elements, i, elem)
+            counts = np.insert(counts, i, 0)
+    return elements, counts
+
+
+def compute_formation_enthalpy(
+    elements_list: Sequence[float],
+    pure_elements_energy: Dict[float, float],
+    total_energy: float,
+    atoms: np.ndarray,
+):
+    """(composition_of_element1, linear_mixing_energy, formation_enthalpy,
+    mixing_entropy) for one binary-alloy configuration.
+
+    formation enthalpy = total energy minus the composition-weighted linear
+    mix of the pure-phase per-atom energies; the entropy term is the ideal
+    mixing (binomial) entropy in Rydberg/K.
+    """
+    elements, counts = _binary_composition(atoms, elements_list)
+    num_atoms = atoms.shape[0]
+    composition = counts[0] / num_atoms
+    linear_mixing_energy = (
+        pure_elements_energy[elements[0]] * composition
+        + pure_elements_energy[elements[1]] * (1.0 - composition)
+    ) * num_atoms
+    formation_enthalpy = total_energy - linear_mixing_energy
+    # thermodynamic (not statistical) mixing entropy: k_B ln C(n, n_1)
+    entropy = _KB_RYDBERG_PER_KELVIN * (
+        math.lgamma(num_atoms + 1)
+        - math.lgamma(counts[0] + 1)
+        - math.lgamma(num_atoms - counts[0] + 1)
+    )
+    return composition, linear_mixing_energy, formation_enthalpy, entropy
+
+
+def convert_raw_data_energy_to_gibbs(
+    dir: str,
+    elements_list: Sequence[float],
+    temperature_kelvin: float = 0.0,
+    overwrite_data: bool = False,
+    create_plots: bool = True,
+):
+    """Rewrite every LSMS file with total energy -> formation Gibbs energy.
+
+    Output lands in ``<dir>_gibbs_energy/``. Requires the dataset to contain
+    the two pure-phase configurations (their per-atom energies anchor the
+    linear mixing line). Binary alloys only, like the reference.
+    """
+    dir = dir.rstrip("/")
+    new_dir = dir + "_gibbs_energy/"
+    if os.path.exists(new_dir) and overwrite_data:
+        shutil.rmtree(new_dir)
+    os.makedirs(new_dir, exist_ok=True)
+
+    elements_list = sorted(elements_list)
+    all_files = sorted(os.listdir(dir))
+
+    # pass 1: pure-phase per-atom energies
+    pure_elements_energy: Dict[float, float] = {}
+    for filename in all_files:
+        energy_token, _, atoms = _read_lsms(os.path.join(dir, filename))
+        species = np.unique(atoms[:, 0])
+        if len(species) == 1:
+            pure_elements_energy[species[0]] = float(energy_token) / atoms.shape[0]
+    assert len(pure_elements_energy) == 2, (
+        "need both pure-element configurations to anchor the mixing line"
+    )
+
+    # pass 2: convert + rewrite
+    comps = np.zeros(len(all_files))
+    enthalpies = np.zeros(len(all_files))
+    gibbs = np.zeros(len(all_files))
+    for i, filename in enumerate(all_files):
+        path = os.path.join(dir, filename)
+        energy_token, lines, atoms = _read_lsms(path)
+        comp, _lin, enthalpy, entropy = compute_formation_enthalpy(
+            elements_list, pure_elements_energy, float(energy_token), atoms
+        )
+        g = enthalpy - temperature_kelvin * entropy
+        comps[i], enthalpies[i], gibbs[i] = comp, enthalpy, g
+        lines[0] = lines[0].replace(energy_token, str(g))
+        with open(os.path.join(new_dir, filename), "w") as f:
+            f.write("".join(lines))
+
+    print("Min formation enthalpy: ", float(gibbs.min()))
+    print("Max formation enthalpy: ", float(gibbs.max()))
+
+    if create_plots:
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except Exception:
+            return gibbs
+        for values, ylabel, fname in (
+            (enthalpies, "Formation enthalpy (Rydberg)", "formation_enthalpy.png"),
+            (gibbs, "Formation Gibbs energy (Rydberg)", "formation_gibbs_energy.png"),
+        ):
+            plt.figure()
+            plt.scatter(comps, values, edgecolor="b", facecolor="none")
+            plt.xlabel("Concentration")
+            plt.ylabel(ylabel)
+            plt.savefig(fname)
+            plt.close()
+    return gibbs
+
+
+def find_bin(comp: float, nbins: int) -> int:
+    """Composition bin index over [0, 1] (reference semantics: open interval
+    membership, overflow to the last bin)."""
+    bins = np.linspace(0, 1, nbins)
+    for bi in range(len(bins) - 1):
+        if bins[bi] < comp < bins[bi + 1]:
+            return bi
+    return nbins - 1
+
+
+def compositional_histogram_cutoff(
+    dir: str,
+    elements_list: Sequence[float],
+    histogram_cutoff: int,
+    num_bins: int,
+    overwrite_data: bool = False,
+    create_plots: bool = True,
+):
+    """Down-select LSMS data: fewer than ``histogram_cutoff`` samples per
+    composition bin (increment-then-compare, i.e. a bin saturates at
+    ``histogram_cutoff - 1`` — reference semantics), symlinked into
+    ``<dir>_histogram_cutoff/``."""
+    dir = dir.rstrip("/")
+    new_dir = dir + "_histogram_cutoff/"
+    if os.path.exists(new_dir):
+        if overwrite_data:
+            shutil.rmtree(new_dir)
+        else:
+            print("Exiting: path to histogram cutoff data already exists")
+            return None
+    os.makedirs(new_dir, exist_ok=True)
+
+    elements_list = sorted(elements_list)
+    kept_comps = []
+    per_bin = np.zeros(num_bins)
+    for filename in sorted(os.listdir(dir)):
+        path = os.path.join(dir, filename)
+        _, _, atoms = _read_lsms(path)
+        _, counts = _binary_composition(atoms, elements_list)
+        composition = counts[0] / atoms.shape[0]
+        b = find_bin(composition, num_bins)
+        per_bin[b] += 1
+        if per_bin[b] < histogram_cutoff:
+            kept_comps.append(composition)
+            os.symlink(os.path.abspath(path), os.path.join(new_dir, filename))
+
+    if create_plots:
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except Exception:
+            return kept_comps
+        plt.figure()
+        plt.hist(kept_comps, bins=num_bins)
+        plt.savefig("composition_histogram_cutoff.png")
+        plt.close()
+        plt.figure()
+        plt.bar(np.linspace(0, 1, num_bins), per_bin, width=1.0 / num_bins)
+        plt.savefig("composition_initial.png")
+        plt.close()
+    return kept_comps

@@ -1,0 +1,99 @@
+"""LSMS post-processing utilities: formation Gibbs conversion + composition
+cutoff (capability parity with the reference's ``utils/lsms`` scripts)."""
+
+import math
+import os
+
+import numpy as np
+
+from hydragnn_tpu.postprocess.lsms import (
+    compositional_histogram_cutoff,
+    compute_formation_enthalpy,
+    convert_raw_data_energy_to_gibbs,
+    find_bin,
+)
+
+
+def _write_lsms(path, total_energy, species, n_cols=5):
+    """One header line (total energy first token), then one row per atom."""
+    rows = [
+        " ".join([str(s)] + ["0.0"] * (n_cols - 1)) for s in species
+    ]
+    with open(path, "w") as f:
+        f.write(f"{total_energy} extra header tokens\n")
+        f.write("\n".join(rows) + "\n")
+
+
+def _make_dataset(tmpdir):
+    d = os.path.join(tmpdir, "raw")
+    os.makedirs(d)
+    # pure phases anchor the mixing line: per-atom energies -1.0 and -2.0
+    _write_lsms(os.path.join(d, "pure_a.txt"), -4.0, [26.0] * 4)
+    _write_lsms(os.path.join(d, "pure_b.txt"), -8.0, [78.0] * 4)
+    # mixed: 1 Fe + 3 Pt, total -7.6 -> enthalpy = -7.6 - (0.25*-1 + 0.75*-2)*4
+    _write_lsms(os.path.join(d, "mix.txt"), -7.6, [26.0, 78.0, 78.0, 78.0])
+    return d
+
+
+def pytest_formation_enthalpy_values():
+    pure = {26.0: -1.0, 78.0: -2.0}
+    atoms = np.array([[26.0, 0, 0], [78.0, 0, 0], [78.0, 0, 0], [78.0, 0, 0]])
+    comp, lin, enthalpy, entropy = compute_formation_enthalpy(
+        [26.0, 78.0], pure, -7.6, atoms
+    )
+    assert comp == 0.25
+    np.testing.assert_allclose(lin, (-1.0 * 0.25 + -2.0 * 0.75) * 4)
+    np.testing.assert_allclose(enthalpy, -7.6 - lin)
+    # ideal mixing entropy: k_B ln C(4,1)
+    np.testing.assert_allclose(
+        entropy / (1.380649e-23 * 4.5874208973812e17), math.log(4.0), rtol=1e-12
+    )
+
+
+def pytest_gibbs_conversion_roundtrip(tmp_path):
+    d = _make_dataset(str(tmp_path))
+    gibbs = convert_raw_data_energy_to_gibbs(
+        d, [26.0, 78.0], temperature_kelvin=0.0, create_plots=False
+    )
+    out = d + "_gibbs_energy/"
+    assert sorted(os.listdir(out)) == ["mix.txt", "pure_a.txt", "pure_b.txt"]
+    # pure phases sit ON the mixing line: formation energy 0
+    with open(os.path.join(out, "pure_a.txt")) as f:
+        assert float(f.readline().split()[0]) == 0.0
+    # the mixed sample: -7.6 - (-7.0) = -0.6
+    with open(os.path.join(out, "mix.txt")) as f:
+        np.testing.assert_allclose(float(f.readline().split()[0]), -0.6)
+    # atom rows preserved
+    with open(os.path.join(out, "mix.txt")) as f:
+        assert len(f.readlines()) == 5
+    np.testing.assert_allclose(sorted(gibbs), [-0.6, 0.0, 0.0], atol=1e-12)
+
+
+def pytest_histogram_cutoff(tmp_path):
+    d = os.path.join(str(tmp_path), "raw")
+    os.makedirs(d)
+    # 5 samples at composition 0.25, 1 at 0.5
+    for i in range(5):
+        _write_lsms(
+            os.path.join(d, f"c25_{i}.txt"), -1.0, [26.0, 78.0, 78.0, 78.0]
+        )
+    _write_lsms(os.path.join(d, "c50.txt"), -1.0, [26.0, 26.0, 78.0, 78.0])
+    kept = compositional_histogram_cutoff(
+        d, [26.0, 78.0], histogram_cutoff=3, num_bins=4, create_plots=False
+    )
+    out = d + "_histogram_cutoff/"
+    files = sorted(os.listdir(out))
+    # composition-0.25 bin capped below the cutoff; 0.5 sample kept
+    assert sum(f.startswith("c25") for f in files) == 2
+    assert "c50.txt" in files
+    assert len(kept) == len(files)
+    # symlinks resolve to the originals
+    for f in files:
+        assert os.path.isfile(os.path.join(out, f))
+
+
+def pytest_find_bin_edges():
+    assert find_bin(0.0, 4) == 3  # exact edge falls through to the last bin
+    assert find_bin(0.2, 4) == 0
+    assert find_bin(0.4, 4) == 1
+    assert find_bin(0.99, 4) == 2

@@ -1,0 +1,58 @@
+"""Prefetching GraphLoader: background-thread collation must be order- and
+content-identical to the synchronous path, and must propagate errors."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+
+
+def _dataset(n=13, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(3, 7))
+        src = np.arange(k)
+        dst = (src + 1) % k
+        g = GraphData(
+            x=rng.random((k, 2)).astype(np.float32),
+            pos=rng.random((k, 3)).astype(np.float32),
+            edge_index=np.stack(
+                [np.concatenate([src, dst]), np.concatenate([dst, src])]
+            ),
+            edge_attr=None,
+        )
+        g.targets = [np.array([1.0], np.float32), np.zeros((k, 1), np.float32)]
+        g.target_types = ["graph", "node"]
+        out.append(g)
+    return out
+
+
+def pytest_prefetch_matches_sync():
+    ds = _dataset()
+    layout = compute_layout([ds], batch_size=4, need_triplets=False)
+    sync = GraphLoader(ds, 4, layout, shuffle=True, prefetch=0)
+    pre = GraphLoader(ds, 4, layout, shuffle=True, prefetch=3)
+    sync.set_epoch(2)
+    pre.set_epoch(2)
+    a = list(sync)
+    b = list(pre)
+    assert len(a) == len(b) == len(sync)
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(ba.x), np.asarray(bb.x))
+        np.testing.assert_array_equal(
+            np.asarray(ba.senders), np.asarray(bb.senders)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ba.targets[1]), np.asarray(bb.targets[1])
+        )
+
+
+def pytest_prefetch_propagates_errors():
+    ds = _dataset(6)
+    layout = compute_layout([ds], batch_size=3, need_triplets=False)
+    loader = GraphLoader(ds, 3, layout, shuffle=False, prefetch=2)
+    ds[4] = None  # poison a sample the second batch will touch
+    with pytest.raises(Exception):
+        list(loader)
