@@ -72,11 +72,6 @@ def create_model_config(config: dict, verbosity: int = 0) -> HydraBase:
         partition_axis=config.get("partition_axis"),
     )
     edge_dim = config.get("edge_dim")
-    if common["partition_axis"] is not None and model_type == "DimeNet":
-        raise ValueError(
-            "DimeNet triplets need 2-hop halos; graph-partition mode is not "
-            "supported for DimeNet yet"
-        )
 
     if model_type == "GIN":
         return GINStack(**common)

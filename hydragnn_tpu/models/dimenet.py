@@ -117,9 +117,16 @@ class SphericalBasisLayer(nn.Module):
     envelope_exponent: int = 5
 
     @nn.compact
-    def __call__(self, dist, angle, idx_kj):
-        d = jnp.clip(dist / self.cutoff, 1e-6, 1.0)
-        env = Envelope(self.envelope_exponent)(d)[:, None]  # [E,1]
+    def __call__(self, dist, angle, idx_kj, dist_t=None):
+        """``dist_t``: optional per-TRIPLET k->j distances. The default path
+        evaluates the radial basis per edge and gathers at ``idx_kj``; in
+        graph-partition mode the (k->j) edge may live on another shard, so
+        the caller passes the triplet distances computed from halo-extended
+        positions and the gather disappears (identical numerics)."""
+        d = jnp.clip(
+            (dist if dist_t is None else dist_t) / self.cutoff, 1e-6, 1.0
+        )
+        env = Envelope(self.envelope_exponent)(d)[:, None]
         zeros = jnp.asarray(
             _BESSEL_ZEROS[: self.num_spherical, : self.num_radial],
             dtype=jnp.float32,
@@ -127,12 +134,14 @@ class SphericalBasisLayer(nn.Module):
         jl = _spherical_jn(self.num_spherical - 1, d[:, None, None] * zeros[None])
         rbf = jnp.stack(
             [jl[l][:, l, :] for l in range(self.num_spherical)], axis=1
-        )  # [E, S, R]
+        )  # [E or T, S, R]
         rbf = env[:, :, None] * rbf
         cbf = jnp.stack(
             _legendre(self.num_spherical - 1, jnp.cos(angle)), axis=1
         )  # [T, S]
-        out = rbf[idx_kj] * cbf[:, :, None]  # [T, S, R]
+        if dist_t is None:
+            rbf = rbf[idx_kj]  # [T, S, R]
+        out = rbf * cbf[:, :, None]
         return out.reshape(out.shape[0], self.num_spherical * self.num_radial)
 
 
@@ -162,11 +171,22 @@ class DimeNetConv(nn.Module):
     num_after_skip: int
     cutoff: float
     envelope_exponent: int
+    # graph-partition mode: the triplet aggregation gathers the STATES of
+    # (k->j) edges, which live on j's shard — an edge-level halo exchange
+    # (the 2-hop part of the halo; node positions of k ride the ordinary
+    # node halo, which the partitioner widens to 2 hops for triplets).
+    partition_axis: str = None
 
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
         act = jax.nn.silu
         ex = batch.extras
+        if ex is None or "trip_i" not in ex:
+            raise ValueError(
+                "DimeNet needs triplet index tables in batch.extras; build "
+                "batches with need_triplets=True (create_dataloaders / "
+                "partition_graph)"
+            )
         i, j = batch.receivers, batch.senders
         idx_i, idx_j, idx_k = ex["trip_i"], ex["trip_j"], ex["trip_k"]
         idx_kj, idx_ji = ex["trip_kj"], ex["trip_ji"]
@@ -187,13 +207,19 @@ class DimeNetConv(nn.Module):
         rbf = BesselBasisLayer(
             self.num_radial, self.cutoff, self.envelope_exponent, name="rbf"
         )(dist)
+        dist_t = None
+        if self.partition_axis is not None:
+            # per-triplet k->j distance from halo-extended positions (the
+            # (k->j) edge row itself may live on another shard)
+            dist_t = jnp.sqrt(((pos[idx_k] - pos[idx_j]) ** 2).sum(-1))
+            dist_t = jnp.where(trip_mask, dist_t, self.cutoff)
         sbf = SphericalBasisLayer(
             self.num_spherical,
             self.num_radial,
             self.cutoff,
             self.envelope_exponent,
             name="sbf",
-        )(dist, angle, idx_kj)
+        )(dist, angle, idx_kj, dist_t=dist_t)
         sbf = jnp.where(trip_mask[:, None], sbf, 0.0)
 
         # lin + embedding block (edge-level states)
@@ -214,6 +240,14 @@ class DimeNetConv(nn.Module):
         x_kj = act(TorchLinear(self.hidden_dim, name="int_lin_kj")(e))
         x_kj = x_kj * rbf_b
         x_kj = act(TorchLinear(self.int_emb_size, use_bias=False, name="int_down")(x_kj))
+        if self.partition_axis is not None:
+            from hydragnn_tpu.parallel.graph_partition import halo_extend
+
+            # extend the edge-state table with fresh (k->j) states from
+            # their owner shards; idx_kj already references this layout
+            x_kj = halo_extend(
+                x_kj, ex["halo_send_edges"], self.partition_axis
+            )
         x_kj = jnp.where(trip_mask[:, None], x_kj[idx_kj] * sbf_b, 0.0)
         x_kj = segment_sum(x_kj, idx_ji, num_edges)
         x_kj = act(TorchLinear(self.hidden_dim, use_bias=False, name="int_up")(x_kj))
@@ -267,6 +301,7 @@ class DIMEStack(HydraBase):
             num_after_skip=self.num_after_skip,
             cutoff=self.radius,
             envelope_exponent=self.envelope_exponent,
+            partition_axis=self.partition_axis,
         )
 
 

@@ -113,6 +113,59 @@ def _morton_order(pos: np.ndarray) -> np.ndarray:
     return np.argsort(code, kind="stable")
 
 
+class _HaloTable:
+    """Vectorized halo bookkeeping for one item kind (nodes or edges).
+
+    Built from (consumer part, global item id) request pairs; deduplicates,
+    assigns dense per-(owner, consumer) slots, and produces the ``[P, P, H]``
+    send table plus a vectorized ``extended_ids`` lookup — no Python loops
+    over items, so partitioning stays O(sort) for giant graphs.
+    """
+
+    def __init__(self, req_q, req_item, part_of, local_of, P, multiple, dummy):
+        num_items = part_of.shape[0]
+        req_q = np.asarray(req_q, np.int64)
+        req_item = np.asarray(req_item, np.int64)
+        owner = part_of[req_item]
+        remote = owner != req_q
+        key = req_q[remote] * num_items + req_item[remote]
+        uniq = np.unique(key)  # sorted
+        uq = uniq // num_items
+        uitem = uniq % num_items
+        up = part_of[uitem]
+        # dense slot index within each (owner p, consumer q) group
+        order = np.lexsort((uitem, uq, up))
+        sp, sq = up[order], uq[order]
+        change = np.r_[True, (sp[1:] != sp[:-1]) | (sq[1:] != sq[:-1])]
+        group_id = np.cumsum(change) - 1
+        group_start = np.nonzero(change)[0]
+        slot_sorted = np.arange(order.shape[0]) - group_start[group_id]
+        counts = np.bincount(group_id) if order.shape[0] else np.zeros(1, np.int64)
+        self.h = int(-(-max(int(counts.max()) if order.shape[0] else 0, 1) // multiple) * multiple)
+        self.send = np.full((P, P, self.h), dummy, np.int32)
+        self.send[sp, sq, slot_sorted] = local_of[uitem[order]].astype(np.int32)
+        self._uniq = uniq
+        self._slot = np.empty(uniq.shape[0], np.int64)
+        self._slot[order] = slot_sorted
+        self._num_items = num_items
+        self._part_of = part_of
+        self._local_of = local_of
+
+    def extended_ids(self, q, items, base: int) -> np.ndarray:
+        """Remap global item ids to consumer-local extended coordinates:
+        local id when owned by ``q``, else ``base + owner*H + slot``."""
+        q = np.asarray(q, np.int64)
+        items = np.asarray(items, np.int64)
+        owner = self._part_of[items]
+        out = self._local_of[items].astype(np.int64)
+        remote = owner != q
+        if remote.any():
+            key = q[remote] * self._num_items + items[remote]
+            idx = np.searchsorted(self._uniq, key)
+            out[remote] = base + owner[remote] * self.h + self._slot[idx]
+        return out.astype(np.int32)
+
+
 class PartitionInfo:
     """Static partition geometry + the inverse maps to un-partition outputs."""
 
@@ -142,6 +195,7 @@ def partition_graph(
     node_multiple: int = 8,
     edge_multiple: int = 8,
     halo_multiple: int = 8,
+    need_triplets: bool = False,
 ) -> Tuple[GraphBatch, PartitionInfo]:
     """Split one giant graph into ``num_parts`` static-shape shards.
 
@@ -198,21 +252,45 @@ def partition_graph(
     e_counts = np.bincount(e_part, minlength=P)
     el = _round_up(max(int(e_counts.max()), 1), edge_multiple)
 
-    # halo: for each (owner p -> consumer q) the unique remote senders
-    remote = part_of_node[send_g] != e_part
-    halo_slot = {}  # (q, p, global sender) -> h
-    halo_lists = [[[] for _ in range(P)] for _ in range(P)]  # [p][q] -> locals of p
-    for idx in np.nonzero(remote)[0]:
-        q = int(e_part[idx])
-        p = int(part_of_node[send_g[idx]])
-        key = (q, p, int(send_g[idx]))
-        if key not in halo_slot:
-            halo_slot[key] = len(halo_lists[p][q])
-            halo_lists[p][q].append(int(local_of_node[send_g[idx]]))
-    max_h = max(
-        (len(halo_lists[p][q]) for p in range(P) for q in range(P)), default=0
+    # local edge row of every global edge (receiver-owner layout; matches
+    # the ascending-nonzero order of the edge build loop below)
+    local_of_edge = np.empty(max(e, 1), dtype=np.int64)
+    for p in range(P):
+        eidx = np.nonzero(e_part == p)[0]
+        local_of_edge[eidx] = np.arange(eidx.shape[0])
+
+    # halo: for each (owner p -> consumer q) the unique remote NODES the
+    # consumer needs — remote senders of its edges plus (DimeNet) remote
+    # j/k nodes of its triplets (the 2-hop halo)
+    node_req_q = [e_part]
+    node_req_item = [send_g]
+    trip = None
+    if need_triplets:
+        from hydragnn_tpu.models.dimenet import compute_triplets
+
+        t_i, t_j, t_k, t_kj, t_ji = compute_triplets(edge_index, n)
+        t_part = e_part[t_ji]  # triplet lives with its (j->i) edge
+        node_req_q += [t_part, t_part]
+        node_req_item += [t_j, t_k]
+        trip = (t_i, t_j, t_k, t_kj, t_ji, t_part)
+
+    node_halo = _HaloTable(
+        np.concatenate(node_req_q),
+        np.concatenate(node_req_item),
+        part_of_node,
+        local_of_node,
+        P,
+        halo_multiple,
+        dummy=nl - 1,
     )
-    halo = _round_up(max(max_h, 1), halo_multiple)
+    halo = node_halo.h
+
+    edge_halo = None
+    if need_triplets:
+        # remote (k->j) edges whose STATE the consumer gathers (x_kj)
+        edge_halo = _HaloTable(
+            trip[5], trip[3], e_part, local_of_edge, P, halo_multiple, dummy=0
+        )
 
     # ---- per-part arrays -------------------------------------------------
     F = x.shape[1]
@@ -233,7 +311,7 @@ def partition_graph(
     )
     # padded slots point at the dummy row so halo_reduce's scatter-add and
     # halo_extend's sends never touch a real node
-    halo_send = np.full((P, P, halo), nl - 1, np.int32)
+    halo_send = node_halo.send
     nig = np.zeros((P, nl), np.int32)  # node_index_in_graph (global position)
 
     for p in range(P):
@@ -248,30 +326,53 @@ def partition_graph(
         n_node[p, 0] = n  # GLOBAL count: local pool sums psum to the true mean
         n_node[p, 1] = nl - sz
         graph_mask[p, 0] = True
-        for q in range(P):
-            lst = halo_lists[p][q]
-            if lst:
-                halo_send[p, q, : len(lst)] = np.asarray(lst, np.int32)
 
     for p in range(P):
         eidx = np.nonzero(e_part == p)[0]
         k = eidx.shape[0]
-        r_loc = local_of_node[recv_g[eidx]].astype(np.int32)
-        s_parts = part_of_node[send_g[eidx]]
-        s_loc = np.empty(k, np.int32)
-        local_mask = s_parts == p
-        s_loc[local_mask] = local_of_node[send_g[eidx[local_mask]]].astype(np.int32)
-        for j in np.nonzero(~local_mask)[0]:
-            sp = int(s_parts[j])
-            h = halo_slot[(p, sp, int(send_g[eidx[j]]))]
-            s_loc[j] = nl + sp * halo + h
-        senders[p, :k] = s_loc
-        receivers[p, :k] = r_loc
+        senders[p, :k] = node_halo.extended_ids(
+            np.full(k, p, np.int64), send_g[eidx], base=nl
+        )
+        receivers[p, :k] = local_of_node[recv_g[eidx]].astype(np.int32)
         edge_mask[p, :k] = True
         n_edge[p, 0] = k
         n_edge[p, 1] = el - k
         if e_attr is not None:
             e_attr[p, :k] = edge_attr[eidx]
+
+    # ---- triplet arrays (DimeNet), fully vectorized ---------------------
+    trip_extras = {}
+    if trip is not None:
+        t_i, t_j, t_k, t_kj, t_ji, t_part = trip
+        t_counts = np.bincount(t_part, minlength=P)
+        tl = _round_up(max(int(t_counts.max()), 1), 8)
+        tr_i = np.full((P, tl), nl - 1, np.int32)
+        tr_j = np.full((P, tl), nl - 1, np.int32)
+        tr_k = np.full((P, tl), nl - 1, np.int32)
+        tr_kj = np.zeros((P, tl), np.int32)
+        tr_ji = np.zeros((P, tl), np.int32)
+        tr_mask = np.zeros((P, tl), bool)
+        # dense row within each part: rank of each triplet in a stable
+        # part-ordered sort
+        order_t = np.argsort(t_part, kind="stable")
+        starts = np.concatenate([[0], np.cumsum(t_counts)[:-1]])
+        rows = np.arange(order_t.shape[0]) - starts[t_part[order_t]]
+        qs = t_part[order_t]
+        tr_i[qs, rows] = local_of_node[t_i[order_t]].astype(np.int32)
+        tr_j[qs, rows] = node_halo.extended_ids(qs, t_j[order_t], base=nl)
+        tr_k[qs, rows] = node_halo.extended_ids(qs, t_k[order_t], base=nl)
+        tr_kj[qs, rows] = edge_halo.extended_ids(qs, t_kj[order_t], base=el)
+        tr_ji[qs, rows] = local_of_edge[t_ji[order_t]].astype(np.int32)
+        tr_mask[qs, rows] = True
+        trip_extras = {
+            "trip_i": tr_i,
+            "trip_j": tr_j,
+            "trip_k": tr_k,
+            "trip_kj": tr_kj,
+            "trip_ji": tr_ji,
+            "trip_mask": tr_mask,
+            "halo_send_edges": edge_halo.send.reshape(P * P, edge_halo.h),
+        }
 
     # ---- targets ---------------------------------------------------------
     targets = []
@@ -307,6 +408,12 @@ def partition_graph(
         extras={
             "halo_send": halo_send.reshape(P * P, halo),
             "node_index_in_graph": flat(nig),
+            # triplet index tables are [P, TL] -> flattened like every other
+            # leaf; halo_send_edges is already [P*P, HE]
+            **{
+                k: (v if k == "halo_send_edges" else flat(v))
+                for k, v in trip_extras.items()
+            },
         },
     )
     info = PartitionInfo(

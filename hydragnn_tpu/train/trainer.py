@@ -298,6 +298,24 @@ class Trainer:
         n = max(n, 1.0)
         true_values = [np.concatenate(v, axis=0) for v in true_values]
         predicted_values = [np.concatenate(v, axis=0) for v in predicted_values]
+        dump = os.getenv("HYDRAGNN_DUMP_TESTDATA")
+        if dump:
+            # per-rank test-prediction dump (train_validate_test.py:602);
+            # an explicit path gets the rank embedded so multi-host ranks
+            # cannot clobber each other
+            rank = jax.process_index()
+            if dump == "1":
+                path = f"testdata_rank{rank}.npz"
+            elif jax.process_count() > 1:
+                root, ext = os.path.splitext(dump)
+                path = f"{root}_rank{rank}{ext or '.npz'}"
+            else:
+                path = dump
+            np.savez(
+                path,
+                **{f"true_{i}": v for i, v in enumerate(true_values)},
+                **{f"pred_{i}": v for i, v in enumerate(predicted_values)},
+            )
         return (
             tot / n,
             (tasks / n if tasks is not None else np.zeros(0)),

@@ -97,7 +97,26 @@ def _arch(model_type, extra=None):
     return cfg
 
 
-def _single_batch(sample):
+def _single_batch(sample, need_triplets=False):
+    if need_triplets:
+        # go through the PRODUCTION collation path so the triplet padding
+        # contract is exercised, not re-implemented
+        from hydragnn_tpu.data.dataobj import GraphData
+        from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+
+        g = GraphData(
+            x=sample.x,
+            pos=sample.pos,
+            edge_index=sample.edge_index,
+            edge_attr=sample.edge_attr,
+        )
+        g.targets = list(sample.targets)
+        g.target_types = list(HEAD_TYPES)
+        layout = compute_layout([[g]], batch_size=1, need_triplets=True)
+        (batch,) = list(
+            GraphLoader([g], 1, layout, shuffle=False, num_shards=1, shard_id=0)
+        )
+        return jax.tree_util.tree_map(jnp.asarray, batch)
     n = sample.x.shape[0]
     e = sample.edge_index.shape[1]
     n_pad, e_pad, g_pad = pad_sizes_for(n, e, 1)
@@ -106,9 +125,14 @@ def _single_batch(sample):
     )
 
 
-def _partitioned(sample, mesh):
+def _partitioned(sample, mesh, need_triplets=False):
     batch, info = partition_graph(
-        sample, NUM_PARTS, HEAD_TYPES, HEAD_DIMS, order="morton"
+        sample,
+        NUM_PARTS,
+        HEAD_TYPES,
+        HEAD_DIMS,
+        order="morton",
+        need_triplets=need_triplets,
     )
     return put_partitioned_batch(batch, mesh, "graph"), info
 
@@ -137,7 +161,8 @@ def pytest_partitioner_covers_graph():
 
 
 @pytest.mark.parametrize(
-    "model_type", ["PNA", "GIN", "SAGE", "MFC", "CGCNN", "GAT", "SchNet", "EGNN"]
+    "model_type",
+    ["PNA", "GIN", "SAGE", "MFC", "CGCNN", "GAT", "SchNet", "EGNN", "DimeNet"],
 )
 def pytest_partitioned_forward_parity(model_type):
     sample = _giant_graph(seed=3)
@@ -146,14 +171,17 @@ def pytest_partitioned_forward_parity(model_type):
         if model_type in ("SchNet", "EGNN")
         else None
     )
+    if model_type == "DimeNet":
+        extra = {"hidden_dim": 8}  # DIMEStack: hidden = in_dim for in>1
+    need_triplets = model_type == "DimeNet"
     ref_model, part_model = _models(model_type, extra)
-    single = _single_batch(sample)
+    single = _single_batch(sample, need_triplets=need_triplets)
     variables = init_model_params(ref_model, single, seed=0)
 
     ref_out = ref_model.apply(variables, single, train=False)
 
     mesh = make_mesh(NUM_PARTS, "graph")
-    pbatch, info = _partitioned(sample, mesh)
+    pbatch, info = _partitioned(sample, mesh, need_triplets=need_triplets)
     part_out = make_partitioned_apply(part_model, mesh, "graph")(variables, pbatch)
 
     # graph head: replicated rows, every shard's row 0 equals the reference
