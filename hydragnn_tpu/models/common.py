@@ -74,6 +74,8 @@ def masked_error(pred, target, mask, kind: str = "mse", axis_name: Optional[str]
     (graph-partition parallelism), numerator and count are ``psum``'d over it
     so the result is the exact global mean — same numerics as unsharded.
     """
+    pred = pred.astype(jnp.float32)  # loss reductions always in f32
+    target = target.astype(jnp.float32)
     m = mask.reshape(mask.shape + (1,) * (pred.ndim - 1)).astype(pred.dtype)
     # where (not multiply) so NaN/inf garbage in padded rows cannot leak in
     diff = jnp.where(m > 0, pred - target, 0.0)
@@ -130,6 +132,8 @@ class MaskedBatchNorm(nn.Module):
         scale = self.param("scale", nn.initializers.ones, (self.features,))
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
 
+        in_dtype = x.dtype
+        x = x.astype(jnp.float32)  # statistics always in f32 (bf16 sums drift)
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         elif self.axis_name is not None:
@@ -169,7 +173,7 @@ class MaskedBatchNorm(nn.Module):
                     1.0 - self.momentum
                 ) * ra_var.value + self.momentum * unbiased
         y = (x - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
-        return jnp.where(mask[:, None], y, 0.0)
+        return jnp.where(mask[:, None], y, 0.0).astype(in_dtype)
 
 
 class MLP(nn.Module):

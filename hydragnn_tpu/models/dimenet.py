@@ -312,28 +312,30 @@ def compute_triplets(edge_index: np.ndarray, num_nodes: int):
     (``DIMEStack.py:158-182``): for every directed edge j->i and every edge
     k->j with k != i, emit (idx_i, idx_j, idx_k, idx_kj, idx_ji).
     """
-    row, col = edge_index[0], edge_index[1]  # j -> i
+    row, col = np.asarray(edge_index[0]), np.asarray(edge_index[1])  # j -> i
     num_edges = row.shape[0]
-    # incoming edge ids per node: edges whose receiver is v
-    in_edges = [[] for _ in range(num_nodes)]
-    for eid in range(num_edges):
-        in_edges[col[eid]].append(eid)
-    ti, tj, tk, tkj, tji = [], [], [], [], []
-    for eid in range(num_edges):
-        jn, inode = row[eid], col[eid]
-        for kj in in_edges[jn]:  # edges k -> j
-            k = row[kj]
-            if k == inode:
-                continue
-            ti.append(inode)
-            tj.append(jn)
-            tk.append(k)
-            tkj.append(kj)
-            tji.append(eid)
+    if num_edges == 0:
+        z = np.zeros(0, np.int32)
+        return z, z, z, z, z
+    # vectorized (k->j, j->i) join: group edges by receiver, then for every
+    # edge (j->i) expand over the in-edges of its sender j — O(sort + T),
+    # no Python loops (giant partitioned graphs hit this path host-side)
+    order = np.argsort(col, kind="stable")  # in-edge ids per node, eid-ascending
+    deg = np.bincount(col, minlength=num_nodes)
+    starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
+    c1 = deg[row]  # kj candidates per (j->i) edge
+    total = int(c1.sum())
+    tji = np.repeat(np.arange(num_edges), c1)
+    within = np.arange(total) - np.repeat(np.cumsum(c1) - c1, c1)
+    tkj = order[starts[row[tji]] + within]
+    ti = col[tji]
+    tj = row[tji]
+    tk = row[tkj]
+    keep = tk != ti  # exclude backtracking triplets (k == i)
     return (
-        np.asarray(ti, dtype=np.int32),
-        np.asarray(tj, dtype=np.int32),
-        np.asarray(tk, dtype=np.int32),
-        np.asarray(tkj, dtype=np.int32),
-        np.asarray(tji, dtype=np.int32),
+        ti[keep].astype(np.int32),
+        tj[keep].astype(np.int32),
+        tk[keep].astype(np.int32),
+        tkj[keep].astype(np.int32),
+        tji[keep].astype(np.int32),
     )

@@ -147,9 +147,40 @@ class Trainer:
     def _build_steps(self):
         model = self.model
         tx = self.tx
+        # mixed precision (no reference counterpart — HydraGNN trains pure
+        # f32): master params stay f32 for the optimizer; forward/backward
+        # runs in bfloat16. Positions stay f32 (geometry — distances/angles
+        # — is precision-critical), BatchNorm statistics and loss reductions
+        # are forced to f32 in models/common.py, and segment scatters upcast
+        # to f32 (graph/segment.py). Measured on v5e (bench.py config): the
+        # QM9-scale step is scatter/latency-bound, not matmul-bound (~8 of
+        # ~49 f32 TFLOP/s), so bf16 LOSES there (29k vs 376k graphs/s at
+        # hidden 64; 258k vs 356k at hidden 512 — XLA's bf16 gather/scatter
+        # layouts are the cost). Accuracy-validated opt-in
+        # (tests/test_mixed_precision.py); expect wins only on matmul-bound
+        # configurations/topologies — measure before enabling.
+        mixed = bool(self.training_config.get("mixed_precision", False))
+
+        def _cast_bf16(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32
+                else a,
+                tree,
+            )
 
         def train_step(state, batch, rng):
+            if mixed:
+                batch = batch.replace(
+                    x=batch.x.astype(jnp.bfloat16),
+                    edge_attr=None
+                    if batch.edge_attr is None
+                    else batch.edge_attr.astype(jnp.bfloat16),
+                )
+
             def loss_fn(params):
+                if mixed:
+                    params = _cast_bf16(params)
                 variables = {"params": params}
                 if state.batch_stats:
                     variables["batch_stats"] = state.batch_stats
