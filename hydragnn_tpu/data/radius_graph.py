@@ -22,22 +22,80 @@ def radius_graph(
     max_neighbors: int = 32,
     loop: bool = False,
 ) -> np.ndarray:
+    """Radius graph; O(n^2) dense for small systems, cell-list (O(n) memory,
+    ~O(n) time for bounded density) above — giant single graphs (the
+    graph-partition workload) need the latter: 16k atoms would otherwise
+    materialize a 3 GB distance matrix. Both paths produce identical edges:
+    every ordered (j -> i) pair with dist <= radius, capped per receiver at
+    ``max_neighbors`` in ascending-j order."""
     n = pos.shape[0]
     if n == 0:
         return np.zeros((2, 0), dtype=np.int64)
-    diff = pos[None, :, :] - pos[:, None, :]  # [i, j]
-    dist = np.sqrt((diff * diff).sum(-1))
-    within = dist <= radius
+    pos = np.asarray(pos, dtype=np.float64)
+    if n <= 1024:
+        diff = pos[None, :, :] - pos[:, None, :]  # [i, j]
+        dist = np.sqrt((diff * diff).sum(-1))
+        within = dist <= radius
+        if not loop:
+            np.fill_diagonal(within, False)
+        senders, receivers = [], []
+        for i in range(n):
+            js = np.nonzero(within[i])[0][:max_neighbors]
+            senders.append(js)
+            receivers.append(np.full(js.shape, i, dtype=np.int64))
+        return np.stack(
+            [np.concatenate(senders), np.concatenate(receivers)]
+        ).astype(np.int64)
+
+    # ---- cell list ------------------------------------------------------
+    grid = np.floor((pos - pos.min(axis=0)) / radius).astype(np.int64)
+    dims = grid.max(axis=0) + 1
+    cid = (grid[:, 0] * dims[1] + grid[:, 1]) * dims[2] + grid[:, 2]
+    order = np.argsort(cid, kind="stable")  # points grouped by cell
+    sorted_cid = cid[order]
+    uniq, start = np.unique(sorted_cid, return_index=True)
+    counts = np.diff(np.append(start, n))
+
+    recv_all, send_all = [], []
+    offsets = np.array(
+        [[a, b, c] for a in (-1, 0, 1) for b in (-1, 0, 1) for c in (-1, 0, 1)]
+    )
+    for off in offsets:
+        ng = grid + off
+        ok = np.all((ng >= 0) & (ng < dims), axis=1)
+        pts = np.nonzero(ok)[0]
+        ncid = (ng[pts, 0] * dims[1] + ng[pts, 1]) * dims[2] + ng[pts, 2]
+        slot = np.searchsorted(uniq, ncid)
+        hit = (slot < uniq.shape[0]) & (uniq[np.minimum(slot, uniq.shape[0] - 1)] == ncid)
+        pts, slot = pts[hit], slot[hit]
+        c = counts[slot]
+        total = int(c.sum())
+        if total == 0:
+            continue
+        recv = np.repeat(pts, c)
+        within_cell = np.arange(total) - np.repeat(np.cumsum(c) - c, c)
+        send = order[np.repeat(start[slot], c) + within_cell]
+        recv_all.append(recv)
+        send_all.append(send)
+    if not recv_all:
+        return np.zeros((2, 0), dtype=np.int64)
+    recv = np.concatenate(recv_all)
+    send = np.concatenate(send_all)
+    d = np.linalg.norm(pos[send] - pos[recv], axis=1)
+    keep = d <= radius
     if not loop:
-        np.fill_diagonal(within, False)
-    senders, receivers = [], []
-    for i in range(n):
-        js = np.nonzero(within[i])[0][:max_neighbors]
-        senders.append(js)
-        receivers.append(np.full(js.shape, i, dtype=np.int64))
-    return np.stack(
-        [np.concatenate(senders), np.concatenate(receivers)]
-    ).astype(np.int64)
+        keep &= send != recv
+    recv, send = recv[keep], send[keep]
+    # per-receiver cap in ascending-j order (dense-path semantics)
+    so = np.lexsort((send, recv))
+    recv, send = recv[so], send[so]
+    change = np.r_[True, recv[1:] != recv[:-1]]
+    group_start = np.nonzero(change)[0]
+    rank = np.arange(recv.shape[0]) - np.repeat(
+        group_start, np.diff(np.append(group_start, recv.shape[0]))
+    )
+    keep = rank < max_neighbors
+    return np.stack([send[keep], recv[keep]]).astype(np.int64)
 
 
 def radius_graph_pbc(

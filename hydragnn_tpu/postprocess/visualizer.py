@@ -1,6 +1,7 @@
 """Visualizer — matplotlib diagnostics (parity with
 ``hydragnn/postprocess/visualizer.py:24-742``: parity/scatter plots, error
-histograms, loss history, node-count histogram), writing under
+histograms, 2-D density contours, conditional-mean error curves, per-node /
+vector parity panels, loss history, node-count histogram), writing under
 ``./logs/<name>/``."""
 
 import os
@@ -120,3 +121,119 @@ class Visualizer:
         ax.set_yscale("log")
         ax.legend()
         self._save(fig, "history_loss.png")
+
+    # ---- analysis helpers (visualizer.py:83-105) -------------------------
+    @staticmethod
+    def _hist2d_contour(data1, data2, bins=40):
+        """(xcenters, ycenters, H) density for a parity contour plot."""
+        data1 = np.asarray(data1).reshape(-1)
+        data2 = np.asarray(data2).reshape(-1)
+        H, xe, ye = np.histogram2d(data1, data2, bins=bins)
+        return 0.5 * (xe[:-1] + xe[1:]), 0.5 * (ye[:-1] + ye[1:]), H.T
+
+    @staticmethod
+    def _err_condmean(true, err, bins=25):
+        """Conditional mean of |error| vs the true value — the reference's
+        ``__err_condmean`` diagnostic (bias as a function of target)."""
+        true = np.asarray(true).reshape(-1)
+        err = np.abs(np.asarray(err).reshape(-1))
+        if true.size == 0:
+            return np.zeros(0), np.zeros(0)
+        edges = np.linspace(true.min(), true.max() + 1e-12, bins + 1)
+        which = np.clip(np.digitize(true, edges) - 1, 0, bins - 1)
+        sums = np.bincount(which, weights=err, minlength=bins)
+        cnts = np.maximum(np.bincount(which, minlength=bins), 1)
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        return centers, sums / cnts
+
+    @staticmethod
+    def add_identity(ax, *line_args, **line_kwargs):
+        """y=x reference line that tracks axis limits
+        (``visualizer.py:614-627``)."""
+        (identity,) = ax.plot([], [], *line_args, **line_kwargs)
+
+        def callback(axes):
+            lo = max(axes.get_xlim()[0], axes.get_ylim()[0])
+            hi = min(axes.get_xlim()[1], axes.get_ylim()[1])
+            identity.set_data([lo, hi], [lo, hi])
+
+        callback(ax)
+        ax.callbacks.connect("xlim_changed", callback)
+        ax.callbacks.connect("ylim_changed", callback)
+        return ax
+
+    def create_plot_global_analysis(
+        self, true_values, predicted_values, output_names=None
+    ):
+        """Per-head analysis grid: parity density contour, |error|
+        conditional mean, and error histogram
+        (``visualizer.py:134-279``)."""
+        n = len(true_values)
+        fig, axes = plt.subplots(3, n, figsize=(5 * n, 12), squeeze=False)
+        for ihead in range(n):
+            t = np.asarray(true_values[ihead]).reshape(-1)
+            p = np.asarray(predicted_values[ihead]).reshape(-1)
+            name = (
+                output_names[ihead]
+                if output_names and ihead < len(output_names)
+                else f"head{ihead}"
+            )
+            ax = axes[0][ihead]
+            if t.size:
+                xc, yc, H = self._hist2d_contour(t, p)
+                ax.contourf(xc, yc, np.log1p(H), levels=12)
+                self.add_identity(ax, "r--", linewidth=1)
+            ax.set_title(f"{name} parity density")
+            ax = axes[1][ihead]
+            centers, cm = self._err_condmean(t, p - t)
+            ax.plot(centers, cm)
+            ax.set_xlabel(f"true {name}")
+            ax.set_ylabel("mean |error|")
+            ax = axes[2][ihead]
+            ax.hist(p - t, bins=40)
+            ax.set_xlabel(f"error {name}")
+        self._save(fig, "global_analysis.png")
+
+    def create_parity_plot_vector(
+        self, true_values, predicted_values, ihead=0, output_name=None, dim=None
+    ):
+        """Vector-output parity: one panel per component
+        (``visualizer.py:467-517``)."""
+        t = np.asarray(true_values[ihead])
+        p = np.asarray(predicted_values[ihead])
+        d = dim or self.head_dims[ihead]
+        t = t.reshape(-1, d)
+        p = p.reshape(-1, d)
+        name = output_name or f"head{ihead}"
+        fig, axes = plt.subplots(1, d, figsize=(5 * d, 5), squeeze=False)
+        for c in range(d):
+            ax = axes[0][c]
+            ax.scatter(t[:, c], p[:, c], s=4, alpha=0.5)
+            self.add_identity(ax, "r--", linewidth=1)
+            ax.set_title(f"{name}[{c}]")
+        self._save(fig, f"parity_vector_{name}.png")
+
+    def create_error_histogram_per_node(
+        self, true_values, predicted_values, ihead=0, output_name=None
+    ):
+        """Node-head error histogram grouped by node position within the
+        graph (fixed-size graphs; ``visualizer.py:387-465``)."""
+        if not self.num_nodes_list or len(set(self.num_nodes_list)) != 1:
+            return  # variable graph size: per-node grouping undefined
+        num_nodes = int(self.num_nodes_list[0])
+        t = np.asarray(true_values[ihead]).reshape(-1)
+        p = np.asarray(predicted_values[ihead]).reshape(-1)
+        if t.size % num_nodes != 0:
+            return
+        err = (p - t).reshape(-1, num_nodes)
+        cols = min(num_nodes, 4)
+        rows = -(-num_nodes // cols)
+        name = output_name or f"head{ihead}"
+        fig, axes = plt.subplots(
+            rows, cols, figsize=(4 * cols, 3 * rows), squeeze=False
+        )
+        for node in range(num_nodes):
+            ax = axes[node // cols][node % cols]
+            ax.hist(err[:, node], bins=30)
+            ax.set_title(f"node {node}")
+        self._save(fig, f"error_hist_per_node_{name}.png")
