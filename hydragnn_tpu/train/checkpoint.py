@@ -19,26 +19,51 @@ import numpy as np
 from flax import serialization
 
 
+def _consolidate(leaf):
+    """Bring one leaf fully to host. Multi-host + sharded (ZeRO optimizer
+    moments over the data axis): device_get cannot read non-addressable
+    shards, so reshard to replicated first — the role DeepSpeed's
+    ``consolidate_state_dict`` plays in the reference (``model.py:60-74``)."""
+    if (
+        isinstance(leaf, jax.Array)
+        and jax.process_count() > 1
+        and not leaf.is_fully_replicated
+    ):
+        mesh = getattr(leaf.sharding, "mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            leaf = jax.jit(
+                lambda x: x, out_shardings=NamedSharding(mesh, P())
+            )(leaf)
+    return jax.device_get(leaf)
+
+
 def _state_dict(state) -> Dict[str, Any]:
-    return {
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats),
-        "opt_state": jax.device_get(state.opt_state),
-        "step": jax.device_get(state.step),
-    }
+    return jax.tree_util.tree_map(
+        _consolidate,
+        {
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+            "step": state.step,
+        },
+    )
 
 
 def save_model(state_or_dict, name: str, path: str = "./logs/"):
     from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
     _, rank = get_comm_size_and_rank()
-    if rank != 0:
-        return
+    # consolidation involves resharding COLLECTIVES — every process must
+    # participate, only rank 0 writes the file
     sd = (
         state_or_dict
         if isinstance(state_or_dict, dict)
         else _state_dict(state_or_dict)
     )
+    if rank != 0:
+        return
     out_dir = os.path.join(path, name)
     os.makedirs(out_dir, exist_ok=True)
     # to_state_dict flattens custom containers (optax states) to plain dicts

@@ -23,6 +23,8 @@ def _free_port():
 
 
 def pytest_two_process_training_step():
+    import tempfile
+
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "_multiprocess_worker.py")
     port = _free_port()
@@ -30,6 +32,7 @@ def pytest_two_process_training_step():
     # the workers pin their own platform/devices; scrub the suite's settings
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
+    env["HYDRAGNN_TPU_TEST_CKPT"] = tempfile.mkdtemp(prefix="mp_ckpt_")
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(rank), "2", str(port)],
