@@ -10,11 +10,14 @@
 #
 #   gcloud compute tpus tpu-vm ssh "$TPU_NAME" --worker=all --command "
 #     cd ~/hydragnn_tpu &&
-#     mkdir -p /tmp/graphpack && gsutil -m rsync -r \
-#         gs://my-bucket/mptrj-graphpack /tmp/graphpack &&   # NVMe-staging analog
-#     HYDRAGNN_PREFETCH=2 \
-#     python -u examples/mptrj/train.py --graphpack /tmp/graphpack
+#     mkdir -p /tmp/oc20run/dataset && gsutil -m rsync -r \
+#         gs://my-bucket/oc20-shards /tmp/oc20run/dataset &&  # NVMe-staging analog
+#     cd /tmp/oc20run && HYDRAGNN_PREFETCH=2 PYTHONPATH=~/hydragnn_tpu \
+#     python -u ~/hydragnn_tpu/examples/open_catalyst_2020/train.py --preload
 #   "
+#   (first produce the shard store once with
+#    `python examples/open_catalyst_2020/train.py --preonly` — the
+#    reference's preonly ADIOS-write pass, SURVEY.md §3.4)
 #
 # (B) SLURM-managed hosts (DCN-connected; setup_distributed() reads the
 #     SLURM_* variables, parses the nodelist for the coordinator, and calls
@@ -27,9 +30,10 @@
 #   export HYDRAGNN_PREFETCH=2
 #   # stage the shard store to node-local storage on every host first
 #   srun -N "$SLURM_JOB_NUM_NODES" --ntasks-per-node=1 \
-#       rsync -a "$SHARED_FS/mptrj-graphpack/" /tmp/graphpack/
+#       rsync -a "$SHARED_FS/oc20-shards/" /tmp/oc20run/dataset/
+#   cd /tmp/oc20run && PYTHONPATH="$REPO" \
 #   srun -N "$SLURM_JOB_NUM_NODES" --ntasks-per-node=1 \
-#       python -u examples/mptrj/train.py --graphpack /tmp/graphpack
+#       python -u "$REPO"/examples/open_catalyst_2020/train.py --preload
 #
 # Each process loads ONLY its shard of every batch (DistributedSampler
 # split in hydragnn_tpu/data/loaders.py); the global sharded batch is
