@@ -122,7 +122,8 @@ class _HaloTable:
     over items, so partitioning stays O(sort) for giant graphs.
     """
 
-    def __init__(self, req_q, req_item, part_of, local_of, P, multiple, dummy):
+    def __init__(self, req_q, req_item, part_of, local_of, P, multiple, dummy,
+                 min_h: int = 0):
         num_items = part_of.shape[0]
         req_q = np.asarray(req_q, np.int64)
         req_item = np.asarray(req_item, np.int64)
@@ -141,7 +142,8 @@ class _HaloTable:
         group_start = np.nonzero(change)[0]
         slot_sorted = np.arange(order.shape[0]) - group_start[group_id]
         counts = np.bincount(group_id) if order.shape[0] else np.zeros(1, np.int64)
-        self.h = int(-(-max(int(counts.max()) if order.shape[0] else 0, 1) // multiple) * multiple)
+        natural = max(int(counts.max()) if order.shape[0] else 0, 1)
+        self.h = max(int(-(-natural // multiple) * multiple), int(min_h))
         self.send = np.full((P, P, self.h), dummy, np.int32)
         self.send[sp, sq, slot_sorted] = local_of[uitem[order]].astype(np.int32)
         self._uniq = uniq
@@ -169,7 +171,8 @@ class _HaloTable:
 class PartitionInfo:
     """Static partition geometry + the inverse maps to un-partition outputs."""
 
-    def __init__(self, num_parts, nl, el, halo, node_perm, part_of_node, local_of_node, n_real):
+    def __init__(self, num_parts, nl, el, halo, node_perm, part_of_node,
+                 local_of_node, n_real, halo_edges=0, tl=0):
         self.num_parts = num_parts
         self.nl = nl  # local node budget (incl. 1 dummy row)
         self.el = el  # local edge budget
@@ -178,6 +181,18 @@ class PartitionInfo:
         self.part_of_node = part_of_node  # [n] owning shard per global node
         self.local_of_node = local_of_node  # [n] local row per global node
         self.n_real = n_real
+        self.halo_edges = halo_edges  # per-peer EDGE halo budget (triplets)
+        self.tl = tl  # local triplet budget
+
+    @property
+    def budgets(self) -> dict:
+        return {
+            "nl": self.nl,
+            "el": self.el,
+            "halo": self.halo,
+            "halo_edges": self.halo_edges,
+            "tl": self.tl,
+        }
 
     def gather_nodes(self, per_part_rows: np.ndarray) -> np.ndarray:
         """``[P*NL, ...]`` stacked per-part rows -> ``[n, ...]`` in the
@@ -196,6 +211,7 @@ def partition_graph(
     edge_multiple: int = 8,
     halo_multiple: int = 8,
     need_triplets: bool = False,
+    budgets: Optional[dict] = None,
 ) -> Tuple[GraphBatch, PartitionInfo]:
     """Split one giant graph into ``num_parts`` static-shape shards.
 
@@ -244,13 +260,17 @@ def partition_graph(
     def _round_up(v, m):
         return int(-(-v // m) * m)
 
-    nl = _round_up(max(part_sizes) + 1, node_multiple)
+    budgets = budgets or {}
+    nl = max(_round_up(max(part_sizes) + 1, node_multiple), budgets.get("nl", 0))
 
     # edge ownership by receiver
     send_g, recv_g = edge_index[0], edge_index[1]
     e_part = part_of_node[recv_g]
     e_counts = np.bincount(e_part, minlength=P)
-    el = _round_up(max(int(e_counts.max()), 1), edge_multiple)
+    el = max(
+        _round_up(max(int(e_counts.max()), 1), edge_multiple),
+        budgets.get("el", 0),
+    )
 
     # local edge row of every global edge (receiver-owner layout; matches
     # the ascending-nonzero order of the edge build loop below)
@@ -282,6 +302,7 @@ def partition_graph(
         P,
         halo_multiple,
         dummy=nl - 1,
+        min_h=budgets.get("halo", 0),
     )
     halo = node_halo.h
 
@@ -289,7 +310,8 @@ def partition_graph(
     if need_triplets:
         # remote (k->j) edges whose STATE the consumer gathers (x_kj)
         edge_halo = _HaloTable(
-            trip[5], trip[3], e_part, local_of_edge, P, halo_multiple, dummy=0
+            trip[5], trip[3], e_part, local_of_edge, P, halo_multiple, dummy=0,
+            min_h=budgets.get("halo_edges", 0),
         )
 
     # ---- per-part arrays -------------------------------------------------
@@ -345,7 +367,7 @@ def partition_graph(
     if trip is not None:
         t_i, t_j, t_k, t_kj, t_ji, t_part = trip
         t_counts = np.bincount(t_part, minlength=P)
-        tl = _round_up(max(int(t_counts.max()), 1), 8)
+        tl = max(_round_up(max(int(t_counts.max()), 1), 8), budgets.get("tl", 0))
         tr_i = np.full((P, tl), nl - 1, np.int32)
         tr_j = np.full((P, tl), nl - 1, np.int32)
         tr_k = np.full((P, tl), nl - 1, np.int32)
@@ -417,7 +439,9 @@ def partition_graph(
         },
     )
     info = PartitionInfo(
-        P, nl, el, halo, perm, part_of_node, local_of_node, n
+        P, nl, el, halo, perm, part_of_node, local_of_node, n,
+        halo_edges=edge_halo.h if edge_halo is not None else 0,
+        tl=trip_extras["trip_i"].shape[1] if trip_extras else 0,
     )
     return batch, info
 
@@ -433,15 +457,24 @@ def _batch_spec(batch, axis):
     return jax.tree_util.tree_map(lambda _: P(axis), batch)
 
 
+def _put_global(a, sharding):
+    """Place an array (present in full on every process) under a global
+    sharding. device_put cannot target non-addressable devices, so on
+    multi-host each process contributes its addressable shards via
+    make_array_from_callback."""
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(a), sharding)
+    a = np.asarray(a)
+    return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])
+
+
 def put_partitioned_batch(batch: GraphBatch, mesh, axis: str = "graph") -> GraphBatch:
     """Device placement: every leaf sharded on axis 0 so each device holds
-    exactly its shard's rows."""
+    exactly its shard's rows (multi-host safe)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sharding = NamedSharding(mesh, P(axis))
-    return jax.tree_util.tree_map(
-        lambda a: jax.device_put(jnp.asarray(a), sharding), batch
-    )
+    return jax.tree_util.tree_map(lambda a: _put_global(a, sharding), batch)
 
 
 def put_partitioned_state(state, mesh):
@@ -451,11 +484,17 @@ def put_partitioned_state(state, mesh):
     Skipping this costs one full extra XLA compile: the first step returns
     P()-annotated arrays, and feeding those back into a jit that was traced
     for differently-annotated inputs is a sharding-signature cache miss
-    (measured ~5 s duplicate compile on v5e).
+    (measured ~5 s duplicate compile on v5e). Multi-host safe (values are
+    identical on every process, e.g. seeded init).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    return jax.device_put(state, NamedSharding(mesh, P()))
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(state, sharding)
+    return jax.tree_util.tree_map(
+        lambda a: _put_global(jax.device_get(a), sharding), state
+    )
 
 
 def make_partitioned_apply(model, mesh, axis: str = "graph"):

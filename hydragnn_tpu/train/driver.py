@@ -57,6 +57,8 @@ def _get_summary_writer(log_name):
 
 def _build_model_and_trainer(config, train_loader, verbosity):
     arch = _arch_for_factory(config)
+    if arch.get("partition_axis"):
+        return _build_partitioned(config, arch, train_loader, verbosity)
     model = create_model_config(arch, verbosity)
     mesh = default_mesh()
     trainer = Trainer(
@@ -71,6 +73,75 @@ def _build_model_and_trainer(config, train_loader, verbosity):
     return model, trainer, state
 
 
+def _build_partitioned(config, arch, train_loader, verbosity):
+    """Giant-graph mode: every sample is ONE graph sharded over all devices
+    (``Architecture.partition_axis`` names the mesh axis)."""
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.train.partitioned import PartitionedTrainer
+
+    axis = arch["partition_axis"]
+    ref_arch = dict(arch)
+    ref_arch.pop("partition_axis")
+    model = create_model_config(arch, verbosity)
+    ref_model = create_model_config(ref_arch, verbosity)
+    mesh = make_mesh(None, axis)  # every device
+    trainer = PartitionedTrainer(
+        model,
+        ref_model,
+        config["NeuralNetwork"]["Training"],
+        mesh=mesh,
+        axis=axis,
+        verbosity=verbosity,
+        freeze_conv=arch.get("freeze_conv_layers", False),
+    )
+    state = trainer.init_state(train_loader.dataset[0], seed=0)
+    return model, trainer, state
+
+
+def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
+    """Swap the padded-batch GraphLoaders for PartitionedLoaders when the
+    config asks for partition mode (post-``update_config``, so output
+    types/dims are derived)."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    if not arch.get("partition_axis"):
+        return train_loader, val_loader, test_loader
+    import jax
+
+    from hydragnn_tpu.train.partitioned import PartitionedLoader, scan_budgets
+
+    head_types = tuple(arch["output_type"])
+    head_dims = tuple(arch["output_dim"])
+    need_triplets = arch["model_type"] == "DimeNet"
+    n_dev = len(jax.devices())
+    # ONE budget union across splits -> one compiled executable for all
+    budgets = scan_budgets(
+        [train_loader.dataset, val_loader.dataset, test_loader.dataset],
+        n_dev,
+        head_types,
+        head_dims,
+        need_triplets,
+    )
+    out = []
+    for loader, shuffle in (
+        (train_loader, True),
+        (val_loader, False),
+        (test_loader, False),
+    ):
+        out.append(
+            PartitionedLoader(
+                loader.dataset,
+                n_dev,
+                head_types,
+                head_dims,
+                need_triplets=need_triplets,
+                shuffle=shuffle,
+                axis=arch["partition_axis"],
+                budgets=budgets,
+            )
+        )
+    return tuple(out)
+
+
 def run_training_impl(config):
     timer = Timer("run_training")
     timer.start()
@@ -80,6 +151,9 @@ def run_training_impl(config):
 
     train_loader, val_loader, test_loader = dataset_loading_and_splitting(config)
     config = update_config(config, train_loader, val_loader, test_loader)
+    train_loader, val_loader, test_loader = make_partitioned_loaders(
+        config, train_loader, val_loader, test_loader
+    )
     log_name = get_log_name_config(config)
     setup_log(log_name)
     save_config(config, log_name)
@@ -122,6 +196,9 @@ def run_prediction_impl(config):
 
     train_loader, val_loader, test_loader = dataset_loading_and_splitting(config)
     config = update_config(config, train_loader, val_loader, test_loader)
+    train_loader, val_loader, test_loader = make_partitioned_loaders(
+        config, train_loader, val_loader, test_loader
+    )
     log_name = get_log_name_config(config)
 
     model, trainer, state = _build_model_and_trainer(

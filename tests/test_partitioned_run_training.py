@@ -1,0 +1,26 @@
+"""Giant-graph (partition) mode through the PUBLIC training API.
+
+``Architecture.partition_axis`` routes ``run_training`` to the partitioned
+trainer: every sample becomes one graph sharded node-wise over all 8 virtual
+devices. Numerics match the unpartitioned model exactly, so the SAME
+accuracy ceilings as ``tests/test_graphs.py`` must hold.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_graphs import unittest_train_model
+
+
+def pytest_partitioned_run_training_pna():
+    unittest_train_model(
+        "PNA",
+        "ci.json",
+        False,
+        overwrite_config={
+            "NeuralNetwork": {"Architecture": {"partition_axis": "graph"}}
+        },
+        num_samples_tot=300,
+    )
