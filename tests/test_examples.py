@@ -44,6 +44,17 @@ def pytest_example_smoke(example, tmp_path):
     assert "Val Loss:" in res.stdout
 
 
+def pytest_example_giant_graph(tmp_path):
+    """Graph-partition demo: one graph over a 4-device virtual CPU mesh."""
+    res = _run_example(
+        "examples/giant_graph/train.py",
+        "--num_atoms", "512", "--steps", "6", "--cpu_devices", "4",
+        cwd=str(tmp_path),
+    )
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "loss" in res.stdout
+
+
 def pytest_example_shard_pipeline(tmp_path):
     """open_catalyst: preonly shard write then a training run reading it."""
     res = _run_example(
