@@ -9,18 +9,30 @@ accuracy ceilings as ``tests/test_graphs.py`` must hold.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from test_graphs import unittest_train_model
+from test_graphs import FULL, unittest_train_model
+
+_OVERWRITE = {"NeuralNetwork": {"Architecture": {"partition_axis": "graph"}}}
 
 
 def pytest_partitioned_run_training_pna():
     unittest_train_model(
-        "PNA",
-        "ci.json",
-        False,
-        overwrite_config={
-            "NeuralNetwork": {"Architecture": {"partition_axis": "graph"}}
-        },
+        "PNA", "ci.json", False, overwrite_config=_OVERWRITE,
+        num_samples_tot=300,
+    )
+
+
+@pytest.mark.skipif(not FULL, reason="HYDRAGNN_FULL_TEST=1 for the long matrix")
+@pytest.mark.parametrize("model_type", ["EGNN", "DimeNet"])
+def pytest_partitioned_run_training_hard_paths(model_type):
+    """The two hardest partition paths through the public API: EGNN's
+    sender-side equivariant aggregation (halo_reduce) and DimeNet's
+    2-hop/edge-state halos (triplet tables)."""
+    ci = "ci_equivariant.json" if model_type == "EGNN" else "ci.json"
+    unittest_train_model(
+        model_type, ci, False, overwrite_config=_OVERWRITE,
         num_samples_tot=300,
     )
