@@ -112,6 +112,11 @@ class HydraBase(nn.Module):
     # match the unpartitioned model exactly.
     partition_axis: Optional[str] = None
 
+    # stacks whose convs read node positions (distances/angles/coordinate
+    # updates) set this True; for the rest the partitioned halo exchange
+    # skips the pos columns — pure ICI bandwidth savings
+    conv_needs_pos: bool = False
+
     @property
     def use_edge_attr(self) -> bool:
         return self.edge_dim is not None and self.edge_dim > 0
@@ -181,12 +186,20 @@ class HydraBase(nn.Module):
 
         send_idx = batch.extras["halo_send"]
         nl = x.shape[0]
-        # ONE all_to_all for features+positions (small collectives are
-        # latency-bound on ICI; fuse, then split)
-        both = halo_extend(
-            jnp.concatenate([x, pos], axis=-1), send_idx, self.partition_axis
-        )
-        xe, pe = both[:, : x.shape[1]], both[:, x.shape[1] :]
+        if self.conv_needs_pos:
+            # ONE all_to_all for features+positions (small collectives are
+            # latency-bound on ICI; fuse, then split)
+            both = halo_extend(
+                jnp.concatenate([x, pos], axis=-1), send_idx, self.partition_axis
+            )
+            xe, pe = both[:, : x.shape[1]], both[:, x.shape[1] :]
+        else:
+            # convs of this stack never read pos: don't ship it. Pass None
+            # so a future pos-reading conv that forgot conv_needs_pos=True
+            # fails loudly at trace time instead of silently gathering
+            # clamped out-of-range rows.
+            xe = halo_extend(x, send_idx, self.partition_axis)
+            pe = None
         # convs that build per-node virtual edges (GAT self-loops) consult
         # node_mask at the extended size; halo rows are masked off since
         # their aggregations happen on the owner shard.

@@ -269,6 +269,7 @@ class DimeNetConv(nn.Module):
 
 
 class DIMEStack(HydraBase):
+    conv_needs_pos: bool = True
     basis_emb_size: int = 8
     envelope_exponent: int = 5
     int_emb_size: int = 64

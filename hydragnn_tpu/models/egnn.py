@@ -84,6 +84,7 @@ class E_GCL(nn.Module):
 
 
 class EGCLStack(HydraBase):
+    conv_needs_pos: bool = True
     conv_use_batchnorm: bool = False  # Identity feature layers (EGCLStack.py:41)
 
     def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):

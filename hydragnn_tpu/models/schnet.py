@@ -121,6 +121,7 @@ class CFConv(nn.Module):
 
 
 class SCFStack(HydraBase):
+    conv_needs_pos: bool = True
     num_filters: int = 126
     num_gaussians: int = 50
     radius: float = 2.0
