@@ -18,9 +18,13 @@ guard), ``0``/unset keeps the XLA path. Measured on v5e (bench.py, PNA
 multihead, ~4.6k nodes / ~15k edges / dim 64): pallas 283k graphs/s vs XLA
 scatter 344k — the one-hot matmul pays for a [E_blk, N] indicator against
 N≈4600 segments, so XLA's sorted scatter wins at QM9-scale segment counts
-and the default stays OFF. The kernel wins when the accumulator is narrow
-(N·D small vs E) — revisit for dense-degree workloads. Gradients are
-provided via custom VJPs (gather-based, XLA-fused).
+and the default stays OFF. Standalone (benchmarks/segment_bench.py) the
+kernel wins ~10-20% at dense degree (E/N >= 20), but end-to-end it still
+loses even at E/N ~= 11 (giant_graph example: 0.8 vs 0.7 ms/step) because
+XLA fuses its scatter with the surrounding elementwise work inside the full
+step — a fusion the opaque pallas_call boundary forfeits. Revisit only with
+a kernel that fuses the message MLP + aggregation. Gradients are provided
+via custom VJPs (gather-based, XLA-fused).
 """
 
 import functools
