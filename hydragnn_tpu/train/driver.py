@@ -166,7 +166,9 @@ def run_training_impl(config):
     if "continue" in training and training["continue"]:
         model_name = training.get("startfrom", log_name)
         if checkpoint_exists(model_name):
-            state = restore_into(state, load_state_dict(model_name))
+            state = trainer.place_state(
+                restore_into(state, load_state_dict(model_name))
+            )
 
     writer = _get_summary_writer(log_name)
     vis_cfg = config.get("Visualization", {})
@@ -205,7 +207,7 @@ def run_prediction_impl(config):
         config, train_loader, verbosity
     )
     assert checkpoint_exists(log_name), f"No trained model found: {log_name}"
-    state = restore_into(state, load_state_dict(log_name))
+    state = trainer.place_state(restore_into(state, load_state_dict(log_name)))
 
     error, tasks_error, true_values, predicted_values = trainer.predict(
         state, test_loader

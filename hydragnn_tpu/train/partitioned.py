@@ -188,6 +188,13 @@ class PartitionedTrainer:
 
         return put_partitioned_batch(batch, self.mesh, self.axis)
 
+    def place_state(self, state):
+        """Re-impose the step's sharding after a checkpoint restore (see
+        Trainer.place_state / put_partitioned_state)."""
+        from hydragnn_tpu.parallel.graph_partition import put_partitioned_state
+
+        return put_partitioned_state(state, self.mesh)
+
     # ---- epoch loops (Trainer surface) ---------------------------------
     def train_epoch(self, state, loader, rng):
         tot = 0.0

@@ -97,22 +97,28 @@ class Trainer:
             opt_state=opt_state,
             step=jnp.zeros((), jnp.int32),
         )
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            if jax.process_count() > 1:
-                # replicated GLOBAL arrays assembled from the (identical)
-                # host-local values on every process
-                from jax.experimental import multihost_utils
-
-                state = jax.tree_util.tree_map(np.asarray, state)
-                state = multihost_utils.host_local_array_to_global_array(
-                    state, self.mesh, P()
-                )
-            else:
-                state = jax.device_put(state, NamedSharding(self.mesh, P()))
+        state = self.place_state(state)
         self._build_steps()
         return state
+
+    def place_state(self, state: TrainState) -> TrainState:
+        """Replicate the state onto the mesh with the step's input sharding —
+        used at init AND after checkpoint restore (a host-restored state fed
+        straight in costs a duplicate sharding-signature compile)."""
+        if self.mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, state)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if jax.process_count() > 1:
+            # replicated GLOBAL arrays assembled from the (identical)
+            # host-local values on every process
+            from jax.experimental import multihost_utils
+
+            state = jax.tree_util.tree_map(np.asarray, state)
+            return multihost_utils.host_local_array_to_global_array(
+                state, self.mesh, P()
+            )
+        return jax.device_put(state, NamedSharding(self.mesh, P()))
 
     def put_batch(self, batch: GraphBatch) -> GraphBatch:
         """Host batch -> device(s). Under a mesh, every leading axis (nodes /
