@@ -17,4 +17,5 @@ from hydragnn_tpu.parallel.graph_partition import (
     make_partitioned_train_step,
     partition_graph,
     put_partitioned_batch,
+    put_partitioned_state,
 )

@@ -15,3 +15,8 @@ from hydragnn_tpu.train.checkpoint import (
     restore_into,
     save_model,
 )
+from hydragnn_tpu.train.partitioned import (
+    PartitionedLoader,
+    PartitionedTrainer,
+    scan_budgets,
+)
