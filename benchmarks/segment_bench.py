@@ -26,13 +26,21 @@ def _arg(flag, default):
     return default
 
 
+def _fence(out):
+    """True completion fence: materialize a result byte on the host.
+    (``block_until_ready`` does not actually block on the tunneled axon
+    backend — any timing relying on it measures dispatch rate, not compute.)"""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.ravel()[0])
+
+
 def timeit(fn, *args, iters=50):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _fence(out)
     return (time.perf_counter() - t0) / iters * 1e3  # ms
 
 

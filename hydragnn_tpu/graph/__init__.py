@@ -1,4 +1,9 @@
-from hydragnn_tpu.graph.batch import GraphBatch, collate_graphs, pad_sizes_for
+from hydragnn_tpu.graph.batch import (
+    GraphBatch,
+    collate_graphs,
+    pad_sizes_for,
+    stack_batches,
+)
 from hydragnn_tpu.graph.segment import (
     segment_sum,
     segment_mean,
@@ -7,5 +12,6 @@ from hydragnn_tpu.graph.segment import (
     segment_std,
     segment_softmax,
     segment_moments_fused,
+    segment_minmax_fused,
     segment_count,
 )

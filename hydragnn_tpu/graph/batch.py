@@ -87,6 +87,21 @@ def pad_sizes_for(
     return n_pad, e_pad, g_pad
 
 
+def stack_batches(batches):
+    """Stack K same-shape collated batches along a new leading axis.
+
+    Producer-side counterpart of the trainer's scan-based multi-step
+    dispatch: one host->device transfer and ONE XLA dispatch then run K
+    optimizer steps on device (``lax.scan``), amortizing per-step dispatch
+    latency — the TPU answer to the reference's per-batch eager hot loop
+    (``train/train_validate_test.py:463-520``), where each step pays full
+    Python + launch overhead.
+    """
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+
+
 def collate_graphs(
     samples,
     n_pad: int,

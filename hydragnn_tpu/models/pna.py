@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 from flax import linen as nn
 
-from hydragnn_tpu.graph import segment_max, segment_min
+from hydragnn_tpu.graph import segment_minmax_fused, segment_moments_fused
 from hydragnn_tpu.models.base import HydraBase
 from hydragnn_tpu.models.common import TorchLinear
 
@@ -57,7 +57,6 @@ class PNAConv(nn.Module):
         h = TorchLinear(self.in_dim, name="pre_nn")(h)
         h = jnp.where(batch.edge_mask[:, None], h, 0.0)
 
-        from hydragnn_tpu.graph import segment_moments_fused
         from hydragnn_tpu.ops import pallas_segments_enabled, segment_moments
 
         # mean/std/degree from ONE pass over the messages — pallas kernel or
@@ -74,17 +73,10 @@ class PNAConv(nn.Module):
         mean = s / deg
         # PNA std numerics: sqrt(relu(E[x^2]-E[x]^2)+eps), see segment_std
         std = jnp.sqrt(jnp.maximum(sq / deg - mean * mean, 0.0) + 1e-5)
-        aggr = jnp.concatenate(
-            [
-                mean,
-                # reuse the counting pass's non-empty mask — saves the hidden
-                # segment_count scatter inside min/max
-                segment_min(h, batch.receivers, n, has=has),
-                segment_max(h, batch.receivers, n, has=has),
-                std,
-            ],
-            axis=-1,
-        )
+        # min+max from ONE packed scatter (scatter passes dominate at this
+        # scale); reuses the counting pass's non-empty mask too
+        mn, mx = segment_minmax_fused(h, batch.receivers, n, has=has)
+        aggr = jnp.concatenate([mean, mn, mx, std], axis=-1)
         log_deg = jnp.log(deg + 1.0)
         scaled = jnp.concatenate(
             [

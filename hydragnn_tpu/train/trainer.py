@@ -48,6 +48,33 @@ class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
 
 
+class SchedState(struct.PyTreeNode):
+    """Device-resident scheduler/guard state for the on-device fit loop:
+    ReduceLROnPlateau (best/bad-epochs), EarlyStopping (best/counter/flag)
+    and the epoch index — all scalars living in HBM so whole-training
+    dispatches never bounce scheduler decisions off the host."""
+
+    plateau_best: jnp.ndarray  # f32
+    plateau_bad: jnp.ndarray  # i32
+    early_best: jnp.ndarray  # f32
+    early_count: jnp.ndarray  # i32
+    stopped: jnp.ndarray  # bool
+    epoch: jnp.ndarray  # i32
+    best_val: jnp.ndarray  # f32, for best-state tracking
+
+    @classmethod
+    def init(cls):
+        return cls(
+            plateau_best=jnp.asarray(jnp.inf, jnp.float32),
+            plateau_bad=jnp.zeros((), jnp.int32),
+            early_best=jnp.asarray(jnp.inf, jnp.float32),
+            early_count=jnp.zeros((), jnp.int32),
+            stopped=jnp.zeros((), bool),
+            epoch=jnp.zeros((), jnp.int32),
+            best_val=jnp.asarray(jnp.inf, jnp.float32),
+        )
+
+
 def _nbatch(loader):
     n = len(loader)
     cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
@@ -72,8 +99,20 @@ class Trainer:
         self.freeze_conv = freeze_conv
         self.tx = None
         self._train_step = None
+        self._train_multi = None
+        self._epoch_scan = None
+        self._fit_scan = None
         self._eval_step = None
         self._batch_sharding = None
+        self._stacked_sharding = None
+        # one dispatch runs this many optimizer steps via lax.scan (1 = the
+        # plain per-batch path); settable in config or HYDRAGNN_STEPS_PER_DISPATCH
+        self.steps_per_dispatch = int(
+            os.getenv(
+                "HYDRAGNN_STEPS_PER_DISPATCH",
+                str(training_config.get("steps_per_dispatch", 1)),
+            )
+        )
 
     # ---- state ---------------------------------------------------------
     def init_state(self, example_batch: GraphBatch, seed: int = 0) -> TrainState:
@@ -149,6 +188,28 @@ class Trainer:
             )
         return jax.tree_util.tree_map(jnp.asarray, batch)
 
+    def put_batch_stacked(self, stacked: GraphBatch) -> GraphBatch:
+        """Like :meth:`put_batch` for a ``stack_batches`` result: the scan
+        axis stays unsharded, each microbatch's leading axis shards over
+        ``data``."""
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if self._stacked_sharding is None:
+                self._stacked_sharding = NamedSharding(self.mesh, P(None, "data"))
+            if jax.process_count() > 1:
+                return jax.tree_util.tree_map(
+                    lambda a: jax.make_array_from_process_local_data(
+                        self._stacked_sharding, np.asarray(a)
+                    ),
+                    stacked,
+                )
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a), self._stacked_sharding),
+                stacked,
+            )
+        return jax.tree_util.tree_map(jnp.asarray, stacked)
+
     # ---- compiled steps ------------------------------------------------
     def _build_steps(self):
         model = self.model
@@ -158,13 +219,12 @@ class Trainer:
         # runs in bfloat16. Positions stay f32 (geometry — distances/angles
         # — is precision-critical), BatchNorm statistics and loss reductions
         # are forced to f32 in models/common.py, and segment scatters upcast
-        # to f32 (graph/segment.py). Measured on v5e (bench.py config): the
-        # QM9-scale step is scatter/latency-bound, not matmul-bound (~8 of
-        # ~49 f32 TFLOP/s), so bf16 LOSES there (29k vs 376k graphs/s at
-        # hidden 64; 258k vs 356k at hidden 512 — XLA's bf16 gather/scatter
-        # layouts are the cost). Accuracy-validated opt-in
-        # (tests/test_mixed_precision.py); expect wins only on matmul-bound
-        # configurations/topologies — measure before enabling.
+        # to f32 (graph/segment.py). The QM9-scale step is scatter/
+        # op-latency-bound, not matmul-bound, so bf16 buys little there;
+        # expect wins on matmul-bound configurations (wide hidden dims,
+        # dense-mode batches). Accuracy-validated opt-in
+        # (tests/test_mixed_precision.py) — measure with a true completion
+        # fence before enabling (see BASELINE.md measurement note).
         mixed = bool(self.training_config.get("mixed_precision", False))
 
         def _cast_bf16(tree):
@@ -237,8 +297,309 @@ class Trainer:
                 "outputs": outputs,
             }
 
+        def _microbatch(data, idx):
+            """Gather microbatch ``idx`` out of an HBM-staged stack."""
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, idx, keepdims=False),
+                data,
+            )
+
+        def epoch_scan(state, data, perm, rngs):
+            """A whole epoch in ONE XLA program over an HBM-staged dataset.
+
+            ``data`` is a ``stack_batches`` result living in device memory
+            (see :meth:`stage_batches`); ``perm`` reorders the microbatches
+            each epoch. Each scan step gathers one microbatch out of HBM and
+            runs the fused train step — zero host round-trips inside the
+            epoch. This is the TPU answer to datasets that fit in HBM
+            (QM9-scale and below): stage once, then epochs are pure compute."""
+
+            def body(s, inp):
+                idx, r = inp
+                return train_step(s, _microbatch(data, idx), r)
+
+            return jax.lax.scan(body, state, (perm, rngs))
+
+        sch_cfg = self.training_config.get("scheduler", {})
+        plateau_factor = float(sch_cfg.get("factor", 0.5))
+        plateau_patience = int(sch_cfg.get("patience", 5))
+        plateau_threshold = float(sch_cfg.get("threshold", 1e-4))
+        plateau_min_lr = float(sch_cfg.get("min_lr", 1e-5))
+        early_enabled = bool(self.training_config.get("EarlyStopping", False))
+        early_patience = int(self.training_config.get("patience", 5))
+
+        def eval_epoch(params, batch_stats, data):
+            """Mean loss/tasks over a staged (stacked) eval set, no outputs.
+            Honors ``HYDRAGNN_MAX_NUM_BATCH`` like every other eval path."""
+
+            def body(_, idx):
+                m = eval_step(params, batch_stats, _microbatch(data, idx))
+                return _, (m["loss"], m["tasks"], m["num_graphs"])
+
+            nb = jax.tree_util.tree_leaves(data)[0].shape[0]
+            cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
+            if cap is not None:
+                nb = min(nb, int(cap))
+            _, (loss, tasks, g) = jax.lax.scan(
+                body, None, jnp.arange(nb)
+            )
+            g = g.astype(jnp.float32)
+            denom = jnp.maximum(g.sum(), 1.0)
+            return (loss * g).sum() / denom, (tasks * g[:, None]).sum(0) / denom
+
+        num_tasks = len(model.output_type)
+
+        def fit_scan(
+            state, best_state, sched, train_data, val_data, test_data,
+            perms, rngs,
+        ):
+            """Whole-training dispatch: scan over epochs, each epoch a scan
+            over HBM-staged microbatches; plateau LR, early stopping and
+            best-state tracking run on device (``SchedState``). One D2H
+            readback per CALL, not per epoch — on hosts where readback
+            latency is milliseconds that's cosmetic, on tunneled dev chips
+            it's the difference between launch-bound and compute-bound.
+
+            ``val_data``/``test_data`` may be the train set (the reference's
+            ``HYDRAGNN_VALTEST=0`` semantics are handled by the caller).
+            Epochs after the early stop fire are skipped via ``lax.cond``
+            (their metric slots return NaN)."""
+
+            def epoch_body(carry, inp):
+                state, best_state, sched = carry
+                perm, erngs = inp
+
+                def run(args):
+                    state, best_state, sched = args
+                    state, m = epoch_scan(state, train_data, perm, erngs)
+                    g = m["num_graphs"].astype(jnp.float32)
+                    denom = jnp.maximum(g.sum(), 1.0)
+                    train_loss = (m["loss"] * g).sum() / denom
+                    train_tasks = (m["tasks"] * g[:, None]).sum(0) / denom
+                    # None val/test = the reference's HYDRAGNN_VALTEST=0
+                    # semantics: reuse the train loss, skip the eval pass
+                    if val_data is None:
+                        val_loss = train_loss
+                    else:
+                        val_loss, _ = eval_epoch(
+                            state.params, state.batch_stats, val_data
+                        )
+                    if test_data is None:
+                        test_loss = val_loss
+                    else:
+                        test_loss, _ = eval_epoch(
+                            state.params, state.batch_stats, test_data
+                        )
+                    # ---- ReduceLROnPlateau (scheduler.py semantics)
+                    is_better = val_loss < sched.plateau_best * (
+                        1.0 - plateau_threshold
+                    )
+                    pbest = jnp.where(is_better, val_loss, sched.plateau_best)
+                    pbad = jnp.where(is_better, 0, sched.plateau_bad + 1)
+                    hp = state.opt_state.hyperparams
+                    lr = hp["learning_rate"]
+                    drop = pbad > plateau_patience
+                    new_lr = jnp.where(
+                        drop,
+                        jnp.maximum(lr * plateau_factor, plateau_min_lr),
+                        lr,
+                    )
+                    pbad = jnp.where(drop, 0, pbad)
+                    opt_state = state.opt_state._replace(
+                        hyperparams={**hp, "learning_rate": new_lr}
+                    )
+                    state = state.replace(opt_state=opt_state)
+                    # ---- EarlyStopping (utils/model.py:189-204 semantics)
+                    e_better = val_loss < sched.early_best
+                    e_best = jnp.where(e_better, val_loss, sched.early_best)
+                    e_count = jnp.where(e_better, 0, sched.early_count + 1)
+                    stopped = (
+                        (e_count >= early_patience)
+                        if early_enabled
+                        else jnp.zeros((), bool)
+                    )
+                    # ---- best-state snapshot (Checkpoint-on-best analog)
+                    improved = val_loss < sched.best_val
+                    new_best_val = jnp.where(improved, val_loss, sched.best_val)
+                    best_state = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(improved, new, old),
+                        state,
+                        best_state,
+                    )
+                    sched = SchedState(
+                        plateau_best=pbest,
+                        plateau_bad=pbad,
+                        early_best=e_best,
+                        early_count=e_count,
+                        stopped=stopped,
+                        epoch=sched.epoch + 1,
+                        best_val=new_best_val,
+                    )
+                    # one packed row per epoch so the whole series is ONE
+                    # D2H array: [train, val, test, lr, stopped, tasks...]
+                    row = jnp.concatenate(
+                        [
+                            jnp.stack(
+                                [train_loss, val_loss, test_loss,
+                                 new_lr.astype(jnp.float32),
+                                 stopped.astype(jnp.float32)]
+                            ),
+                            train_tasks.astype(jnp.float32),
+                        ]
+                    )
+                    return (state, best_state, sched), row
+
+                def skip(args):
+                    state, best_state, sched = args
+                    nan = jnp.asarray(jnp.nan, jnp.float32)
+                    lr = state.opt_state.hyperparams["learning_rate"]
+                    row = jnp.concatenate(
+                        [
+                            jnp.stack(
+                                [nan, nan, nan, lr.astype(jnp.float32),
+                                 jnp.ones((), jnp.float32)]
+                            ),
+                            jnp.full((num_tasks,), jnp.nan, jnp.float32),
+                        ]
+                    )
+                    return (state, best_state, sched), row
+
+                return jax.lax.cond(
+                    sched.stopped, skip, run, (state, best_state, sched)
+                )
+
+            (state, best_state, sched), series = jax.lax.scan(
+                epoch_body, (state, best_state, sched), (perms, rngs)
+            )
+            return state, best_state, sched, series
+
+        def multi_train_step(state, batches, rngs):
+            """K optimizer steps in ONE XLA program (``lax.scan`` over a
+            stacked batch). Amortizes dispatch latency: at QM9 scale a single
+            step's device time is well under the host's per-dispatch cost, so
+            the eager-style loop is launch-bound (measured ~2.3 ms/step wall
+            vs ~0.6 ms device on v5e). Metrics come back stacked ``[K, ...]``
+            so epoch accumulation stays exact."""
+
+            def body(s, inp):
+                b, r = inp
+                return train_step(s, b, r)
+
+            return jax.lax.scan(body, state, (batches, rngs))
+
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._train_multi = jax.jit(multi_train_step, donate_argnums=(0,))
+        self._epoch_scan = jax.jit(epoch_scan, donate_argnums=(0,))
+        # donate state + sched; best_state is NOT donated (its initial value
+        # may alias state's buffers)
+        self._fit_scan = jax.jit(fit_scan, donate_argnums=(0, 2))
         self._eval_step = jax.jit(eval_step)
+
+    # ---- device-resident dataset --------------------------------------
+    def stage_batches(self, batches) -> GraphBatch:
+        """Stack same-shape collated batches and park them in HBM once.
+
+        Returns a device-resident epoch usable with
+        :meth:`train_epoch_staged`. Use when the (padded) training set fits
+        device memory — it removes host->device transfers from the training
+        loop entirely, which otherwise bound small-graph workloads."""
+        from hydragnn_tpu.graph.batch import stack_batches
+
+        return self.put_batch_stacked(stack_batches(list(batches)))
+
+    def train_epoch_staged(self, state, staged, rng, shuffle=True):
+        """One epoch over an HBM-staged dataset in a single dispatch.
+
+        Shuffling permutes microbatch ORDER each epoch (sample->batch
+        assignment is fixed at staging time — the streaming ``train_epoch``
+        path reshuffles samples fully; restage periodically if you want
+        that here). Returns the same (state, rng, loss, tasks) contract as
+        :meth:`train_epoch`."""
+        nb = jax.tree_util.tree_leaves(staged)[0].shape[0]
+        cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
+        n_use = min(nb, int(cap)) if cap is not None else nb
+        rng, prng = jax.random.split(rng)
+        if shuffle:
+            perm = jax.random.permutation(prng, nb)[:n_use]
+        else:
+            perm = jnp.arange(n_use)
+        subs = jax.random.split(rng, n_use + 1)
+        rng = subs[0]
+        tr.start("train")
+        state, metrics = self._epoch_scan(state, staged, perm, subs[1:])
+        g = np.asarray(metrics["num_graphs"], np.float64)
+        tot = float(np.asarray(metrics["loss"], np.float64) @ g)
+        tasks = (np.asarray(metrics["tasks"], np.float64) * g[:, None]).sum(0)
+        tr.stop("train")
+        n = max(float(g.sum()), 1.0)
+        return state, rng, tot / n, tasks / n
+
+    def fit_staged(
+        self,
+        state,
+        staged_train,
+        num_epoch: int,
+        rng,
+        staged_val=None,
+        staged_test=None,
+        shuffle: bool = True,
+        sched: Optional[SchedState] = None,
+        best_state: Optional[TrainState] = None,
+    ):
+        """Run ``num_epoch`` training epochs as ONE device dispatch.
+
+        Everything the reference's epoch driver does per epoch —
+        ReduceLROnPlateau on the val loss, EarlyStopping, best-val state
+        tracking (the ``Checkpoint`` analog), val+test evaluation — runs on
+        device inside a single ``lax.scan`` over epochs; the metric series
+        comes back as one packed array, i.e. ONE host readback per call.
+        Call it in chunks (e.g. 10 epochs at a time) when host-side
+        per-epoch actions are needed (TensorBoard, SLURM wall-clock guard):
+        ``sched``/``best_state`` carry across calls.
+
+        Returns ``(state, best_state, sched, rng, series)`` where ``rng`` is
+        the advanced key and ``series`` is a dict of numpy arrays over
+        epochs: ``train_loss``, ``val_loss``, ``test_loss``, ``lr``,
+        ``stopped``, ``train_tasks [E, T]`` — NaN rows mark epochs skipped
+        after early stop fired.
+        """
+        nb = jax.tree_util.tree_leaves(staged_train)[0].shape[0]
+        cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
+        n_use = min(nb, int(cap)) if cap is not None else nb
+        rng, prng = jax.random.split(rng)
+        if shuffle:
+            perms = jax.vmap(
+                lambda k: jax.random.permutation(k, nb)[:n_use]
+            )(jax.random.split(prng, num_epoch))
+        else:
+            perms = jnp.tile(jnp.arange(n_use), (num_epoch, 1))
+        subs = jax.random.split(rng, num_epoch * n_use + 1)
+        rng = subs[0]
+        erngs = subs[1:].reshape(num_epoch, n_use, -1)
+        if sched is None:
+            sched = SchedState.init()
+            if self.mesh is not None:
+                sched = jax.tree_util.tree_map(jnp.asarray, sched)
+        if best_state is None:
+            # explicit copy: ``state`` is donated, the snapshot must not
+            # alias its buffers
+            best_state = jax.tree_util.tree_map(jnp.copy, state)
+        tr.start("train")
+        state, best_state, sched, series = self._fit_scan(
+            state, best_state, sched, staged_train, staged_val,
+            staged_test, perms, erngs,
+        )
+        series = np.asarray(series)  # the single readback
+        tr.stop("train")
+        out = {
+            "train_loss": series[:, 0],
+            "val_loss": series[:, 1],
+            "test_loss": series[:, 2],
+            "lr": series[:, 3],
+            "stopped": series[:, 4] > 0.5,
+            "train_tasks": series[:, 5:],
+        }
+        return state, best_state, sched, rng, out
 
     # ---- epoch loops ---------------------------------------------------
     def train_epoch(self, state, loader, rng):
@@ -246,12 +607,29 @@ class Trainer:
         tasks = None
         n = 0.0
         nbatch = _nbatch(loader)
+        K = max(1, self.steps_per_dispatch)
+        pending = []
         tr.start("train")
-        for ibatch, batch in enumerate(loader):
-            if ibatch >= nbatch:
-                break
+
+        def _flush(state, rng, tot, tasks, n, group):
+            if len(group) > 1:
+                from hydragnn_tpu.graph.batch import stack_batches
+
+                tr.start("dataload")
+                stacked = self.put_batch_stacked(stack_batches(group))
+                tr.stop("dataload")
+                subs = jax.random.split(rng, len(group) + 1)
+                rng = subs[0]
+                tr.start("train_step")
+                state, metrics = self._train_multi(state, stacked, subs[1:])
+                tr.stop("train_step")
+                g = np.asarray(metrics["num_graphs"], np.float64)  # [K]
+                tot += float(np.asarray(metrics["loss"], np.float64) @ g)
+                t = (np.asarray(metrics["tasks"], np.float64) * g[:, None]).sum(0)
+                tasks_ = t if tasks is None else tasks + t
+                return state, rng, tot, tasks_, n + float(g.sum())
             tr.start("dataload")
-            batch = self.put_batch(batch)
+            batch = self.put_batch(group[0])
             tr.stop("dataload")
             rng, sub = jax.random.split(rng)
             tr.start("train_step")
@@ -260,8 +638,27 @@ class Trainer:
             g = float(metrics["num_graphs"])
             tot += float(metrics["loss"]) * g
             t = np.asarray(metrics["tasks"]) * g
-            tasks = t if tasks is None else tasks + t
-            n += g
+            tasks_ = t if tasks is None else tasks + t
+            return state, rng, tot, tasks_, n + g
+
+        for ibatch, batch in enumerate(loader):
+            if ibatch >= nbatch:
+                break
+            if K == 1:
+                state, rng, tot, tasks, n = _flush(
+                    state, rng, tot, tasks, n, [batch]
+                )
+                continue
+            pending.append(batch)
+            if len(pending) == K:
+                state, rng, tot, tasks, n = _flush(
+                    state, rng, tot, tasks, n, pending
+                )
+                pending = []
+        # trailing partial group: single-step path (a short stack would be a
+        # fresh scan-length compile)
+        for batch in pending:
+            state, rng, tot, tasks, n = _flush(state, rng, tot, tasks, n, [batch])
         tr.stop("train")
         n = max(n, 1.0)
         return state, rng, tot / n, (tasks / n if tasks is not None else np.zeros(0))
@@ -423,13 +820,29 @@ def train_validate_test(
     total_loss_test = np.zeros(num_epoch)
     skip_valtest = int(os.getenv("HYDRAGNN_VALTEST", "1")) == 0
 
+    # device-resident mode: stage the (collated) training set in HBM once;
+    # every epoch is then a single scan dispatch with no H2D traffic
+    staged = None
+    if int(
+        os.getenv(
+            "HYDRAGNN_DEVICE_RESIDENT",
+            str(int(training.get("device_resident_dataset", False))),
+        )
+    ):
+        staged = trainer.stage_batches(list(train_loader))
+
     epoch_time = 0.0
     for epoch in range(num_epoch):
         t0 = time.time()
         train_loader.set_epoch(epoch)
-        state, rng, train_loss, train_tasks = trainer.train_epoch(
-            state, train_loader, rng
-        )
+        if staged is not None:
+            state, rng, train_loss, train_tasks = trainer.train_epoch_staged(
+                state, staged, rng
+            )
+        else:
+            state, rng, train_loss, train_tasks = trainer.train_epoch(
+                state, train_loader, rng
+            )
         if skip_valtest:
             val_loss, val_tasks = train_loss, train_tasks
             test_loss, test_tasks = train_loss, train_tasks

@@ -1,0 +1,262 @@
+"""Scan-based multi-step dispatch parity.
+
+One `lax.scan` dispatch over K stacked microbatches must produce the same
+training trajectory as K plain per-batch steps — exact epoch metrics, same
+final parameters. This is the dispatch-amortization path
+(`Trainer.steps_per_dispatch` / `HYDRAGNN_STEPS_PER_DISPATCH`); the
+reference has no counterpart (its hot loop is eager per-batch,
+`train/train_validate_test.py:463-520`).
+"""
+
+import numpy as np
+import jax
+
+from hydragnn_tpu.graph import collate_graphs, pad_sizes_for, stack_batches
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.parallel.mesh import make_mesh
+from hydragnn_tpu.train.trainer import Trainer
+
+from test_models_forward import FakeData
+
+
+def _arch(model_type="PNA", max_n=6):
+    return {
+        "model_type": model_type,
+        "input_dim": 1,
+        "hidden_dim": 16,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {"num_headlayers": 1, "dim_headlayers": [8], "type": "mlp"},
+        },
+        "task_weights": [1.0, 1.0],
+        "num_conv_layers": 2,
+        "num_nodes": max_n,
+        "edge_dim": None,
+        "pna_deg": [0, 2, 4, 2],
+        "equivariance": False,
+    }
+
+
+def _batches(num_batches, num_graphs=8, max_n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    n_pad, e_pad, g_pad = pad_sizes_for(
+        max_n, 2 * max_n, num_graphs, graph_multiple=8
+    )
+    out = []
+    for _ in range(num_batches):
+        samples = [
+            FakeData(rng, int(rng.integers(3, max_n + 1)))
+            for _ in range(num_graphs)
+        ]
+        out.append(
+            collate_graphs(
+                samples, n_pad, e_pad, g_pad,
+                head_types=("graph", "node"), head_dims=(1, 1),
+            )
+        )
+    return out
+
+
+class ListLoader:
+    def __init__(self, batches):
+        self.batches = batches
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        return iter(self.batches)
+
+    def set_epoch(self, epoch):
+        pass
+
+
+def _run(batches, steps_per_dispatch, mesh=None):
+    model = create_model_config(_arch())
+    trainer = Trainer(
+        model,
+        training_config={
+            "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+            "steps_per_dispatch": steps_per_dispatch,
+        },
+        mesh=mesh,
+    )
+    state = trainer.init_state(batches[0])
+    state, _rng, loss, tasks = trainer.train_epoch(
+        state, ListLoader(batches), jax.random.PRNGKey(0)
+    )
+    return state, loss, tasks
+
+
+def pytest_multistep_matches_single_step():
+    batches = _batches(5)  # K=2 -> two stacked dispatches + one trailing single
+    s1, loss1, tasks1 = _run(batches, 1)
+    s2, loss2, tasks2 = _run(batches, 2)
+    assert np.isclose(loss1, loss2, rtol=1e-5), (loss1, loss2)
+    np.testing.assert_allclose(tasks1, tasks2, rtol=1e-5)
+    flat1 = jax.tree_util.tree_leaves(s1.params)
+    flat2 = jax.tree_util.tree_leaves(s2.params)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    flat1 = jax.tree_util.tree_leaves(s1.batch_stats)
+    flat2 = jax.tree_util.tree_leaves(s2.batch_stats)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def pytest_multistep_sharded_mesh():
+    mesh = make_mesh(8)
+    batches = _batches(4)
+    s1, loss1, _ = _run(batches, 1, mesh=mesh)
+    s2, loss2, _ = _run(batches, 4, mesh=mesh)
+    assert np.isclose(loss1, loss2, rtol=1e-5), (loss1, loss2)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def pytest_staged_epoch_matches_streaming():
+    batches = _batches(4)
+    model = create_model_config(_arch())
+    cfg = {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+    t1 = Trainer(model, training_config=cfg)
+    s1 = t1.init_state(batches[0])
+    s1, _, loss1, tasks1 = t1.train_epoch(
+        s1, ListLoader(batches), jax.random.PRNGKey(0)
+    )
+    t2 = Trainer(model, training_config=cfg)
+    s2 = t2.init_state(batches[0])
+    staged = t2.stage_batches(batches)
+    s2, _, loss2, tasks2 = t2.train_epoch_staged(
+        s2, staged, jax.random.PRNGKey(0), shuffle=False
+    )
+    assert np.isclose(loss1, loss2, rtol=1e-5), (loss1, loss2)
+    np.testing.assert_allclose(tasks1, tasks2, rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def pytest_staged_epoch_shuffles_batch_order():
+    batches = _batches(4)
+    model = create_model_config(_arch())
+    cfg = {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+    t = Trainer(model, training_config=cfg)
+    s = t.init_state(batches[0])
+    staged = t.stage_batches(batches)
+    # two epochs with shuffle: runs, stays finite, and the rng advances
+    rng = jax.random.PRNGKey(0)
+    s, rng1, loss_a, _ = t.train_epoch_staged(s, staged, rng)
+    s, rng2, loss_b, _ = t.train_epoch_staged(s, staged, rng1)
+    assert np.isfinite(loss_a) and np.isfinite(loss_b)
+    assert not np.array_equal(np.asarray(rng1), np.asarray(rng2))
+
+
+def pytest_fit_staged_matches_per_epoch_loop():
+    """One whole-training dispatch == N per-epoch dispatches (no shuffle,
+    plateau never fires in 3 epochs)."""
+    batches = _batches(3)
+    model = create_model_config(_arch())
+    cfg = {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+
+    t1 = Trainer(model, training_config=cfg)
+    s1 = t1.init_state(batches[0])
+    staged1 = t1.stage_batches(batches)
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(3):
+        s1, rng, loss, _ = t1.train_epoch_staged(s1, staged1, rng, shuffle=False)
+        losses.append(loss)
+
+    t2 = Trainer(model, training_config=cfg)
+    s2 = t2.init_state(batches[0])
+    staged2 = t2.stage_batches(batches)
+    s2, best2, sched2, _, series = t2.fit_staged(
+        s2, staged2, 3, jax.random.PRNGKey(0), shuffle=False
+    )
+    np.testing.assert_allclose(series["train_loss"], losses, rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    # train improves -> best_state tracks the last (lowest-val) epoch
+    assert float(sched2.best_val) <= series["val_loss"][0]
+    assert int(sched2.epoch) == 3
+    assert not series["stopped"].any()
+
+
+def pytest_fit_staged_chunked_carry():
+    """Two 2-epoch dispatches with carried sched/best == one 4-epoch
+    dispatch (models have no dropout, so rng streams don't affect math)."""
+    batches = _batches(3)
+    model = create_model_config(_arch())
+    cfg = {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+
+    ta = Trainer(model, training_config=cfg)
+    sa = ta.init_state(batches[0])
+    sta = ta.stage_batches(batches)
+    sa, besta, scheda, rnga, ser_a = ta.fit_staged(
+        sa, sta, 2, jax.random.PRNGKey(7), shuffle=False
+    )
+    sa, besta, scheda, rnga, ser_a2 = ta.fit_staged(
+        sa, sta, 2, rnga, shuffle=False, sched=scheda, best_state=besta
+    )
+
+    tb = Trainer(model, training_config=cfg)
+    sb = tb.init_state(batches[0])
+    stb = tb.stage_batches(batches)
+    sb, bestb, schedb, _, ser_b = tb.fit_staged(
+        sb, stb, 4, jax.random.PRNGKey(7), shuffle=False
+    )
+    np.testing.assert_allclose(
+        np.concatenate([ser_a["train_loss"], ser_a2["train_loss"]]),
+        ser_b["train_loss"],
+        rtol=1e-5,
+    )
+    assert int(scheda.epoch) == int(schedb.epoch) == 4
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sa.params), jax.tree_util.tree_leaves(sb.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def pytest_fit_staged_early_stop_and_val():
+    """With a huge LR the loss diverges; early stopping with patience 1
+    must fire and later epochs come back as NaN-marked skips."""
+    batches = _batches(2)
+    model = create_model_config(_arch())
+    cfg = {
+        "Optimizer": {"type": "SGD", "learning_rate": 1e6},
+        "EarlyStopping": True,
+        "patience": 1,
+    }
+    t = Trainer(model, training_config=cfg)
+    s = t.init_state(batches[0])
+    staged = t.stage_batches(batches)
+    val = t.stage_batches(batches[:1])
+    s, best, sched, _, series = t.fit_staged(
+        s, staged, 8, jax.random.PRNGKey(0), staged_val=val, shuffle=False
+    )
+    assert series["stopped"].any()
+    first_stop = int(np.argmax(series["stopped"]))
+    # every epoch after the stop is a NaN skip row
+    if first_stop + 1 < len(series["train_loss"]):
+        assert np.isnan(series["train_loss"][first_stop + 1 :]).all()
+    assert bool(sched.stopped)
+
+
+def pytest_stack_batches_shapes():
+    batches = _batches(3)
+    stacked = stack_batches(batches)
+    assert stacked.x.shape == (3,) + batches[0].x.shape
+    assert stacked.senders.shape == (3,) + batches[0].senders.shape
+    assert len(stacked.targets) == 2
