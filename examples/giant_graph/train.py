@@ -177,21 +177,22 @@ def main():
     state = put_partitioned_state(state, mesh)
     step = make_partitioned_train_step(model, tx, mesh, "graph")
 
+    from hydragnn_tpu.utils.sync import fence
+
     rng = jax.random.PRNGKey(0)
     state, metrics = step(state, pbatch, rng)  # compile
     loss0 = metrics["loss"]
-    # NOTE: do not fetch scalars before the timed loop — on tunneled dev
-    # backends a host read can drop the session into synchronous dispatch
-    # and every later step pays a full round trip.
     for _ in range(2):  # settle any backend warmup
         rng, sub = jax.random.split(rng)
         state, metrics = step(state, pbatch, sub)
-    jax.block_until_ready(metrics["loss"])
+    fence(metrics["loss"])
     t0 = time.time()
     for i in range(3, steps):
         rng, sub = jax.random.split(rng)
         state, metrics = step(state, pbatch, sub)
-    jax.block_until_ready(metrics["loss"])
+    # true completion fence — block_until_ready does not block on tunneled
+    # dev backends; the single host readback is amortized over the steps
+    fence(metrics["loss"])
     dt = (time.time() - t0) / max(steps - 3, 1)
     print(f"step 0: loss {float(loss0):.6f}")
     print(

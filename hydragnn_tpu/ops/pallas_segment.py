@@ -13,18 +13,17 @@ dense matmul the systolic array eats — so the messages are read from HBM
 exactly ONCE. ``segment_moments`` produces sum, count and sum-of-squares in
 that single pass (mean/std/degree all derive from it).
 
-Enablement: ``HYDRAGNN_PALLAS=1`` opts in (with the accumulator-fits-VMEM
-guard), ``0``/unset keeps the XLA path. Measured on v5e (bench.py, PNA
-multihead, ~4.6k nodes / ~15k edges / dim 64): pallas 283k graphs/s vs XLA
-scatter 344k — the one-hot matmul pays for a [E_blk, N] indicator against
-N≈4600 segments, so XLA's sorted scatter wins at QM9-scale segment counts
-and the default stays OFF. Standalone (benchmarks/segment_bench.py) the
-kernel wins ~10-20% at dense degree (E/N >= 20), but end-to-end it still
-loses even at E/N ~= 11 (giant_graph example: 0.8 vs 0.7 ms/step) because
-XLA fuses its scatter with the surrounding elementwise work inside the full
-step — a fusion the opaque pallas_call boundary forfeits. Revisit only with
-a kernel that fuses the message MLP + aggregation. Gradients are provided
-via custom VJPs (gather-based, XLA-fused).
+Enablement: ``HYDRAGNN_PALLAS=1`` opts in (with the VMEM-budget guard
+below), ``0``/unset keeps the XLA path. Fence-true measurement on the
+tunneled v5e (bench.py fit_staged, PNA multihead, ~4.6k nodes / ~18k edges
+/ dim 64, 2026-07-30): pallas 4.44 ms/step vs XLA scatter 4.45 — a dead
+heat end-to-end, because the moments kernel replaces only one of the
+remaining scatter passes and the step is op-latency-bound on this backend.
+XLA additionally fuses its scatter with the surrounding elementwise work —
+a fusion the opaque pallas_call boundary forfeits — so the default stays
+OFF. Revisit with a kernel that fuses the message MLP + aggregation on
+hardware where scatters dominate. Gradients are provided via custom VJPs
+(gather-based, XLA-fused).
 """
 
 import functools
@@ -38,11 +37,18 @@ _VMEM_ACC_BUDGET = 6 * 1024 * 1024  # bytes of VMEM we allow the accumulators
 
 
 def pallas_segments_enabled(num_segments: int, dim: int, n_outputs: int = 1):
-    """Decide kernel vs XLA fallback for a [num_segments, dim] accumulation."""
+    """Decide kernel vs XLA fallback for a [num_segments, dim] accumulation.
+
+    Budget covers everything the kernel keeps resident in VMEM: the
+    accumulators AND the per-block ``[_EDGE_BLOCK, num_segments]`` one-hot
+    indicator (at 16k+ segments the indicator alone exceeds the 16 MB VMEM
+    scoped limit — observed as a compile-time VMEM OOM on the giant-graph
+    partition config before this guard included it)."""
     if os.getenv("HYDRAGNN_PALLAS", "0") != "1":
         return False
     acc_bytes = n_outputs * num_segments * max(dim, 1) * 4
-    return acc_bytes <= _VMEM_ACC_BUDGET
+    onehot_bytes = _EDGE_BLOCK * num_segments * 4
+    return acc_bytes + onehot_bytes <= _VMEM_ACC_BUDGET
 
 
 def _interpret(requested: bool) -> bool:

@@ -327,6 +327,15 @@ class Trainer:
         plateau_min_lr = float(sch_cfg.get("min_lr", 1e-5))
         early_enabled = bool(self.training_config.get("EarlyStopping", False))
         early_patience = int(self.training_config.get("patience", 5))
+        # best-state tracking starts after this many epochs (the reference
+        # BestCheckpoint warmup, ``utils/model.py:207-248``; default 10 when
+        # checkpointing is on, else track from the start)
+        best_warmup = int(
+            self.training_config.get(
+                "checkpoint_warmup",
+                10 if self.training_config.get("Checkpoint", False) else 0,
+            )
+        )
 
         def eval_epoch(params, batch_stats, data):
             """Mean loss/tasks over a staged (stacked) eval set, no outputs.
@@ -351,7 +360,7 @@ class Trainer:
 
         def fit_scan(
             state, best_state, sched, train_data, val_data, test_data,
-            perms, rngs,
+            perms, rngs, active,
         ):
             """Whole-training dispatch: scan over epochs, each epoch a scan
             over HBM-staged microbatches; plateau LR, early stopping and
@@ -362,12 +371,14 @@ class Trainer:
 
             ``val_data``/``test_data`` may be the train set (the reference's
             ``HYDRAGNN_VALTEST=0`` semantics are handled by the caller).
-            Epochs after the early stop fire are skipped via ``lax.cond``
-            (their metric slots return NaN)."""
+            Epochs after the early stop fire — and epochs whose ``active``
+            flag is False (scan-length padding so every chunk reuses one
+            compiled program) — are skipped via ``lax.cond`` (their metric
+            slots return NaN)."""
 
             def epoch_body(carry, inp):
                 state, best_state, sched = carry
-                perm, erngs = inp
+                perm, erngs, act = inp
 
                 def run(args):
                     state, best_state, sched = args
@@ -418,8 +429,11 @@ class Trainer:
                         if early_enabled
                         else jnp.zeros((), bool)
                     )
-                    # ---- best-state snapshot (Checkpoint-on-best analog)
-                    improved = val_loss < sched.best_val
+                    # ---- best-state snapshot (Checkpoint-on-best analog,
+                    # warmup-gated like utils/model.py:207-248)
+                    improved = (val_loss < sched.best_val) & (
+                        sched.epoch >= best_warmup
+                    )
                     new_best_val = jnp.where(improved, val_loss, sched.best_val)
                     best_state = jax.tree_util.tree_map(
                         lambda new, old: jnp.where(improved, new, old),
@@ -457,7 +471,7 @@ class Trainer:
                         [
                             jnp.stack(
                                 [nan, nan, nan, lr.astype(jnp.float32),
-                                 jnp.ones((), jnp.float32)]
+                                 sched.stopped.astype(jnp.float32)]
                             ),
                             jnp.full((num_tasks,), jnp.nan, jnp.float32),
                         ]
@@ -465,11 +479,14 @@ class Trainer:
                     return (state, best_state, sched), row
 
                 return jax.lax.cond(
-                    sched.stopped, skip, run, (state, best_state, sched)
+                    jnp.logical_or(sched.stopped, jnp.logical_not(act)),
+                    skip,
+                    run,
+                    (state, best_state, sched),
                 )
 
             (state, best_state, sched), series = jax.lax.scan(
-                epoch_body, (state, best_state, sched), (perms, rngs)
+                epoch_body, (state, best_state, sched), (perms, rngs, active)
             )
             return state, best_state, sched, series
 
@@ -545,6 +562,7 @@ class Trainer:
         shuffle: bool = True,
         sched: Optional[SchedState] = None,
         best_state: Optional[TrainState] = None,
+        pad_to: Optional[int] = None,
     ):
         """Run ``num_epoch`` training epochs as ONE device dispatch.
 
@@ -555,7 +573,9 @@ class Trainer:
         comes back as one packed array, i.e. ONE host readback per call.
         Call it in chunks (e.g. 10 epochs at a time) when host-side
         per-epoch actions are needed (TensorBoard, SLURM wall-clock guard):
-        ``sched``/``best_state`` carry across calls.
+        ``sched``/``best_state`` carry across calls. ``pad_to`` pads the
+        scan length so a shorter final chunk reuses the compiled program
+        (padded epochs are inert and trimmed from the returned series).
 
         Returns ``(state, best_state, sched, rng, series)`` where ``rng`` is
         the advanced key and ``series`` is a dict of numpy arrays over
@@ -566,16 +586,18 @@ class Trainer:
         nb = jax.tree_util.tree_leaves(staged_train)[0].shape[0]
         cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
         n_use = min(nb, int(cap)) if cap is not None else nb
+        n_sched = max(num_epoch, pad_to or 0)
         rng, prng = jax.random.split(rng)
         if shuffle:
             perms = jax.vmap(
                 lambda k: jax.random.permutation(k, nb)[:n_use]
-            )(jax.random.split(prng, num_epoch))
+            )(jax.random.split(prng, n_sched))
         else:
-            perms = jnp.tile(jnp.arange(n_use), (num_epoch, 1))
-        subs = jax.random.split(rng, num_epoch * n_use + 1)
+            perms = jnp.tile(jnp.arange(n_use), (n_sched, 1))
+        subs = jax.random.split(rng, n_sched * n_use + 1)
         rng = subs[0]
-        erngs = subs[1:].reshape(num_epoch, n_use, -1)
+        erngs = subs[1:].reshape(n_sched, n_use, -1)
+        active = jnp.arange(n_sched) < num_epoch
         if sched is None:
             sched = SchedState.init()
             if self.mesh is not None:
@@ -587,9 +609,9 @@ class Trainer:
         tr.start("train")
         state, best_state, sched, series = self._fit_scan(
             state, best_state, sched, staged_train, staged_val,
-            staged_test, perms, erngs,
+            staged_test, perms, erngs, active,
         )
-        series = np.asarray(series)  # the single readback
+        series = np.asarray(series)[:num_epoch]  # the single readback
         tr.stop("train")
         out = {
             "train_loss": series[:, 0],
@@ -831,8 +853,97 @@ def train_validate_test(
     ):
         staged = trainer.stage_batches(list(train_loader))
 
+    # whole-training dispatch: fit_chunk_epochs > 0 runs training in chunks
+    # of N epochs, each chunk ONE XLA program (on-device plateau LR, early
+    # stop, best-state tracking); host work between chunks only — logging,
+    # TensorBoard, checkpoint, SLURM wall-clock guard
+    fit_chunk = int(
+        os.getenv(
+            "HYDRAGNN_FIT_CHUNK", str(training.get("fit_chunk_epochs", 0))
+        )
+    )
+    def _log_epoch(ep, train_loss, val_loss, test_loss, train_tasks):
+        total_loss_train[ep] = train_loss
+        total_loss_val[ep] = val_loss
+        total_loss_test[ep] = test_loss
+        print_distributed(
+            verbosity,
+            f"Epoch: {ep:04d}, Train Loss: {train_loss:.8f}, "
+            f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}",
+        )
+        if writer is not None:
+            writer.add_scalar("train error", train_loss, ep)
+            writer.add_scalar("validate error", val_loss, ep)
+            writer.add_scalar("test error", test_loss, ep)
+            for itask, tl in enumerate(np.atleast_1d(train_tasks)):
+                writer.add_scalar(f"train error of task {itask}", float(tl), ep)
+
+    ran_fit = staged is not None and fit_chunk > 0
+    if ran_fit:
+        staged_val = (
+            None if skip_valtest else trainer.stage_batches(list(val_loader))
+        )
+        staged_test = (
+            None if skip_valtest else trainer.stage_batches(list(test_loader))
+        )
+        from hydragnn_tpu.parallel.distributed import check_remaining
+
+        sched = None
+        best_state = None
+        best_saved = np.inf
+        epoch0 = 0
+        while epoch0 < num_epoch:
+            n = min(fit_chunk, num_epoch - epoch0)
+            t0 = time.time()
+            # pad_to keeps every chunk at the same scan length — the short
+            # final chunk must not recompile the whole-training program
+            state, best_state, sched, rng, series = trainer.fit_staged(
+                state,
+                staged,
+                n,
+                rng,
+                staged_val=staged_val,
+                staged_test=staged_test,
+                sched=sched,
+                best_state=best_state,
+                pad_to=fit_chunk,
+            )
+            chunk_time = time.time() - t0
+            for i in range(n):
+                if np.isnan(series["train_loss"][i]):
+                    continue
+                _log_epoch(
+                    epoch0 + i,
+                    series["train_loss"][i],
+                    series["val_loss"][i],
+                    series["test_loss"][i],
+                    series["train_tasks"][i],
+                )
+            # persist the best state after every chunk that improved it —
+            # a preempted job resumes from the last improvement, like the
+            # reference's per-epoch BestCheckpoint (utils/model.py:207-248)
+            if ckpt is not None:
+                bv = float(np.asarray(sched.best_val))
+                if np.isfinite(bv) and bv < best_saved:
+                    save_model(best_state, log_name, ckpt.path)
+                    best_saved = bv
+            epoch0 += n
+            if bool(np.asarray(sched.stopped)):
+                ep_stop = epoch0 - n + int(np.argmax(series["stopped"]))
+                print_distributed(
+                    verbosity, f"Early stopping at epoch {ep_stop}"
+                )
+                break
+            # the next unit of work is an indivisible fit_chunk-epoch
+            # dispatch — reserve a whole chunk's wall time, not one epoch's
+            if not check_remaining(chunk_time):
+                print_distributed(
+                    verbosity, "Stopping: not enough job wall-clock time left"
+                )
+                break
+
     epoch_time = 0.0
-    for epoch in range(num_epoch):
+    for epoch in range(num_epoch if not ran_fit else 0):
         t0 = time.time()
         train_loader.set_epoch(epoch)
         if staged is not None:
@@ -856,20 +967,7 @@ def train_validate_test(
                 opt_state=set_learning_rate(state.opt_state, new_lr)
             )
 
-        total_loss_train[epoch] = train_loss
-        total_loss_val[epoch] = val_loss
-        total_loss_test[epoch] = test_loss
-        print_distributed(
-            verbosity,
-            f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}, "
-            f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}",
-        )
-        if writer is not None:
-            writer.add_scalar("train error", train_loss, epoch)
-            writer.add_scalar("validate error", val_loss, epoch)
-            writer.add_scalar("test error", test_loss, epoch)
-            for itask, tl in enumerate(np.atleast_1d(train_tasks)):
-                writer.add_scalar(f"train error of task {itask}", float(tl), epoch)
+        _log_epoch(epoch, train_loss, val_loss, test_loss, train_tasks)
 
         if visualizer is not None and visualizer.plot_hist_solution:
             _, _, tv, pv = trainer.predict(state, test_loader)

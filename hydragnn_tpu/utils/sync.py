@@ -1,0 +1,35 @@
+"""Device-completion fencing for honest timing.
+
+``jax.block_until_ready`` does NOT actually block on some tunneled dev
+backends (observed on the axon TPU plugin): it returns while the device
+queue is still draining, so any wall-clock measurement fenced with it
+records dispatch rate, not compute time. The only reliable completion
+point is materializing a result byte on the host.
+
+The reference faces the same problem on CUDA (async launches) and solves
+it with ``torch.cuda.synchronize`` in its tracer
+(``hydragnn/utils/tracer.py:110-131``, the ``cudasync`` option); ``fence``
+is the TPU/JAX analog used by ``bench.py``, the examples, and the timers.
+"""
+
+import numpy as np
+
+
+def fence(tree):
+    """Block until every computation feeding ``tree`` has finished.
+
+    Fetches one element of the first array leaf. Device queues execute in
+    order, so fencing on the most recently dispatched output fences all
+    work enqueued before it. Returns ``tree`` unchanged so it can wrap a
+    call site: ``out = fence(step(...))``.
+    """
+    import jax
+
+    leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "ravel")
+    ]
+    if leaves:
+        np.asarray(jax.device_get(leaves[0].ravel()[0:1]))
+    return tree

@@ -161,3 +161,22 @@ def pytest_train_model_vectoroutput(model_type):
 )
 def pytest_train_model_conv_head(model_type):
     unittest_train_model(model_type, "ci_conv_head.json", False)
+
+
+@pytest.mark.parametrize("model_type", ["PNA"])
+def pytest_train_model_whole_training_dispatch(model_type):
+    """Device-resident + chunked whole-training dispatch (fit_staged) must
+    hit the same accuracy ceilings through the public run_training API."""
+    unittest_train_model(
+        model_type,
+        "ci.json",
+        False,
+        overwrite_config={
+            "NeuralNetwork": {
+                "Training": {
+                    "device_resident_dataset": True,
+                    "fit_chunk_epochs": 10,
+                }
+            }
+        },
+    )

@@ -254,6 +254,34 @@ def pytest_fit_staged_early_stop_and_val():
     assert bool(sched.stopped)
 
 
+def pytest_fit_staged_pad_to_inert():
+    """pad_to-padded epochs must be inert: fit(3, pad_to=5) == fit(3), with
+    padded series rows trimmed away."""
+    batches = _batches(3)
+    model = create_model_config(_arch())
+    cfg = {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+    ta = Trainer(model, training_config=cfg)
+    sa = ta.init_state(batches[0])
+    sta = ta.stage_batches(batches)
+    sa, _, scheda, _, ser_a = ta.fit_staged(
+        sa, sta, 3, jax.random.PRNGKey(3), shuffle=False, pad_to=5
+    )
+    tb = Trainer(model, training_config=cfg)
+    sb = tb.init_state(batches[0])
+    stb = tb.stage_batches(batches)
+    sb, _, schedb, _, ser_b = tb.fit_staged(
+        sb, stb, 3, jax.random.PRNGKey(3), shuffle=False
+    )
+    assert ser_a["train_loss"].shape == (3,)
+    np.testing.assert_allclose(ser_a["train_loss"], ser_b["train_loss"], rtol=1e-5)
+    assert int(scheda.epoch) == int(schedb.epoch) == 3
+    assert not ser_a["stopped"].any()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sa.params), jax.tree_util.tree_leaves(sb.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def pytest_stack_batches_shapes():
     batches = _batches(3)
     stacked = stack_batches(batches)
