@@ -11,6 +11,7 @@ from hydragnn_tpu.graph.segment import (
     segment_min,
     segment_std,
     segment_softmax,
+    segment_softmax_unnorm,
     segment_moments_fused,
     segment_minmax_fused,
     segment_count,

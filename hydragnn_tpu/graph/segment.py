@@ -127,22 +127,33 @@ def segment_moments_fused(data, segment_ids, num_segments, weights=None):
     return s[:, :d], s[:, -1:], s[:, d : 2 * d]
 
 
-def segment_softmax(logits, segment_ids, num_segments, mask=None):
-    """Numerically-stable softmax within segments (GAT edge attention).
+def segment_softmax_unnorm(logits, segment_ids, num_segments, mask=None):
+    """Masked, max-shifted ``exp`` — the stable-softmax numerator terms.
 
-    ``mask`` (bool over elements) zeroes out padded edges so they contribute
-    neither to the max nor the normalizer.
+    Shared prologue of :func:`segment_softmax` and fused-attention callers
+    (GAT) that fold the normalizer into their aggregation scatter: returns
+    ``exp(logits - segmax)`` with padded elements exactly zero, so
+    ``segment_sum`` of the result is the softmax denominator.
     """
     if mask is not None:
         m = mask.reshape(mask.shape + (1,) * (logits.ndim - 1))
         logits = jnp.where(m, logits, -_BIG)
     seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
     seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
-    logits = logits - seg_max[segment_ids]
-    unnorm = jnp.exp(logits)
+    unnorm = jnp.exp(logits - seg_max[segment_ids])
     if mask is not None:
         m = mask.reshape(mask.shape + (1,) * (logits.ndim - 1))
         unnorm = jnp.where(m, unnorm, 0.0)
+    return unnorm
+
+
+def segment_softmax(logits, segment_ids, num_segments, mask=None):
+    """Numerically-stable softmax within segments (GAT edge attention).
+
+    ``mask`` (bool over elements) zeroes out padded edges so they contribute
+    neither to the max nor the normalizer.
+    """
+    unnorm = segment_softmax_unnorm(logits, segment_ids, num_segments, mask)
     denom = segment_sum(unnorm, segment_ids, num_segments)
     denom = jnp.maximum(denom, 1e-16)
     return unnorm / denom[segment_ids]

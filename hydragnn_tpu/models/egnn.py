@@ -63,20 +63,25 @@ class E_GCL(nn.Module):
             cw = jnp.tanh(cw)  # tanh=True bounds the update
             trans = jnp.clip(coord_diff * cw, -100.0, 100.0)
             trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
-            # trans and the count share one segment pass + one halo_reduce
+            # the coord update (trans + count) and the node-model message
+            # aggregation all land at the SAME sender index — ONE packed
+            # scatter (and one halo_reduce) instead of two
             both = self._sender_sum(
                 jnp.concatenate(
-                    [trans, batch.edge_mask.astype(trans.dtype)[:, None]], -1
+                    [e, trans, batch.edge_mask.astype(trans.dtype)[:, None]],
+                    -1,
                 ),
                 row,
                 n,
                 batch,
             )
-            agg, cnt = both[:, :3], both[:, 3]
-            pos = pos + agg / jnp.maximum(cnt, 1.0)[:, None]
-
-        # node model: aggregate edge features at the sender index (row)
-        agg = self._sender_sum(e, row, n, batch)
+            agg = both[:, : self.hidden_dim]
+            coord_agg = both[:, self.hidden_dim : self.hidden_dim + 3]
+            cnt = both[:, -1]
+            pos = pos + coord_agg / jnp.maximum(cnt, 1.0)[:, None]
+        else:
+            # node model: aggregate edge features at the sender index (row)
+            agg = self._sender_sum(e, row, n, batch)
         h = jnp.concatenate([x, agg], axis=-1)
         h = jax.nn.relu(TorchLinear(self.hidden_dim, name="node_mlp_0")(h))
         h = TorchLinear(self.out_dim, name="node_mlp_1")(h)

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from hydragnn_tpu.graph import segment_softmax, segment_sum
+from hydragnn_tpu.graph import segment_softmax_unnorm, segment_sum
 from hydragnn_tpu.models.base import HydraBase
 
 
@@ -51,11 +51,20 @@ class GATv2Conv(nn.Module):
         g = x_l[send] + x_r[recv]
         g = jax.nn.leaky_relu(g, self.negative_slope)
         alpha = (g * att).sum(axis=-1)  # [E+N, H]
-        alpha = segment_softmax(alpha, recv, n, mask=emask)
-        alpha = nn.Dropout(rate=self.dropout, deterministic=not train)(alpha)
-        msg = x_l[send] * alpha[..., None]
-        msg = jnp.where(emask[:, None, None], msg, 0.0)
-        out = segment_sum(msg, recv, n)  # [N, H, C]
+        # fused attention: softmax numerator (weighted messages) and
+        # denominator share ONE scatter pass instead of softmax-normalize +
+        # aggregate (3 scatter passes -> 2). Attention dropout applies to
+        # the numerator only — identical to dropping normalized alphas,
+        # since the 1/(1-p) scaling commutes with the division.
+        ex = segment_softmax_unnorm(alpha, recv, n, mask=emask)  # [E+N, H]
+        exd = nn.Dropout(rate=self.dropout, deterministic=not train)(ex)
+        packed = jnp.concatenate(
+            [x_l[send] * exd[..., None], ex[..., None]], axis=-1
+        )  # [E+N, H, C+1]
+        s = segment_sum(
+            packed.reshape(packed.shape[0], h * (c + 1)), recv, n
+        ).reshape(n, h, c + 1)
+        out = s[..., :c] / jnp.maximum(s[..., -1:], 1e-16)  # [N, H, C]
 
         if self.concat:
             out = out.reshape(n, h * c)

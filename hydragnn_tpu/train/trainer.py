@@ -83,6 +83,12 @@ def _nbatch(loader):
     return n
 
 
+def _env_flag(env_name: str, config: dict, config_key: str, default=False):
+    """Boolean knob with the framework's env-overrides-config convention
+    (the reference's ``HYDRAGNN_*`` channel layered over its JSON config)."""
+    return bool(int(os.getenv(env_name, str(int(config.get(config_key, default))))))
+
+
 class Trainer:
     def __init__(
         self,
@@ -102,6 +108,7 @@ class Trainer:
         self._train_multi = None
         self._epoch_scan = None
         self._fit_scan = None
+        self._predict_scan = None
         self._eval_step = None
         self._batch_sharding = None
         self._stacked_sharding = None
@@ -504,9 +511,23 @@ class Trainer:
 
             return jax.lax.scan(body, state, (batches, rngs))
 
+        def predict_scan(params, batch_stats, data):
+            """Full-set prediction in one program: stacked per-microbatch
+            (loss, tasks, num_graphs, outputs) — callers do ONE readback."""
+
+            def body(_, idx):
+                m = eval_step(params, batch_stats, _microbatch(data, idx))
+                return _, (
+                    m["loss"], m["tasks"], m["num_graphs"], m["outputs"]
+                )
+
+            nb = jax.tree_util.tree_leaves(data)[0].shape[0]
+            return jax.lax.scan(body, None, jnp.arange(nb))[1]
+
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
         self._train_multi = jax.jit(multi_train_step, donate_argnums=(0,))
         self._epoch_scan = jax.jit(epoch_scan, donate_argnums=(0,))
+        self._predict_scan = jax.jit(predict_scan)
         # donate state + sched; best_state is NOT donated (its initial value
         # may alias state's buffers)
         self._fit_scan = jax.jit(fit_scan, donate_argnums=(0, 2))
@@ -716,6 +737,36 @@ class Trainer:
         true_values = [[] for _ in range(num_heads)]
         predicted_values = [[] for _ in range(num_heads)]
         nbatch = _nbatch(loader)
+
+        # device-resident fast path (single-process): run the whole test
+        # set as ONE scan and do ONE readback — per-batch output fetches
+        # cost a full host round trip each on tunneled backends. Own knob
+        # (default: follows the training-set flag) because the TEST set +
+        # stacked outputs have their own HBM footprint; non-uniform batch
+        # shapes or an over-budget stage fall back to streaming.
+        device_resident = _env_flag(
+            "HYDRAGNN_PREDICT_DEVICE_RESIDENT",
+            self.training_config,
+            "predict_device_resident",
+            default=_env_flag(
+                "HYDRAGNN_DEVICE_RESIDENT",
+                self.training_config,
+                "device_resident_dataset",
+            ),
+        )
+        if device_resident and (self.mesh is None or jax.process_count() == 1):
+            host_batches = []
+            for ibatch, batch in enumerate(loader):
+                if ibatch >= nbatch:
+                    break
+                host_batches.append(batch)
+            try:
+                return self._predict_device_resident(state, host_batches)
+            except (ValueError, MemoryError):
+                # ragged batch shapes (stack fails) or staging would not
+                # fit — stream instead; re-iterate from the collected list
+                loader = host_batches
+
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
                 break
@@ -751,6 +802,65 @@ class Trainer:
                 true = np.asarray(batch.targets[ihead])[mask].reshape(-1, 1)
                 predicted_values[ihead].append(pred)
                 true_values[ihead].append(true)
+        return self._predict_finish(tot, tasks, n, true_values, predicted_values)
+
+    # allow roughly half a v5e HBM for (staged test set + stacked outputs);
+    # beyond that the streaming path is the safe default
+    _PREDICT_STAGE_BUDGET_BYTES = 8 * 1024**3
+
+    def _predict_device_resident(self, state, host_batches):
+        """One-scan, one-readback predict over a staged test set. Raises
+        ValueError/MemoryError for the caller's streaming fallback when the
+        batch shapes are ragged or the staging would blow the HBM budget."""
+        num_heads = self.model.num_heads
+        head_types = self.model.output_type
+        from hydragnn_tpu.graph.batch import stack_batches
+
+        stacked = stack_batches(host_batches)  # ValueError if ragged
+        stage_bytes = sum(
+            a.nbytes
+            for a in jax.tree_util.tree_leaves(stacked)
+            if hasattr(a, "nbytes")
+        )
+        nb = len(host_batches)
+        out_rows = {
+            "graph": host_batches[0].graph_mask.shape[0],
+            "node": host_batches[0].node_mask.shape[0],
+        }
+        out_bytes = sum(
+            nb * out_rows[t] * d * 4
+            for t, d in zip(head_types, self.model.output_dim)
+        )
+        if stage_bytes + out_bytes > self._PREDICT_STAGE_BUDGET_BYTES:
+            raise MemoryError(
+                f"staged predict would need {stage_bytes + out_bytes} bytes"
+            )
+        staged = self.put_batch_stacked(stacked)
+        loss_b, tasks_b, g_b, outputs_b = jax.device_get(
+            self._predict_scan(state.params, state.batch_stats, staged)
+        )
+        g_arr = np.asarray(g_b, np.float64)
+        tot = float(np.asarray(loss_b, np.float64) @ g_arr)
+        tasks = (np.asarray(tasks_b, np.float64) * g_arr[:, None]).sum(0)
+        n = float(g_arr.sum())
+        true_values = [[] for _ in range(num_heads)]
+        predicted_values = [[] for _ in range(num_heads)]
+        for ib, batch in enumerate(host_batches):
+            graph_mask = np.asarray(batch.graph_mask)
+            node_mask = np.asarray(batch.node_mask)
+            for ihead in range(num_heads):
+                mask = (
+                    graph_mask if head_types[ihead] == "graph" else node_mask
+                )
+                pred = np.asarray(outputs_b[ihead][ib])[mask].reshape(-1, 1)
+                true = np.asarray(batch.targets[ihead])[mask].reshape(-1, 1)
+                predicted_values[ihead].append(pred)
+                true_values[ihead].append(true)
+        return self._predict_finish(tot, tasks, n, true_values, predicted_values)
+
+    def _predict_finish(self, tot, tasks, n, true_values, predicted_values):
+        """Shared tail of both predict paths: concat, optional test-data
+        dump, averaged metrics."""
         n = max(n, 1.0)
         true_values = [np.concatenate(v, axis=0) for v in true_values]
         predicted_values = [np.concatenate(v, axis=0) for v in predicted_values]
@@ -845,12 +955,7 @@ def train_validate_test(
     # device-resident mode: stage the (collated) training set in HBM once;
     # every epoch is then a single scan dispatch with no H2D traffic
     staged = None
-    if int(
-        os.getenv(
-            "HYDRAGNN_DEVICE_RESIDENT",
-            str(int(training.get("device_resident_dataset", False))),
-        )
-    ):
+    if _env_flag("HYDRAGNN_DEVICE_RESIDENT", training, "device_resident_dataset"):
         staged = trainer.stage_batches(list(train_loader))
 
     # whole-training dispatch: fit_chunk_epochs > 0 runs training in chunks

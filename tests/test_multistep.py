@@ -282,6 +282,37 @@ def pytest_fit_staged_pad_to_inert():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def pytest_predict_staged_matches_streaming():
+    """The device-resident predict fast path (one scan + one readback) must
+    produce identical metrics and per-head value arrays."""
+    batches = _batches(4)
+    model = create_model_config(_arch())
+    loader = ListLoader(batches)
+
+    t1 = Trainer(
+        model, training_config={"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+    )
+    s1 = t1.init_state(batches[0])
+    e1, te1, tv1, pv1 = t1.predict(s1, loader)
+
+    t2 = Trainer(
+        model,
+        training_config={
+            "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+            "device_resident_dataset": True,
+        },
+    )
+    s2 = t2.init_state(batches[0])
+    # same init seed -> same params; compare outputs directly
+    e2, te2, tv2, pv2 = t2.predict(s2, loader)
+    assert np.isclose(e1, e2, rtol=1e-6), (e1, e2)
+    np.testing.assert_allclose(te1, te2, rtol=1e-6)
+    for a, b in zip(tv1, tv2):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    for a, b in zip(pv1, pv2):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
 def pytest_stack_batches_shapes():
     batches = _batches(3)
     stacked = stack_batches(batches)
