@@ -92,6 +92,9 @@ def bench_ours():
     from hydragnn_tpu.graph import collate_graphs, pad_sizes_for
     from hydragnn_tpu.models import create_model_config
     from hydragnn_tpu.train.trainer import Trainer
+    from hydragnn_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
     n_pad, e_pad, g_pad = pad_sizes_for(MAX_NODES, 4 * MAX_NODES, BATCH_GRAPHS)
     batches = [

@@ -29,6 +29,7 @@ from hydragnn_tpu.utils.config import (
     save_config,
     update_config,
 )
+from hydragnn_tpu.utils.compile_cache import enable_compile_cache
 from hydragnn_tpu.utils.print_utils import setup_log
 from hydragnn_tpu.utils.timers import Timer, print_timers
 
@@ -145,6 +146,7 @@ def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
 def run_training_impl(config):
     timer = Timer("run_training")
     timer.start()
+    enable_compile_cache()
     setup_distributed()
     tr.initialize()
     verbosity = config.get("Verbosity", {}).get("level", 0)
@@ -193,6 +195,7 @@ def run_training_impl(config):
 
 
 def run_prediction_impl(config):
+    enable_compile_cache()
     setup_distributed()
     verbosity = config.get("Verbosity", {}).get("level", 0)
 

@@ -761,11 +761,29 @@ class Trainer:
                     break
                 host_batches.append(batch)
             try:
-                return self._predict_device_resident(state, host_batches)
+                # only the two documented failure modes trigger the
+                # fallback: ragged shapes (stack raises ValueError) and the
+                # host-side budget estimate (MemoryError)
+                stacked = self._stack_for_predict(host_batches)
             except (ValueError, MemoryError):
-                # ragged batch shapes (stack fails) or staging would not
-                # fit — stream instead; re-iterate from the collected list
                 loader = host_batches
+            else:
+                try:
+                    return self._predict_device_resident(
+                        state, host_batches, stacked
+                    )
+                except Exception as e:
+                    # a REAL device OOM surfaces as a runtime error, not
+                    # MemoryError — fall back to streaming for that case
+                    # only; anything else is a genuine bug and propagates
+                    msg = str(e)
+                    if (
+                        "RESOURCE_EXHAUSTED" in msg
+                        or "out of memory" in msg.lower()
+                    ):
+                        loader = host_batches
+                    else:
+                        raise
 
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
@@ -794,26 +812,38 @@ class Trainer:
                     )
                 )
             outputs = jax.device_get(outputs)
-            graph_mask = np.asarray(batch.graph_mask)
-            node_mask = np.asarray(batch.node_mask)
-            for ihead in range(num_heads):
-                mask = graph_mask if head_types[ihead] == "graph" else node_mask
-                pred = np.asarray(outputs[ihead])[mask].reshape(-1, 1)
-                true = np.asarray(batch.targets[ihead])[mask].reshape(-1, 1)
-                predicted_values[ihead].append(pred)
-                true_values[ihead].append(true)
+            self._collect_head_values(
+                batch, outputs, true_values, predicted_values
+            )
         return self._predict_finish(tot, tasks, n, true_values, predicted_values)
 
     # allow roughly half a v5e HBM for (staged test set + stacked outputs);
-    # beyond that the streaming path is the safe default
+    # beyond that the streaming path is the safe default. Best-effort only:
+    # it cannot see HBM already held by staged training data / params — the
+    # caller additionally catches the device's own RESOURCE_EXHAUSTED.
     _PREDICT_STAGE_BUDGET_BYTES = 8 * 1024**3
 
-    def _predict_device_resident(self, state, host_batches):
-        """One-scan, one-readback predict over a staged test set. Raises
-        ValueError/MemoryError for the caller's streaming fallback when the
-        batch shapes are ragged or the staging would blow the HBM budget."""
-        num_heads = self.model.num_heads
-        head_types = self.model.output_type
+    def _collect_head_values(
+        self, batch, outputs, true_values, predicted_values
+    ):
+        """Append one batch's masked per-head (true, pred) rows — shared by
+        the streaming and device-resident predict paths."""
+        graph_mask = np.asarray(batch.graph_mask)
+        node_mask = np.asarray(batch.node_mask)
+        for ihead in range(self.model.num_heads):
+            mask = (
+                graph_mask
+                if self.model.output_type[ihead] == "graph"
+                else node_mask
+            )
+            pred = np.asarray(outputs[ihead])[mask].reshape(-1, 1)
+            true = np.asarray(batch.targets[ihead])[mask].reshape(-1, 1)
+            predicted_values[ihead].append(pred)
+            true_values[ihead].append(true)
+
+    def _stack_for_predict(self, host_batches):
+        """Stack + host-side budget estimate for the staged predict path.
+        Raises ValueError (ragged shapes) or MemoryError (over budget)."""
         from hydragnn_tpu.graph.batch import stack_batches
 
         stacked = stack_batches(host_batches)  # ValueError if ragged
@@ -829,12 +859,17 @@ class Trainer:
         }
         out_bytes = sum(
             nb * out_rows[t] * d * 4
-            for t, d in zip(head_types, self.model.output_dim)
+            for t, d in zip(self.model.output_type, self.model.output_dim)
         )
         if stage_bytes + out_bytes > self._PREDICT_STAGE_BUDGET_BYTES:
             raise MemoryError(
                 f"staged predict would need {stage_bytes + out_bytes} bytes"
             )
+        return stacked
+
+    def _predict_device_resident(self, state, host_batches, stacked):
+        """One-scan, one-readback predict over a staged test set."""
+        num_heads = self.model.num_heads
         staged = self.put_batch_stacked(stacked)
         loss_b, tasks_b, g_b, outputs_b = jax.device_get(
             self._predict_scan(state.params, state.batch_stats, staged)
@@ -846,16 +881,12 @@ class Trainer:
         true_values = [[] for _ in range(num_heads)]
         predicted_values = [[] for _ in range(num_heads)]
         for ib, batch in enumerate(host_batches):
-            graph_mask = np.asarray(batch.graph_mask)
-            node_mask = np.asarray(batch.node_mask)
-            for ihead in range(num_heads):
-                mask = (
-                    graph_mask if head_types[ihead] == "graph" else node_mask
-                )
-                pred = np.asarray(outputs_b[ihead][ib])[mask].reshape(-1, 1)
-                true = np.asarray(batch.targets[ihead])[mask].reshape(-1, 1)
-                predicted_values[ihead].append(pred)
-                true_values[ihead].append(true)
+            self._collect_head_values(
+                batch,
+                [outputs_b[ihead][ib] for ihead in range(num_heads)],
+                true_values,
+                predicted_values,
+            )
         return self._predict_finish(tot, tasks, n, true_values, predicted_values)
 
     def _predict_finish(self, tot, tasks, n, true_values, predicted_values):

@@ -1,0 +1,44 @@
+"""Persistent XLA compilation cache.
+
+First compilation of the fused training programs costs tens of seconds on
+TPU (the whole-training ``fit_staged`` program most of all). JAX can
+persist compiled executables across processes; enabling it makes every run
+after the first start hot. No reference counterpart (torch eager has no
+compile step).
+
+``HYDRAGNN_COMPILE_CACHE`` controls it: unset/``1`` -> on (default dir
+``~/.cache/hydragnn_tpu/xla``), ``0`` -> off, any other value -> used as
+the cache directory.
+"""
+
+import os
+
+_enabled = False
+
+
+def enable_compile_cache():
+    """Idempotent; call before the first jit compilation for best effect."""
+    global _enabled
+    if _enabled:
+        return
+    knob = os.getenv("HYDRAGNN_COMPILE_CACHE", "1")
+    if knob == "0":
+        return
+    cache_dir = (
+        knob
+        if knob not in ("", "1")
+        else os.path.join(
+            os.path.expanduser("~"), ".cache", "hydragnn_tpu", "xla"
+        )
+    )
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _enabled = True
+    except Exception:
+        # cache is an optimization only — never fail a run over it
+        pass

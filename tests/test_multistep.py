@@ -303,8 +303,14 @@ def pytest_predict_staged_matches_streaming():
         },
     )
     s2 = t2.init_state(batches[0])
+    # spy: the fast path must actually run (a silent fallback to streaming
+    # would make this parity test vacuous)
+    calls = []
+    orig_scan = t2._predict_scan
+    t2._predict_scan = lambda *a, **k: (calls.append(1), orig_scan(*a, **k))[1]
     # same init seed -> same params; compare outputs directly
     e2, te2, tv2, pv2 = t2.predict(s2, loader)
+    assert calls, "device-resident predict path did not execute"
     assert np.isclose(e1, e2, rtol=1e-6), (e1, e2)
     np.testing.assert_allclose(te1, te2, rtol=1e-6)
     for a, b in zip(tv1, tv2):
