@@ -196,10 +196,46 @@ class PartitionedTrainer:
         return put_partitioned_state(state, self.mesh)
 
     # ---- epoch loops (Trainer surface) ---------------------------------
+    @staticmethod
+    def _acc_add(acc, metrics):
+        """Collect per-step metrics without a host readback (device parts,
+        stacked + fetched once per epoch, float64 host summation); on
+        multi-host, eager ops on non-addressable jit outputs are disallowed
+        so the (permitted) per-step host fetch is used instead. See
+        Trainer._acc_add."""
+        if jax.process_count() > 1:
+            part = np.concatenate(
+                [
+                    [np.asarray(metrics["loss"], np.float64)],
+                    [1.0],
+                    np.asarray(metrics["tasks"], np.float64),
+                ]
+            )
+        else:
+            part = jnp.concatenate(
+                [
+                    metrics["loss"].astype(jnp.float32)[None],
+                    jnp.ones((1,), jnp.float32),
+                    metrics["tasks"].astype(jnp.float32),
+                ]
+            )
+        acc = [] if acc is None else acc
+        acc.append(part)
+        return acc
+
+    @staticmethod
+    def _acc_read(acc):
+        if not acc:
+            return 0.0, np.zeros(0)
+        if isinstance(acc[0], np.ndarray):
+            a = np.stack(acc).astype(np.float64).sum(axis=0)
+        else:
+            a = np.asarray(jnp.stack(acc), np.float64).sum(axis=0)
+        n = max(a[1], 1.0)
+        return a[0] / n, a[2:] / n
+
     def train_epoch(self, state, loader, rng):
-        tot = 0.0
-        tasks = None
-        n = 0.0
+        acc = None
         nbatch = _nbatch(loader)
         tr.start("train")
         for ibatch, batch in enumerate(loader):
@@ -208,30 +244,21 @@ class PartitionedTrainer:
             batch = self.put_batch(batch)
             rng, sub = jax.random.split(rng)
             state, metrics = self._train_step(state, batch, sub)
-            tot += float(metrics["loss"])
-            t = np.asarray(metrics["tasks"])
-            tasks = t if tasks is None else tasks + t
-            n += 1.0
+            acc = self._acc_add(acc, metrics)
+        loss, tasks = self._acc_read(acc)
         tr.stop("train")
-        n = max(n, 1.0)
-        return state, rng, tot / n, (tasks / n if tasks is not None else np.zeros(0))
+        return state, rng, loss, tasks
 
     def evaluate(self, state, loader, desc="validate"):
-        tot = 0.0
-        tasks = None
-        n = 0.0
+        acc = None
         nbatch = _nbatch(loader)
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
                 break
             batch = self.put_batch(batch)
             metrics = self._eval_step(state.params, state.batch_stats, batch)
-            tot += float(metrics["loss"])
-            t = np.asarray(metrics["tasks"])
-            tasks = t if tasks is None else tasks + t
-            n += 1.0
-        n = max(n, 1.0)
-        return tot / n, (tasks / n if tasks is not None else np.zeros(0))
+            acc = self._acc_add(acc, metrics)
+        return self._acc_read(acc)
 
     def predict(self, state, loader):
         """Per-sample outputs gathered back to global node order."""

@@ -645,16 +645,66 @@ class Trainer:
         return state, best_state, sched, rng, out
 
     # ---- epoch loops ---------------------------------------------------
+    @staticmethod
+    def _acc_add(acc, metrics, multi):
+        """Collect per-batch epoch metrics WITHOUT a host readback: each
+        batch appends one packed [loss_sum, graph_count, task_sums...]
+        device vector to ``acc`` — per-batch ``float(...)`` fetches cost a
+        full round trip each on TPU backends AND serialize the dispatch
+        pipeline. :meth:`_acc_read` stacks the parts, does the epoch's ONE
+        readback, and sums in float64 on the host (exact, unlike a
+        sequential on-device f32 running sum).
+
+        Multi-host: eager jnp ops on jit outputs spanning non-addressable
+        devices are disallowed — fall back to the (permitted) per-batch
+        host fetch of the replicated scalars, as before this optimization.
+        """
+        g32 = metrics["num_graphs"]
+        if jax.process_count() > 1:
+            g = np.asarray(g32, np.float64)
+            t = np.asarray(metrics["tasks"], np.float64)
+            loss = np.asarray(metrics["loss"], np.float64)
+            if multi:
+                part = np.concatenate([[loss @ g], [g.sum()], t.T @ g])
+            else:
+                part = np.concatenate([[loss * g], [g], t * g])
+        else:
+            g32 = g32.astype(jnp.float32)
+            t = metrics["tasks"].astype(jnp.float32)
+            if multi:  # stacked [K] / [K, T] from a scan
+                part = jnp.concatenate(
+                    [(metrics["loss"] @ g32)[None], g32.sum()[None], t.T @ g32]
+                )
+            else:
+                part = jnp.concatenate(
+                    [(metrics["loss"] * g32)[None], g32[None], t * g32]
+                )
+        acc = [] if acc is None else acc
+        acc.append(part)
+        return acc
+
+    @staticmethod
+    def _acc_read(acc):
+        """(avg_loss, per-task avg): one readback, float64 host summation."""
+        if not acc:
+            return 0.0, np.zeros(0)
+        if isinstance(acc[0], np.ndarray):
+            a = np.stack(acc).astype(np.float64).sum(axis=0)
+        else:
+            a = (
+                np.asarray(jnp.stack(acc), np.float64).sum(axis=0)
+            )  # the epoch's single readback
+        n = max(a[1], 1.0)
+        return a[0] / n, a[2:] / n
+
     def train_epoch(self, state, loader, rng):
-        tot = 0.0
-        tasks = None
-        n = 0.0
+        acc = None
         nbatch = _nbatch(loader)
         K = max(1, self.steps_per_dispatch)
         pending = []
         tr.start("train")
 
-        def _flush(state, rng, tot, tasks, n, group):
+        def _flush(state, rng, acc, group):
             if len(group) > 1:
                 from hydragnn_tpu.graph.batch import stack_batches
 
@@ -666,11 +716,7 @@ class Trainer:
                 tr.start("train_step")
                 state, metrics = self._train_multi(state, stacked, subs[1:])
                 tr.stop("train_step")
-                g = np.asarray(metrics["num_graphs"], np.float64)  # [K]
-                tot += float(np.asarray(metrics["loss"], np.float64) @ g)
-                t = (np.asarray(metrics["tasks"], np.float64) * g[:, None]).sum(0)
-                tasks_ = t if tasks is None else tasks + t
-                return state, rng, tot, tasks_, n + float(g.sum())
+                return state, rng, self._acc_add(acc, metrics, multi=True)
             tr.start("dataload")
             batch = self.put_batch(group[0])
             tr.stop("dataload")
@@ -678,51 +724,36 @@ class Trainer:
             tr.start("train_step")
             state, metrics = self._train_step(state, batch, sub)
             tr.stop("train_step")
-            g = float(metrics["num_graphs"])
-            tot += float(metrics["loss"]) * g
-            t = np.asarray(metrics["tasks"]) * g
-            tasks_ = t if tasks is None else tasks + t
-            return state, rng, tot, tasks_, n + g
+            return state, rng, self._acc_add(acc, metrics, multi=False)
 
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
                 break
             if K == 1:
-                state, rng, tot, tasks, n = _flush(
-                    state, rng, tot, tasks, n, [batch]
-                )
+                state, rng, acc = _flush(state, rng, acc, [batch])
                 continue
             pending.append(batch)
             if len(pending) == K:
-                state, rng, tot, tasks, n = _flush(
-                    state, rng, tot, tasks, n, pending
-                )
+                state, rng, acc = _flush(state, rng, acc, pending)
                 pending = []
         # trailing partial group: single-step path (a short stack would be a
         # fresh scan-length compile)
         for batch in pending:
-            state, rng, tot, tasks, n = _flush(state, rng, tot, tasks, n, [batch])
+            state, rng, acc = _flush(state, rng, acc, [batch])
+        loss, tasks = self._acc_read(acc)  # the epoch's one readback
         tr.stop("train")
-        n = max(n, 1.0)
-        return state, rng, tot / n, (tasks / n if tasks is not None else np.zeros(0))
+        return state, rng, loss, tasks
 
     def evaluate(self, state, loader, desc="validate"):
-        tot = 0.0
-        tasks = None
-        n = 0.0
+        acc = None
         nbatch = _nbatch(loader)
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
                 break
             batch = self.put_batch(batch)
             metrics = self._eval_step(state.params, state.batch_stats, batch)
-            g = float(metrics["num_graphs"])
-            tot += float(metrics["loss"]) * g
-            t = np.asarray(metrics["tasks"]) * g
-            tasks = t if tasks is None else tasks + t
-            n += g
-        n = max(n, 1.0)
-        return tot / n, (tasks / n if tasks is not None else np.zeros(0))
+            acc = self._acc_add(acc, metrics, multi=False)
+        return self._acc_read(acc)
 
     def predict(self, state, loader):
         """Full test pass with sample collection — the reference's ``test()``
@@ -773,17 +804,23 @@ class Trainer:
                         state, host_batches, stacked
                     )
                 except Exception as e:
-                    # a REAL device OOM surfaces as a runtime error, not
-                    # MemoryError — fall back to streaming for that case
-                    # only; anything else is a genuine bug and propagates
+                    # memory exhaustion — device (RESOURCE_EXHAUSTED
+                    # runtime error) or host (MemoryError from staging /
+                    # the stacked readback) — falls back to streaming;
+                    # anything else is a genuine bug and propagates
                     msg = str(e)
                     if (
-                        "RESOURCE_EXHAUSTED" in msg
+                        isinstance(e, MemoryError)
+                        or "RESOURCE_EXHAUSTED" in msg
                         or "out of memory" in msg.lower()
                     ):
                         loader = host_batches
                     else:
                         raise
+                finally:
+                    # don't hold the second full host copy of the test set
+                    # through a (memory-pressured) streaming fallback
+                    del stacked
 
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:

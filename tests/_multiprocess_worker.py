@@ -159,6 +159,19 @@ def main():
     agree = host_allreduce(np.array([series["train_loss"][-1]]), "max")
     assert abs(float(agree[0]) - series["train_loss"][-1]) < 1e-6
 
+    # streaming epoch across hosts (exercises the multi-host metric
+    # accumulation path: per-batch host fetch of replicated scalars)
+    class _EpochLoader(list):
+        def set_epoch(self, e):
+            pass
+
+    state, _rng2, ep_loss, ep_tasks = trainer.train_epoch(
+        state, _EpochLoader([batch, batch2]), jax.random.PRNGKey(2)
+    )
+    assert np.isfinite(ep_loss), ep_loss
+    agree = host_allreduce(np.array([ep_loss]), "max")
+    assert abs(float(agree[0]) - ep_loss) < 1e-6, (agree, ep_loss)
+
     # ZeRO-style sharded optimizer state -> single consolidated checkpoint
     # (reference: consolidate_state_dict, utils/model.py:60-74)
     import tempfile
