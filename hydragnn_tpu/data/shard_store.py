@@ -156,7 +156,11 @@ class ShardDataset:
     no per-sample files, no remote fetch needed on a single host.
     """
 
-    def __init__(self, label: str, preload: bool = False):
+    def __init__(self, label: str, preload: bool = False, subset=None):
+        """``subset``: optional sequence of global sample indices that this
+        dataset view exposes (the reference's AdiosDataset subset support,
+        ``utils/adiosdataset.py:610-636``) — ``len``/``[i]`` then run over
+        the subset while ``get`` keeps taking global indices."""
         self.label = label
         paths = sorted(glob.glob(os.path.join(label, "shard.*.gpk")))
         if not paths:
@@ -170,13 +174,21 @@ class ShardDataset:
                 self.meta = json.load(f)
         self.target_types = list(self.meta.get("target_types", []))
 
-    def __len__(self) -> int:
+        self.subset = None if subset is None else [int(i) for i in subset]
+
+    def num_samples_total(self) -> int:
         return int(self._cum[-1]) if len(self._cum) else 0
 
+    def __len__(self) -> int:
+        if self.subset is not None:
+            return len(self.subset)
+        return self.num_samples_total()
+
     def _locate(self, idx: int):
+        total = self.num_samples_total()
         if idx < 0:
-            idx += len(self)
-        if not 0 <= idx < len(self):
+            idx += total
+        if not 0 <= idx < total:
             raise IndexError(idx)
         shard = int(np.searchsorted(self._cum, idx, side="right"))
         local = idx - (int(self._cum[shard - 1]) if shard else 0)
@@ -207,11 +219,13 @@ class ShardDataset:
         return d
 
     def __getitem__(self, idx: int) -> GraphData:
+        if self.subset is not None:
+            idx = self.subset[idx]
         return self.get(idx)
 
     def __iter__(self):
         for i in range(len(self)):
-            yield self.get(i)
+            yield self[i]  # subset-relative: __getitem__ translates
 
     def close(self):
         for r in self.readers:

@@ -3,6 +3,7 @@ from hydragnn_tpu.models.create import (
     MODEL_TYPES,
     create_model_config,
     init_model_params,
+    print_model,
 )
 from hydragnn_tpu.models.common import (
     MLP,

@@ -152,3 +152,22 @@ def init_model_params(model: HydraBase, example_batch, seed: int = 0):
     rngs = {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(1)}
     variables = model.init(rngs, example_batch, train=False)
     return variables
+
+
+def print_model(model: HydraBase, variables, verbosity: int = 0):
+    """Parameter summary — top-level module table + total trainable count
+    (``hydragnn/utils/model.py:173-181``)."""
+    from hydragnn_tpu.utils.print_utils import print_distributed
+
+    params = variables.get("params", variables)
+    per_module = {}
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        top = getattr(path[0], "key", str(path[0]))
+        per_module[top] = per_module.get(top, 0) + int(np.prod(leaf.shape))
+        total += int(np.prod(leaf.shape))
+    print_distributed(verbosity, f"model: {type(model).__name__}")
+    for name in sorted(per_module):
+        print_distributed(verbosity, f"  {name}: {per_module[name]:,} params")
+    print_distributed(verbosity, f"total trainable params: {total:,}")
+    return total

@@ -213,6 +213,54 @@ class Visualizer:
             ax.set_title(f"{name}[{c}]")
         self._save(fig, f"parity_vector_{name}.png")
 
+    def create_parity_plot_and_error_histogram_scalar(
+        self, true_values, predicted_values, ihead=0, output_name=None
+    ):
+        """Scalar-head combined panel: parity scatter beside its error
+        histogram (``visualizer.py:281-385``)."""
+        t = np.asarray(true_values[ihead]).reshape(-1)
+        p = np.asarray(predicted_values[ihead]).reshape(-1)
+        name = output_name or f"head{ihead}"
+        fig, axes = plt.subplots(1, 2, figsize=(10, 5), squeeze=False)
+        ax = axes[0][0]
+        ax.scatter(t, p, s=4, alpha=0.5)
+        if t.size:
+            self.add_identity(ax, "r--", linewidth=1)
+        ax.set_xlabel(f"true {name}")
+        ax.set_ylabel(f"predicted {name}")
+        ax = axes[0][1]
+        ax.hist(p - t, bins=40)
+        ax.set_xlabel(f"error {name}")
+        self._save(fig, f"parity_and_hist_{name}.png")
+
+    def create_parity_plot_per_node_vector(
+        self, true_values, predicted_values, ihead=0, output_name=None, dim=None
+    ):
+        """Vector node-head parity grouped by node position within the
+        graph: one row per node, one column per component (fixed-size
+        graphs; ``visualizer.py:519-612``)."""
+        if not self.num_nodes_list or len(set(self.num_nodes_list)) != 1:
+            return  # variable graph size: per-node grouping undefined
+        num_nodes = int(self.num_nodes_list[0])
+        d = dim or self.head_dims[ihead]
+        t = np.asarray(true_values[ihead]).reshape(-1, d)
+        p = np.asarray(predicted_values[ihead]).reshape(-1, d)
+        if t.shape[0] % num_nodes != 0:
+            return
+        t = t.reshape(-1, num_nodes, d)
+        p = p.reshape(-1, num_nodes, d)
+        name = output_name or f"head{ihead}"
+        fig, axes = plt.subplots(
+            num_nodes, d, figsize=(4 * d, 3 * num_nodes), squeeze=False
+        )
+        for node in range(num_nodes):
+            for c in range(d):
+                ax = axes[node][c]
+                ax.scatter(t[:, node, c], p[:, node, c], s=4, alpha=0.5)
+                self.add_identity(ax, "r--", linewidth=1)
+                ax.set_title(f"node {node} [{c}]")
+        self._save(fig, f"parity_per_node_vector_{name}.png")
+
     def create_error_histogram_per_node(
         self, true_values, predicted_values, ihead=0, output_name=None
     ):

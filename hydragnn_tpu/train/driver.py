@@ -71,6 +71,9 @@ def _build_model_and_trainer(config, train_loader, verbosity):
     )
     example_batch = next(iter(train_loader))
     state = trainer.init_state(example_batch, seed=0)
+    from hydragnn_tpu.models.create import print_model
+
+    print_model(model, {"params": state.params}, verbosity)
     return model, trainer, state
 
 

@@ -107,6 +107,32 @@ def pytest_graphpack_empty_shard():
         ds.close()
 
 
+def pytest_graphpack_subset_view():
+    """Subset views expose only the chosen global indices through len/[i]
+    (AdiosDataset subset parity, ``utils/adiosdataset.py:610-636``)."""
+    from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
+
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory() as tmp:
+        label = os.path.join(tmp, "s")
+        w = ShardWriter(label, rank=0)
+        samples = [_mk(rng, 3 + i) for i in range(6)]
+        w.add(samples)
+        w.save()
+        ds = ShardDataset(label, subset=[4, 1, 5])
+        assert len(ds) == 3
+        assert ds.num_samples_total() == 6
+        assert ds[0].num_nodes == samples[4].x.shape[0]
+        assert ds[1].num_nodes == samples[1].x.shape[0]
+        # get() still addresses the GLOBAL index space
+        assert ds.get(0).num_nodes == samples[0].x.shape[0]
+        # iteration follows the subset view
+        assert [d.num_nodes for d in ds] == [
+            samples[i].x.shape[0] for i in (4, 1, 5)
+        ]
+        ds.close()
+
+
 def pytest_diststore_remote_fetch():
     from hydragnn_tpu.data.distdataset import DistDataset
 

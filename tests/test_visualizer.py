@@ -37,6 +37,12 @@ def pytest_visualizer_catalog(tmp_path):
         viz.create_error_histogram_per_node(
             [t_g, t_n[:, :1]], [p_g, p_n[:, :1]], ihead=1, output_name="f0"
         )
+        viz.create_parity_plot_and_error_histogram_scalar(
+            tv, pv, ihead=0, output_name="energy"
+        )
+        viz.create_parity_plot_per_node_vector(
+            tv, pv, ihead=1, output_name="forces"
+        )
         viz.plot_history(
             np.geomspace(1, 0.1, 5), np.geomspace(1, 0.12, 5), np.geomspace(1, 0.13, 5)
         )
@@ -51,6 +57,8 @@ def pytest_visualizer_catalog(tmp_path):
             "global_analysis.png",
             "parity_vector_forces.png",
             "error_hist_per_node_f0.png",
+            "parity_and_hist_energy.png",
+            "parity_per_node_vector_forces.png",
             "history_loss.png",
         ]
         for f in expected:
