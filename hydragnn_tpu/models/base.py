@@ -30,6 +30,7 @@ from hydragnn_tpu.models.common import (
     get_activation,
     global_mean_pool,
     masked_error,
+    masked_gaussian_nll,
 )
 
 
@@ -98,6 +99,13 @@ class HydraBase(nn.Module):
     loss_function_type: str = "mse"
     equivariance: bool = False
     loss_weights: Tuple[float, ...] = ()
+    # Kendall-style uncertainty-weighted NLL multi-task loss
+    # (``Architecture.ilossweights_nll``): every head emits one extra
+    # log-variance channel; the loss learns per-sample task weighting. The
+    # reference declares this mode but its implementation raises "not ready
+    # yet" (``models/Base.py:335-354``) and the factory cannot reach it
+    # (``create.py:71``) — here it is finished and config-reachable.
+    loss_nll: bool = False
     num_conv_layers: int = 2
     num_nodes: Optional[int] = None
     edge_dim: Optional[int] = None
@@ -256,9 +264,12 @@ class HydraBase(nn.Module):
 
         outputs = []
         node_index = None
+        # NLL mode: one extra log-variance channel per head (the reference
+        # reserves the slot the same way, ``Base.py:241``)
+        uq_extra = 1 if self.loss_nll else 0
         for ihead in range(self.num_heads):
             head_type = self.output_type[ihead]
-            head_dim = self.output_dim[ihead]
+            head_dim = self.output_dim[ihead] + uq_extra
             if head_type == "graph":
                 num_head_hidden = heads_cfg["graph"]["num_headlayers"]
                 dim_head_hidden = heads_cfg["graph"]["dim_headlayers"]
@@ -334,6 +345,27 @@ class HydraBase(nn.Module):
                 if self.output_type[ihead] == "graph"
                 else batch.node_mask
             )
+            if self.loss_nll:
+                d = self.output_dim[ihead]
+                tot = tot + masked_gaussian_nll(
+                    pred[..., :d],
+                    pred[..., d:],
+                    target,
+                    mask,
+                    axis_name=self.partition_axis,
+                )
+                # per-task report stays plain MSE of the mean prediction
+                # (the reference's tasks_mseloss, ``Base.py:352``)
+                tasks.append(
+                    masked_error(
+                        pred[..., :d],
+                        target,
+                        mask,
+                        "mse",
+                        axis_name=self.partition_axis,
+                    )
+                )
+                continue
             err = masked_error(
                 pred,
                 target,

@@ -102,6 +102,35 @@ def masked_error(pred, target, mask, kind: str = "mse", axis_name: Optional[str]
     return out
 
 
+def masked_gaussian_nll(
+    mu, logvar, target, mask, axis_name: Optional[str] = None, eps: float = 1e-6
+):
+    """Masked Gaussian negative log-likelihood, mean over real rows.
+
+    The Kendall/Gal/Cipolla multi-task uncertainty weighting the reference
+    declares but never finished (``models/Base.py:335-354`` raises "not
+    ready yet"; the factory cannot even reach it, ``create.py:71``): each
+    head emits one extra channel interpreted as a per-sample log-variance
+    ``s``; the loss ``0.5 * (exp(-s) * (mu - y)^2 + s)`` learns to
+    down-weight tasks/samples it is uncertain about. Matches torch's
+    ``GaussianNLLLoss(full=False)`` up to the 1/2 s-vs-log(var) convention.
+    """
+    mu = mu.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    logvar = logvar.astype(jnp.float32)
+    m = mask.reshape(mask.shape + (1,) * (mu.ndim - 1)).astype(mu.dtype)
+    diff = jnp.where(m > 0, mu - target, 0.0)
+    # clamp the variance away from zero like torch's GaussianNLLLoss(eps)
+    logvar = jnp.maximum(logvar, jnp.log(eps))
+    val = 0.5 * (jnp.exp(-logvar) * diff * diff + logvar)
+    numer = (jnp.where(m > 0, val, 0.0)).sum()
+    count = m.sum() * mu.shape[-1]
+    if axis_name is not None:
+        numer = jax.lax.psum(numer, axis_name)
+        count = jax.lax.psum(count, axis_name)
+    return numer / jnp.maximum(count, 1.0)
+
+
 class MaskedBatchNorm(nn.Module):
     """BatchNorm1d over real nodes only (padding excluded from statistics).
 

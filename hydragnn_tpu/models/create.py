@@ -67,6 +67,10 @@ def create_model_config(config: dict, verbosity: int = 0) -> HydraBase:
         num_nodes=config.get("num_nodes"),
         conv_checkpointing=config.get("conv_checkpointing", False),
         initial_bias=config.get("initial_bias"),
+        # uncertainty-weighted NLL multi-task loss — the mode the reference
+        # declares but leaves unreachable/unfinished (Base.py:335-354,
+        # create.py:71); heads grow one log-variance channel
+        loss_nll=bool(config.get("ilossweights_nll", 0)),
         # graph-partition parallelism over one giant graph (config key
         # "partition_axis" names the mesh axis; see parallel/graph_partition)
         partition_axis=config.get("partition_axis"),

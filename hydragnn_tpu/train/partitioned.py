@@ -284,18 +284,21 @@ class PartitionedTrainer:
             n += 1.0
             outputs = jax.device_get(metrics["outputs"])
             for ihead in range(num_heads):
+                # NLL mode appends a log-variance channel to every head's
+                # output — collected values are the mean prediction only
+                d = self.model.output_dim[ihead]
                 if head_types[ihead] == "graph":
                     # replicated: shard 0's real-graph row
                     pred = np.asarray(outputs[ihead]).reshape(
                         info.num_parts, 2, -1
-                    )[0, 0].reshape(-1, 1)
+                    )[0, 0][:d].reshape(-1, 1)
                     true = np.asarray(batch.targets[ihead]).reshape(
                         info.num_parts, 2, -1
                     )[0, 0].reshape(-1, 1)
                 else:
                     pred = info.gather_nodes(
                         np.asarray(outputs[ihead])
-                    ).reshape(-1, 1)
+                    )[..., :d].reshape(-1, 1)
                     true = info.gather_nodes(
                         np.asarray(batch.targets[ihead])
                     ).reshape(-1, 1)

@@ -873,8 +873,12 @@ class Trainer:
                 if self.model.output_type[ihead] == "graph"
                 else node_mask
             )
-            pred = np.asarray(outputs[ihead])[mask].reshape(-1, 1)
-            true = np.asarray(batch.targets[ihead])[mask].reshape(-1, 1)
+            true = np.asarray(batch.targets[ihead])[mask]
+            # NLL mode appends a log-variance channel — collected values
+            # are the mean prediction only
+            pred = np.asarray(outputs[ihead])[mask][..., : true.shape[-1]]
+            pred = pred.reshape(-1, 1)
+            true = true.reshape(-1, 1)
             predicted_values[ihead].append(pred)
             true_values[ihead].append(true)
 

@@ -197,6 +197,42 @@ def pytest_partitioned_forward_parity(model_type):
     np.testing.assert_allclose(node_part, node_ref, rtol=2e-4, atol=2e-5)
 
 
+def pytest_partitioned_nll_loss_parity():
+    """Uncertainty-weighted NLL mode under graph partitioning: the psum'd
+    masked NLL and the collected (log-variance-stripped) predictions match
+    the unpartitioned model."""
+    sample = _giant_graph(seed=5)
+    ref_model, part_model = _models("PNA", {"ilossweights_nll": 1})
+    single = _single_batch(sample)
+    variables = init_model_params(ref_model, single, seed=0)
+    ref_out = ref_model.apply(variables, single, train=False)
+    ref_tot, ref_tasks = ref_model.loss(ref_out, single)
+
+    mesh = make_mesh(NUM_PARTS, "graph")
+    pbatch, info = _partitioned(sample, mesh)
+    part_out = make_partitioned_apply(part_model, mesh, "graph")(
+        variables, pbatch
+    )
+    # heads carry the extra log-variance channel in both layouts
+    d = ref_model.output_dim[0]
+    assert np.asarray(ref_out[0]).shape[-1] == d + 1
+    g_ref = np.asarray(ref_out[0])[0]
+    g_part = np.asarray(part_out[0]).reshape(NUM_PARTS, 2, -1)
+    for p in range(NUM_PARTS):
+        np.testing.assert_allclose(g_part[p, 0], g_ref, rtol=2e-4, atol=2e-5)
+    # the partitioned psum'd loss equals the single-device loss
+    from hydragnn_tpu.parallel.graph_partition import (
+        make_partitioned_eval_step,
+    )
+
+    pmetrics = make_partitioned_eval_step(part_model, mesh, "graph")(
+        variables["params"], variables.get("batch_stats", {}), pbatch
+    )
+    np.testing.assert_allclose(
+        float(pmetrics["loss"]), float(ref_tot), rtol=2e-4, atol=1e-6
+    )
+
+
 def pytest_partitioned_train_step_parity():
     """One full training step (loss + grads + SGD update) matches."""
     import optax

@@ -164,6 +164,21 @@ def pytest_train_model_conv_head(model_type):
 
 
 @pytest.mark.parametrize("model_type", ["PNA"])
+def pytest_train_model_nll_loss(model_type):
+    """Uncertainty-weighted NLL multi-task loss (the mode the reference
+    leaves unfinished): heads grow a log-variance channel, training through
+    the public API still hits the reference accuracy ceilings."""
+    unittest_train_model(
+        model_type,
+        "ci.json",
+        False,
+        overwrite_config={
+            "NeuralNetwork": {"Architecture": {"ilossweights_nll": 1}}
+        },
+    )
+
+
+@pytest.mark.parametrize("model_type", ["PNA"])
 def pytest_train_model_whole_training_dispatch(model_type):
     """Device-resident + chunked whole-training dispatch (fit_staged) must
     hit the same accuracy ceilings through the public run_training API."""
