@@ -89,6 +89,23 @@ def _env_flag(env_name: str, config: dict, config_key: str, default=False):
     return bool(int(os.getenv(env_name, str(int(config.get(config_key, default))))))
 
 
+def _decompact_traced(batch: GraphBatch) -> GraphBatch:
+    """Inverse of the wire compaction, INSIDE the jitted program (free —
+    XLA fuses the casts; eager device casts would cost a dispatch each):
+    upcast int16 index arrays, synthesize zero positions for the [1, 3]
+    placeholder shipped when the model never reads ``pos``."""
+    rep = {}
+    if batch.senders.dtype != jnp.int32:
+        rep = dict(
+            senders=batch.senders.astype(jnp.int32),
+            receivers=batch.receivers.astype(jnp.int32),
+            node_graph=batch.node_graph.astype(jnp.int32),
+        )
+    if batch.pos.shape[-2] == 1 and batch.x.shape[-2] != 1:
+        rep["pos"] = jnp.zeros(batch.x.shape[:-1] + (3,), jnp.float32)
+    return batch.replace(**rep) if rep else batch
+
+
 class Trainer:
     def __init__(
         self,
@@ -166,6 +183,41 @@ class Trainer:
             )
         return jax.device_put(state, NamedSharding(self.mesh, P()))
 
+    def _compact_for_transfer(self, batch: GraphBatch):
+        """Shrink the host->device wire format (streaming is H2D-bound;
+        undone INSIDE the jitted step by ``_decompact_traced``):
+
+        - index arrays (senders/receivers/node_graph) travel as int16 when
+          the node/graph counts fit, and are cast back to int32 on device —
+          the jitted step still sees int32, so nothing else changes;
+        - ``pos`` is replaced by a ``[..., 1, 3]`` placeholder when the
+          model never reads positions (no distance/coordinate convs, no
+          equivariance); the step synthesizes device-side zeros.
+
+        Returns the transfer-ready batch. ``compact_transfer`` /
+        ``HYDRAGNN_COMPACT_TRANSFER`` (default on) disables it entirely.
+        """
+        if not _env_flag(
+            "HYDRAGNN_COMPACT_TRANSFER", self.training_config,
+            "compact_transfer", default=True,
+        ):
+            return batch
+        # shape[-2] of x is the node count for both plain [N, F] and
+        # stacked [K, N, F] layouts; n_node's last axis is the graph count
+        if batch.x.shape[-2] < 2**15 and batch.n_node.shape[-1] < 2**15:
+            batch = batch.replace(
+                senders=np.asarray(batch.senders, np.int16),
+                receivers=np.asarray(batch.receivers, np.int16),
+                node_graph=np.asarray(batch.node_graph, np.int16),
+            )
+        needs_pos = getattr(self.model, "conv_needs_pos", True) or getattr(
+            self.model, "equivariance", False
+        )
+        if not needs_pos:
+            placeholder = np.zeros(batch.pos.shape[:-2] + (1, 3), np.float32)
+            batch = batch.replace(pos=placeholder)
+        return batch
+
     def put_batch(self, batch: GraphBatch) -> GraphBatch:
         """Host batch -> device(s). Under a mesh, every leading axis (nodes /
         edges / graphs / triplets) is sharded over the ``data`` axis — the
@@ -193,7 +245,9 @@ class Trainer:
                 lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
                 batch,
             )
-        return jax.tree_util.tree_map(jnp.asarray, batch)
+        return jax.tree_util.tree_map(
+            jnp.asarray, self._compact_for_transfer(batch)
+        )
 
     def put_batch_stacked(self, stacked: GraphBatch) -> GraphBatch:
         """Like :meth:`put_batch` for a ``stack_batches`` result: the scan
@@ -215,7 +269,9 @@ class Trainer:
                 lambda a: jax.device_put(jnp.asarray(a), self._stacked_sharding),
                 stacked,
             )
-        return jax.tree_util.tree_map(jnp.asarray, stacked)
+        return jax.tree_util.tree_map(
+            jnp.asarray, self._compact_for_transfer(stacked)
+        )
 
     # ---- compiled steps ------------------------------------------------
     def _build_steps(self):
@@ -243,6 +299,7 @@ class Trainer:
             )
 
         def train_step(state, batch, rng):
+            batch = _decompact_traced(batch)
             if mixed:
                 batch = batch.replace(
                     x=batch.x.astype(jnp.bfloat16),
@@ -292,6 +349,7 @@ class Trainer:
             return new_state, metrics
 
         def eval_step(params, batch_stats, batch):
+            batch = _decompact_traced(batch)
             variables = {"params": params}
             if batch_stats:
                 variables["batch_stats"] = batch_stats
