@@ -102,7 +102,10 @@ def _decompact_traced(batch: GraphBatch) -> GraphBatch:
             node_graph=batch.node_graph.astype(jnp.int32),
         )
     if batch.pos.shape[-2] == 1 and batch.x.shape[-2] != 1:
-        rep["pos"] = jnp.zeros(batch.x.shape[:-1] + (3,), jnp.float32)
+        # NaN, not zeros: a conv that reads positions while declaring
+        # conv_needs_pos=False would otherwise train on plausible all-zero
+        # coordinates; NaN makes that bug blow up in the first loss value
+        rep["pos"] = jnp.full(batch.x.shape[:-1] + (3,), jnp.nan, jnp.float32)
     return batch.replace(**rep) if rep else batch
 
 
@@ -183,7 +186,9 @@ class Trainer:
             )
         return jax.device_put(state, NamedSharding(self.mesh, P()))
 
-    def _compact_for_transfer(self, batch: GraphBatch):
+    def _compact_for_transfer(
+        self, batch: GraphBatch, allow_pos_placeholder: bool = True
+    ):
         """Shrink the host->device wire format (streaming is H2D-bound;
         undone INSIDE the jitted step by ``_decompact_traced``):
 
@@ -192,9 +197,12 @@ class Trainer:
           the jitted step still sees int32, so nothing else changes;
         - ``pos`` is replaced by a ``[..., 1, 3]`` placeholder when the
           model never reads positions (no distance/coordinate convs, no
-          equivariance); the step synthesizes device-side zeros.
+          equivariance); the step synthesizes a device-side fill. Disabled
+          under a mesh (``allow_pos_placeholder=False``): a 1-row axis
+          cannot shard over the data axis.
 
-        Returns the transfer-ready batch. ``compact_transfer`` /
+        Applies to single-process transfers (plain and mesh-sharded); the
+        multi-host assembly path ships uncompacted. ``compact_transfer`` /
         ``HYDRAGNN_COMPACT_TRANSFER`` (default on) disables it entirely.
         """
         if not _env_flag(
@@ -213,7 +221,7 @@ class Trainer:
         needs_pos = getattr(self.model, "conv_needs_pos", True) or getattr(
             self.model, "equivariance", False
         )
-        if not needs_pos:
+        if not needs_pos and allow_pos_placeholder:
             placeholder = np.zeros(batch.pos.shape[:-2] + (1, 3), np.float32)
             batch = batch.replace(pos=placeholder)
         return batch
@@ -243,7 +251,7 @@ class Trainer:
                 )
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
-                batch,
+                self._compact_for_transfer(batch, allow_pos_placeholder=False),
             )
         return jax.tree_util.tree_map(
             jnp.asarray, self._compact_for_transfer(batch)
@@ -267,7 +275,9 @@ class Trainer:
                 )
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(jnp.asarray(a), self._stacked_sharding),
-                stacked,
+                self._compact_for_transfer(
+                    stacked, allow_pos_placeholder=False
+                ),
             )
         return jax.tree_util.tree_map(
             jnp.asarray, self._compact_for_transfer(stacked)
@@ -585,6 +595,7 @@ class Trainer:
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
         self._train_multi = jax.jit(multi_train_step, donate_argnums=(0,))
         self._epoch_scan = jax.jit(epoch_scan, donate_argnums=(0,))
+        self._eval_epoch = jax.jit(eval_epoch)
         self._predict_scan = jax.jit(predict_scan)
         # donate state + sched; best_state is NOT donated (its initial value
         # may alias state's buffers)
@@ -629,6 +640,12 @@ class Trainer:
         tr.stop("train")
         n = max(float(g.sum()), 1.0)
         return state, rng, tot / n, tasks / n
+
+    def evaluate_staged(self, state, staged):
+        """Whole eval set in one dispatch over an HBM-staged stack — the
+        staged counterpart of :meth:`evaluate` (same averaged metrics)."""
+        loss, tasks = self._eval_epoch(state.params, state.batch_stats, staged)
+        return float(np.asarray(loss)), np.asarray(tasks, np.float64)
 
     def fit_staged(
         self,
@@ -1178,6 +1195,7 @@ def train_validate_test(
                 break
 
     epoch_time = 0.0
+    staged_evals = None
     for epoch in range(num_epoch if not ran_fit else 0):
         t0 = time.time()
         train_loader.set_epoch(epoch)
@@ -1192,6 +1210,44 @@ def train_validate_test(
         if skip_valtest:
             val_loss, val_tasks = train_loss, train_tasks
             test_loss, test_tasks = train_loss, train_tasks
+        elif staged is not None:
+            # device-resident epoch driver: evals run staged too (one
+            # dispatch + one readback per split, no per-batch H2D). Any
+            # staging/dispatch memory failure downgrades PERMANENTLY to the
+            # streaming evaluate — the eval sets have their own footprint
+            # on top of the staged training set.
+            if staged_evals is None:
+                try:
+                    vb, tb = list(val_loader), list(test_loader)
+                    if not vb or not tb:
+                        raise ValueError("empty eval loader")
+                    staged_evals = (
+                        trainer.stage_batches(vb),
+                        trainer.stage_batches(tb),
+                    )
+                except (ValueError, MemoryError):
+                    staged_evals = False
+            if staged_evals:
+                try:
+                    val_loss, val_tasks = trainer.evaluate_staged(
+                        state, staged_evals[0]
+                    )
+                    test_loss, test_tasks = trainer.evaluate_staged(
+                        state, staged_evals[1]
+                    )
+                except Exception as e:
+                    msg = str(e)
+                    if (
+                        isinstance(e, MemoryError)
+                        or "RESOURCE_EXHAUSTED" in msg
+                        or "out of memory" in msg.lower()
+                    ):
+                        staged_evals = False
+                    else:
+                        raise
+            if not staged_evals:
+                val_loss, val_tasks = trainer.evaluate(state, val_loader)
+                test_loss, test_tasks = trainer.evaluate(state, test_loader)
         else:
             val_loss, val_tasks = trainer.evaluate(state, val_loader)
             test_loss, test_tasks = trainer.evaluate(state, test_loader)
