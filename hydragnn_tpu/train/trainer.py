@@ -89,6 +89,18 @@ def _env_flag(env_name: str, config: dict, config_key: str, default=False):
     return bool(int(os.getenv(env_name, str(int(config.get(config_key, default))))))
 
 
+def _is_oom(exc: BaseException) -> bool:
+    """Memory exhaustion, host or device: MemoryError, or the runtime's
+    RESOURCE_EXHAUSTED / out-of-memory errors (jaxlib raises RuntimeError
+    subclasses, not MemoryError). Shared by every staging fallback."""
+    msg = str(exc)
+    return (
+        isinstance(exc, MemoryError)
+        or "RESOURCE_EXHAUSTED" in msg
+        or "out of memory" in msg.lower()
+    )
+
+
 def _decompact_traced(batch: GraphBatch) -> GraphBatch:
     """Inverse of the wire compaction, INSIDE the jitted program (free —
     XLA fuses the casts; eager device casts would cost a dispatch each):
@@ -700,8 +712,11 @@ class Trainer:
                 sched = jax.tree_util.tree_map(jnp.asarray, sched)
         if best_state is None:
             # explicit copy: ``state`` is donated, the snapshot must not
-            # alias its buffers
-            best_state = jax.tree_util.tree_map(jnp.copy, state)
+            # alias its buffers. One jitted dispatch — eager per-leaf copies
+            # would cost ~a hundred dispatches on high-latency backends.
+            best_state = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t)
+            )(state)
         tr.start("train")
         state, best_state, sched, series = self._fit_scan(
             state, best_state, sched, staged_train, staged_val,
@@ -879,16 +894,9 @@ class Trainer:
                         state, host_batches, stacked
                     )
                 except Exception as e:
-                    # memory exhaustion — device (RESOURCE_EXHAUSTED
-                    # runtime error) or host (MemoryError from staging /
-                    # the stacked readback) — falls back to streaming;
-                    # anything else is a genuine bug and propagates
-                    msg = str(e)
-                    if (
-                        isinstance(e, MemoryError)
-                        or "RESOURCE_EXHAUSTED" in msg
-                        or "out of memory" in msg.lower()
-                    ):
+                    # memory exhaustion (host or device) falls back to
+                    # streaming; anything else is a genuine bug
+                    if _is_oom(e):
                         loader = host_batches
                     else:
                         raise
@@ -1144,8 +1152,21 @@ def train_validate_test(
         best_state = None
         best_saved = np.inf
         epoch0 = 0
+        # full sample->batch reshuffle at chunk boundaries (the staged scan
+        # only permutes batch ORDER within a chunk; this restores the
+        # reference DistributedSampler's per-epoch sample shuffling at
+        # chunk granularity, at the price of re-staging H2D per chunk)
+        restage = _env_flag(
+            "HYDRAGNN_RESTAGE_PER_CHUNK", training, "restage_per_chunk"
+        )
         while epoch0 < num_epoch:
             n = min(fit_chunk, num_epoch - epoch0)
+            if restage and epoch0 > 0:
+                train_loader.set_epoch(epoch0)
+                # release the old stack FIRST — holding it through the
+                # re-stage would double the training set's HBM footprint
+                staged = None
+                staged = trainer.stage_batches(list(train_loader))
             t0 = time.time()
             # pad_to keeps every chunk at the same scan length — the short
             # final chunk must not recompile the whole-training program
@@ -1225,8 +1246,11 @@ def train_validate_test(
                         trainer.stage_batches(vb),
                         trainer.stage_batches(tb),
                     )
-                except (ValueError, MemoryError):
-                    staged_evals = False
+                except Exception as e:
+                    if isinstance(e, ValueError) or _is_oom(e):
+                        staged_evals = False
+                    else:
+                        raise
             if staged_evals:
                 try:
                     val_loss, val_tasks = trainer.evaluate_staged(
@@ -1236,12 +1260,7 @@ def train_validate_test(
                         state, staged_evals[1]
                     )
                 except Exception as e:
-                    msg = str(e)
-                    if (
-                        isinstance(e, MemoryError)
-                        or "RESOURCE_EXHAUSTED" in msg
-                        or "out of memory" in msg.lower()
-                    ):
+                    if _is_oom(e):
                         staged_evals = False
                     else:
                         raise
