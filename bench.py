@@ -120,14 +120,19 @@ def bench_ours():
     state, _best, _sched, rng, series = trainer.fit_staged(
         state, staged, EPOCHS, rng
     )
-    t0 = time.perf_counter()
-    state, _best, _sched, rng, series = trainer.fit_staged(
-        state, staged, EPOCHS, rng
-    )
-    dt = time.perf_counter() - t0
+    # best of two timed runs: the dev chip is shared and run-to-run
+    # contention varies by tens of percent
+    best_dt = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        state, _best, _sched, rng, series = trainer.fit_staged(
+            state, staged, EPOCHS, rng
+        )
+        dt = time.perf_counter() - t0
+        assert np.isfinite(series["train_loss"]).all()
+        best_dt = dt if best_dt is None else min(best_dt, dt)
     steps = EPOCH_BATCHES * EPOCHS
-    assert np.isfinite(series["train_loss"]).all()
-    return BATCH_GRAPHS * steps / dt
+    return BATCH_GRAPHS * steps / best_dt
 
 
 def bench_torch_baseline():
@@ -230,11 +235,16 @@ def bench_torch_baseline():
         opt.step()
 
     step()  # warmup
-    t0 = time.perf_counter()
-    for _ in range(BASELINE_STEPS):
-        step()
-    dt = time.perf_counter() - t0
-    return BATCH_GRAPHS * BASELINE_STEPS / dt
+    # best of two, matching the measured framework's methodology — an
+    # asymmetric min() would inflate vs_baseline by the host's contention
+    best_dt = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(BASELINE_STEPS):
+            step()
+        dt = time.perf_counter() - t0
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+    return BATCH_GRAPHS * BASELINE_STEPS / best_dt
 
 
 def main():

@@ -189,14 +189,47 @@ class Trainer:
 
         if jax.process_count() > 1:
             # replicated GLOBAL arrays assembled from the (identical)
-            # host-local values on every process
+            # host-local values on every process. Note: under ZeRO the
+            # opt_state is transiently replicated here before resharding —
+            # multi-host direct placement would need per-leaf global
+            # assembly; single-process (below) places directly.
             from jax.experimental import multihost_utils
 
             state = jax.tree_util.tree_map(np.asarray, state)
-            return multihost_utils.host_local_array_to_global_array(
+            state = multihost_utils.host_local_array_to_global_array(
                 state, self.mesh, P()
             )
+            return self._maybe_shard_zero(state)
+        if self.training_config.get("Optimizer", {}).get(
+            "use_zero_redundancy", False
+        ):
+            # place opt-state leaves DIRECTLY at their target sharding —
+            # replicate-then-reshard would transiently hold the full
+            # optimizer state on every device, defeating ZeRO at init
+            from hydragnn_tpu.parallel.mesh import shard_optimizer_state
+
+            opt = shard_optimizer_state(state.opt_state, self.mesh)
+            placed = jax.device_put(
+                state.replace(opt_state=None), NamedSharding(self.mesh, P())
+            )
+            return placed.replace(opt_state=opt)
         return jax.device_put(state, NamedSharding(self.mesh, P()))
+
+    def _maybe_shard_zero(self, state: TrainState) -> TrainState:
+        """``Training.Optimizer.use_zero_redundancy`` (the reference's
+        ZeroRedundancyOptimizer / DeepSpeed-ZeRO switch,
+        ``utils/optimizer.py:142-151``): shard optimizer-state leaves over
+        the mesh's data axis. A sharding decision, not a different
+        optimizer — XLA inserts the all-gathers."""
+        if self.mesh is None or not self.training_config.get(
+            "Optimizer", {}
+        ).get("use_zero_redundancy", False):
+            return state
+        from hydragnn_tpu.parallel.mesh import shard_optimizer_state
+
+        return state.replace(
+            opt_state=shard_optimizer_state(state.opt_state, self.mesh)
+        )
 
     def _compact_for_transfer(
         self, batch: GraphBatch, allow_pos_placeholder: bool = True

@@ -55,6 +55,37 @@ def pytest_zero_redundancy_sharding():
     assert np.isfinite(float(metrics["loss"]))
 
 
+def pytest_zero_redundancy_config_key():
+    """The reference's Optimizer.use_zero_redundancy switch must actually
+    shard the optimizer state over the mesh (not just exist in docs)."""
+    from jax.sharding import PartitionSpec as P
+
+    batch = make_batch()
+    model = create_model_config(arch_config("SAGE"))
+    mesh = make_mesh()
+    trainer = Trainer(
+        model,
+        {
+            "Optimizer": {
+                "type": "AdamW",
+                "learning_rate": 1e-3,
+                "use_zero_redundancy": True,
+            }
+        },
+        mesh=mesh,
+    )
+    state = trainer.init_state(batch)
+    specs = [
+        getattr(leaf.sharding, "spec", None)
+        for leaf in jax.tree_util.tree_leaves(state.opt_state)
+        if hasattr(leaf, "sharding")
+    ]
+    assert any(s == P("data") for s in specs), specs
+    rng = jax.random.PRNGKey(0)
+    state, metrics = trainer._train_step(state, trainer.put_batch(batch), rng)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def pytest_freeze_conv():
     """freeze_conv_layers: encoder params must not change, heads must."""
     batch = make_batch()
