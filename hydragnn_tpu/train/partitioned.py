@@ -191,6 +191,17 @@ class PartitionedTrainer:
     def place_state(self, state):
         """Re-impose the step's sharding after a checkpoint restore (see
         Trainer.place_state / put_partitioned_state)."""
+        if self.training_config.get("Optimizer", {}).get(
+            "use_zero_redundancy", False
+        ):
+            import warnings
+
+            warnings.warn(
+                "use_zero_redundancy is not applied in graph-partition "
+                "mode: the mesh axis shards the GRAPH, not the batch, so "
+                "optimizer state stays replicated",
+                stacklevel=2,
+            )
         from hydragnn_tpu.parallel.graph_partition import put_partitioned_state
 
         return put_partitioned_state(state, self.mesh)

@@ -200,9 +200,7 @@ class Trainer:
                 state, self.mesh, P()
             )
             return self._maybe_shard_zero(state)
-        if self.training_config.get("Optimizer", {}).get(
-            "use_zero_redundancy", False
-        ):
+        if self._zero_enabled():
             # place opt-state leaves DIRECTLY at their target sharding —
             # replicate-then-reshard would transiently hold the full
             # optimizer state on every device, defeating ZeRO at init
@@ -215,15 +213,19 @@ class Trainer:
             return placed.replace(opt_state=opt)
         return jax.device_put(state, NamedSharding(self.mesh, P()))
 
+    def _zero_enabled(self) -> bool:
+        """``Training.Optimizer.use_zero_redundancy`` — the reference's
+        ZeroRedundancyOptimizer / DeepSpeed-ZeRO switch
+        (``utils/optimizer.py:142-151``). A sharding decision, not a
+        different optimizer — XLA inserts the all-gathers."""
+        return bool(
+            self.training_config.get("Optimizer", {}).get(
+                "use_zero_redundancy", False
+            )
+        )
+
     def _maybe_shard_zero(self, state: TrainState) -> TrainState:
-        """``Training.Optimizer.use_zero_redundancy`` (the reference's
-        ZeroRedundancyOptimizer / DeepSpeed-ZeRO switch,
-        ``utils/optimizer.py:142-151``): shard optimizer-state leaves over
-        the mesh's data axis. A sharding decision, not a different
-        optimizer — XLA inserts the all-gathers."""
-        if self.mesh is None or not self.training_config.get(
-            "Optimizer", {}
-        ).get("use_zero_redundancy", False):
+        if not self._zero_enabled():
             return state
         from hydragnn_tpu.parallel.mesh import shard_optimizer_state
 

@@ -164,6 +164,20 @@ def pytest_train_model_conv_head(model_type):
 
 
 @pytest.mark.parametrize("model_type", ["PNA"])
+def pytest_train_model_multistep_dispatch(model_type):
+    """steps_per_dispatch (scan multi-step) through the public API must hit
+    the same accuracy ceilings as the per-batch streaming path."""
+    unittest_train_model(
+        model_type,
+        "ci.json",
+        False,
+        overwrite_config={
+            "NeuralNetwork": {"Training": {"steps_per_dispatch": 4}}
+        },
+    )
+
+
+@pytest.mark.parametrize("model_type", ["PNA"])
 def pytest_train_model_nll_loss(model_type):
     """Uncertainty-weighted NLL multi-task loss (the mode the reference
     leaves unfinished): heads grow a log-variance channel, training through
