@@ -70,6 +70,20 @@ def apply_cli_overrides(config: dict) -> dict:
         v = example_arg(key)
         if v is not None:
             training[key] = int(v)
+    # execution-mode flags (every example gets them for free):
+    # --device-resident stages the training set in HBM; --fit-chunk N
+    # additionally runs whole-training chunks as single XLA dispatches
+    if example_arg("device-resident"):
+        training["device_resident_dataset"] = True
+    v = example_arg("fit-chunk")
+    if v is True:
+        raise SystemExit(
+            "--fit-chunk needs a value (epochs per whole-training "
+            "dispatch), e.g. --fit-chunk 10"
+        )
+    if v is not None:
+        training["device_resident_dataset"] = True
+        training["fit_chunk_epochs"] = int(v)
     return config
 
 

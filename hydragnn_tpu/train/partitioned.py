@@ -130,6 +130,17 @@ class PartitionedTrainer:
         self.tx = None
         self._train_step = None
         self._eval_step = None
+        if training_config.get("Optimizer", {}).get(
+            "use_zero_redundancy", False
+        ):
+            import warnings
+
+            warnings.warn(
+                "use_zero_redundancy is not applied in graph-partition "
+                "mode: the mesh axis shards the GRAPH, not the batch, so "
+                "optimizer state stays replicated",
+                stacklevel=2,
+            )
 
     def init_state(self, sample, seed: int = 0) -> TrainState:
         """Parameters from the unpartitioned twin on a single collated copy
@@ -190,18 +201,9 @@ class PartitionedTrainer:
 
     def place_state(self, state):
         """Re-impose the step's sharding after a checkpoint restore (see
-        Trainer.place_state / put_partitioned_state)."""
-        if self.training_config.get("Optimizer", {}).get(
-            "use_zero_redundancy", False
-        ):
-            import warnings
-
-            warnings.warn(
-                "use_zero_redundancy is not applied in graph-partition "
-                "mode: the mesh axis shards the GRAPH, not the batch, so "
-                "optimizer state stays replicated",
-                stacklevel=2,
-            )
+        Trainer.place_state / put_partitioned_state). The
+        use_zero_redundancy warning fires in ``__init__``, which every
+        construction path goes through."""
         from hydragnn_tpu.parallel.graph_partition import put_partitioned_state
 
         return put_partitioned_state(state, self.mesh)
