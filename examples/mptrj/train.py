@@ -2,12 +2,20 @@
 energy + forces.
 
 Mirrors ``examples/mptrj`` in the reference (Materials Project relaxation
-trajectories driving an EGNN force field). Offline: random clusters relaxed
-toward equilibrium in steps; every intermediate frame contributes a sample
-whose forces point along the relaxation path — exactly the structure of
-real MPtrj frames.
+trajectories driving an EGNN force field,
+``/root/reference/examples/mptrj/train.py:57-118``).
+
+Ingestion goes through the REAL MPtrj format: ``--data_dir`` (default
+``dataset/mptrj``) is scanned for ``MPtrj*.json`` and parsed with
+:func:`load_mptrj`, which reads the actual nested schema
+(``{mp_id: {frame_id: {structure: pymatgen-dict, energy_per_atom, force,
+stress, magmom}}}``) without pymatgen. Drop the real
+``MPtrj_2022.9_full.json`` there and it is used as-is. Offline, the example
+first materializes synthetic relaxation trajectories *in that same JSON
+schema*, so the real parser is the single code path either way.
 """
 
+import glob
 import os
 import sys
 
@@ -17,42 +25,82 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from common import (
     example_arg,
     load_config,
-    molecule_graph,
     random_molecule,
     train_example,
 )
 
+from hydragnn_tpu.data.mptrj import load_mptrj, write_mptrj_json
+
 ELEMENTS = [3, 14, 26, 8]  # Li Si Fe O — battery-materials flavour
 
 
-def trajectory(rng, radius, max_neighbours, frames=6):
+def trajectory_records(rng, traj_id, frames=6):
+    """One synthetic relaxation: every intermediate frame is a record in
+    the MPtrj flat schema (energy per atom, forces along the relaxation
+    path) — the structure of real MPtrj frames."""
     z, pos = random_molecule(rng, ELEMENTS, int(rng.integers(6, 12)), spread=2.0)
-    eq = pos + rng.normal(0, 0.05, pos.shape)  # the 'relaxed' geometry
-    samples = []
+    eq = pos + rng.normal(0, 0.05, pos.shape)
+    lattice = np.diag([30.0, 30.0, 30.0])  # big box; loader is non-PBC anyway
+    records = []
     cur = pos + rng.normal(0, 0.35, pos.shape)
-    for _ in range(frames):
+    for fi in range(frames):
         disp = cur - eq
-        energy = 0.5 * float((disp**2).sum()) / len(z)
-        forces = -disp
-        samples.append(
-            molecule_graph(
-                z, cur.astype(np.float32), radius, max_neighbours,
-                targets=[np.array([energy]), forces.astype(np.float32)],
-                target_types=["graph", "node"],
-            )
+        energy = 0.5 * float((disp**2).sum()) / len(z)  # per atom
+        records.append(
+            {
+                "mp_id": f"mp-{traj_id}",
+                "frame_id": f"mp-{traj_id}-{fi}-0",
+                "z": z.astype(np.int64),
+                "pos": cur.astype(np.float64) + 15.0,  # centered in the box
+                "lattice": lattice,
+                "energy": energy,
+                "forces": (-disp).astype(np.float64),
+                "magmom": np.zeros(len(z)),
+            }
         )
         cur = cur - 0.4 * disp  # one relaxation step
-    return samples
+    return records
 
 
 def main():
     config = load_config(__file__, "mptrj.json")
     arch = config["NeuralNetwork"]["Architecture"]
     num_traj = int(example_arg("num_samples", 120))
-    rng = np.random.default_rng(5)
+    # cap on parsed REAL frames (the full MPtrj json is ~1.6M frames;
+    # loading it whole is a deliberate act, not a default)
+    max_frames = example_arg("max_frames", 20000)
+    max_frames = None if str(max_frames) in ("0", "all") else int(max_frames)
+    data_dir = str(example_arg("data_dir", "dataset/mptrj"))
+    synthetic_path = os.path.join(data_dir, "MPtrj_synthetic.json")
+    marker = synthetic_path + ".meta"
+    paths = sorted(glob.glob(os.path.join(data_dir, "MPtrj*.json")))
+    stale_synthetic = (
+        paths == [synthetic_path]
+        and os.path.exists(marker)
+        and open(marker).read().strip() != str(num_traj)
+    )
+    if not paths or stale_synthetic:
+        rng = np.random.default_rng(5)
+        records = []
+        for t in range(num_traj):
+            records.extend(trajectory_records(rng, t))
+        write_mptrj_json(synthetic_path, records)
+        with open(marker, "w") as f:
+            f.write(str(num_traj))
+        paths = [synthetic_path]
     dataset = []
-    for _ in range(num_traj):
-        dataset.extend(trajectory(rng, arch["radius"], arch["max_neighbours"]))
+    for p in paths:
+        remaining = None if max_frames is None else max_frames - len(dataset)
+        if remaining is not None and remaining <= 0:
+            break
+        dataset.extend(
+            load_mptrj(
+                p,
+                radius=arch["radius"],
+                max_neighbours=arch["max_neighbours"],
+                num_samples=remaining,
+            )
+        )
     train_example(config, dataset, log_name="mptrj")
 
 

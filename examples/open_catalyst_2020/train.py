@@ -12,9 +12,16 @@ Mirrors ``examples/open_catalyst_2020/train.py`` in the reference:
                   so each process holds one partition and fetches remote
                   samples on demand (``train.py:308-347``).
 
-Offline data: FCC metal slabs (Cu/Pt/Ag) with a small adsorbate (H, O, C)
-above the surface, periodic in-plane; adsorption 'energy' is a deterministic
-function of adsorbate identity and local coordination.
+Ingestion goes through the REAL OC20 format: structures are read from
+``.extxyz`` files (``--data_dir`` to point at a directory of real OC20
+frames) with the ase-free extxyz parser and converted by
+:func:`frame_to_graph`, the ``AtomsToGraphs.convert`` analog
+(``/root/reference/examples/open_catalyst_2020/utils/atoms_to_graphs.py:26``)
+— PBC radius graph, energy target, edge lengths. Offline, each rank first
+materializes synthetic FCC slab+adsorbate structures (periodic in-plane,
+adsorption 'energy' a deterministic function of adsorbate identity and
+coordination) as extxyz frames, so the real parser is the single code
+path either way.
 """
 
 import os
@@ -26,6 +33,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from common import example_arg, load_config, train_with_loaders
 
 from hydragnn_tpu.data import GraphData, radius_graph_pbc, split_dataset
+from hydragnn_tpu.data.extxyz import frame_to_graph, iter_extxyz, write_extxyz
 from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
 from hydragnn_tpu.parallel.distributed import (
     get_comm_size_and_rank,
@@ -40,7 +48,9 @@ VACUUM = 15.0
 
 
 def make_structure(rng, radius, max_neighbours):
-    """2-layer 2x2 FCC(100) slab + one adsorbate in the vacuum gap."""
+    """2-layer 2x2 FCC(100) slab + one adsorbate, as an extxyz frame dict
+    (z, pos, cell, energy in info) — the synthetic stand-in for one real
+    OC20 frame."""
     metal = METALS[int(rng.integers(len(METALS)))]
     ads = ADSORBATES[int(rng.integers(len(ADSORBATES)))]
     pos, z = [], []
@@ -58,31 +68,63 @@ def make_structure(rng, radius, max_neighbours):
     pos = np.asarray(pos, np.float64) + rng.normal(0, 0.05, (9, 3))
     cell = np.diag([2 * ALAT, 2 * ALAT, ALAT + VACUUM])
 
-    d = GraphData(
-        x=np.asarray(z, np.float32).reshape(-1, 1),
-        pos=pos.astype(np.float32),
-        supercell_size=cell,
-    )
-    d.edge_index, _ = radius_graph_pbc(pos, cell, radius, max_neighbours)
     # adsorption energy: species term + coordination of the adsorbate
-    ads_coord = int((d.edge_index[1] == 8).sum())
+    edge_index, _ = radius_graph_pbc(pos, cell, radius, max_neighbours)
+    ads_coord = int((edge_index[1] == 8).sum())
     energy = {1: -0.5, 8: -1.2, 6: -0.9}[ads] * (1 + 0.15 * ads_coord) + {
         29: 0.1, 78: -0.3, 47: 0.2
     }[metal]
-    d.targets = [np.asarray([energy], np.float32)]
-    d.target_types = ["graph"]
-    return d
+    return {
+        "z": np.asarray(z, np.int64),
+        "pos": pos,
+        "cell": cell,
+        "info": {"energy": energy},
+        "arrays": {},
+    }
 
 
 def preonly(config, modelname, num_samples):
     world, rank = get_comm_size_and_rank()
     arch = config["NeuralNetwork"]["Architecture"]
-    my_ids = list(nsplit(range(num_samples), world))[rank]
-    rng = np.random.default_rng(42 + rank)
-    samples = [
-        make_structure(rng, arch["radius"], arch["max_neighbours"])
-        for _ in my_ids
-    ]
+    data_dir = example_arg("data_dir")
+    xyz_dir = str(data_dir) if data_dir else f"dataset/{modelname}_extxyz"
+    my_xyz = os.path.join(xyz_dir, f"structures_rank{rank}.extxyz")
+    if not data_dir:
+        # offline: materialize this rank's share of synthetic structures
+        # in the real extxyz format first
+        my_ids = list(nsplit(range(num_samples), world))[rank]
+        rng = np.random.default_rng(42 + rank)
+        os.makedirs(xyz_dir, exist_ok=True)
+        write_extxyz(
+            my_xyz,
+            (make_structure(rng, arch["radius"], arch["max_neighbours"])
+             for _ in my_ids),
+        )
+        files = [my_xyz]
+    else:
+        # real data: nsplit the frame files across ranks (train.py:67-80)
+        all_files = sorted(
+            os.path.join(xyz_dir, f) for f in os.listdir(xyz_dir)
+            if f.endswith(".extxyz") or f.endswith(".xyz")
+        )
+        files = list(nsplit(all_files, world))[rank]
+    # Threshold for atomic forces in eV/angstrom (reference train.py:60)
+    forces_norm_threshold = 100.0
+    samples = []
+    for path in files:
+        for frame in iter_extxyz(path):
+            forces = frame["arrays"].get("forces")
+            if forces is not None and len(forces):
+                if np.linalg.norm(forces, axis=1).max() > forces_norm_threshold:
+                    continue
+            samples.append(
+                frame_to_graph(
+                    frame,
+                    radius=arch["radius"],
+                    max_neighbours=arch["max_neighbours"],
+                    energy_per_atom=False,
+                )
+            )
     # local 0.9 split, like the reference (train.py:237-242)
     trainset, valset, testset = split_dataset(samples, 0.9, False)
     for name, ds in [("trainset", trainset), ("valset", valset),

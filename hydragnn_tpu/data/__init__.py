@@ -21,6 +21,20 @@ from hydragnn_tpu.data.split import (
     stratified_subsample,
 )
 from hydragnn_tpu.data.raw import AbstractRawDataset
+from hydragnn_tpu.data.elements import SYMBOL_TO_Z, Z_TO_SYMBOL, atomic_number
+from hydragnn_tpu.data.qm9_raw import QM9RawDataset, write_qm9_sdf
+from hydragnn_tpu.data.extxyz import (
+    frame_to_graph,
+    iter_extxyz,
+    load_extxyz_dir,
+    read_extxyz,
+    write_extxyz,
+)
+from hydragnn_tpu.data.mptrj import load_mptrj, write_mptrj_json
+from hydragnn_tpu.data.pickledataset import (
+    SimplePickleDataset,
+    SimplePickleWriter,
+)
 from hydragnn_tpu.data.lsms import LSMSDataset
 from hydragnn_tpu.data.cfg import CFGDataset
 from hydragnn_tpu.data.xyz import XYZDataset

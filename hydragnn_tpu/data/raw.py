@@ -50,6 +50,29 @@ class AbstractRawDataset:
     def transform_input_to_data_object_base(self, filepath: str):
         raise NotImplementedError
 
+    # ---- sequence protocol over the loaded samples ---------------------
+    # (reference AbstractBaseDataset semantics: ``len(ds)`` / ``ds[i]`` /
+    # iteration work on the constructed dataset,
+    # ``utils/abstractbasedataset.py:6-46``). Loads lazily on first use;
+    # the flat view is built once and cached.
+    def _all_samples(self) -> List[GraphData]:
+        flat = getattr(self, "_flat_samples", None)
+        if flat is None:
+            if not self.dataset_list:
+                self.load_raw_data()
+            flat = [d for split in self.dataset_list for d in split]
+            self._flat_samples = flat
+        return flat
+
+    def __len__(self):
+        return len(self._all_samples())
+
+    def __getitem__(self, i: int) -> GraphData:
+        return self._all_samples()[i]
+
+    def __iter__(self):
+        return iter(self._all_samples())
+
     def load_raw_data(self):
         serialized_dir = os.path.join(
             os.environ.get("SERIALIZED_DATA_PATH", os.getcwd()),

@@ -1,0 +1,318 @@
+"""Real-dataset format parsers: QM9 sdf/csv + dsgdb9nsd xyz, OC20 extxyz,
+MPtrj JSON.
+
+Fixture data uses the first molecules of the actual QM9 distribution
+(methane / ammonia / water: real published geometries and property rows) in
+the exact gdb9 file layout, so the parser is tested against the real
+format, not a convenient imitation. The datasets themselves cannot be
+downloaded in this environment (no network egress); dropping the real
+``gdb9.sdf`` next to these fixtures exercises the identical code path.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.extxyz import (
+    frame_to_graph,
+    iter_extxyz,
+    load_extxyz_dir,
+    write_extxyz,
+)
+from hydragnn_tpu.data.mptrj import (
+    iter_mptrj,
+    load_mptrj,
+    structure_from_dict,
+    write_mptrj_json,
+)
+from hydragnn_tpu.data.qm9_raw import (
+    HAR2EV,
+    QM9RawDataset,
+    parse_dsgdb9nsd_xyz,
+    parse_sdf_v2000,
+    read_gdb9_csv,
+    read_uncharacterized,
+)
+
+# --- real QM9 rows (gdb_1 methane, gdb_2 ammonia, gdb_3 water) -------------
+
+_GDB9_SDF = """gdb_1
+  -OEChem-03231823243D
+
+  5  4  0  0  0  0  0  0  0  0999 V2000
+   -0.0127    1.0858    0.0080 C   0  0  0  0  0  0  0  0  0  0  0  0
+    0.0022   -0.0060    0.0020 H   0  0  0  0  0  0  0  0  0  0  0  0
+    1.0117    1.4638    0.0003 H   0  0  0  0  0  0  0  0  0  0  0  0
+   -0.5408    1.4475   -0.8766 H   0  0  0  0  0  0  0  0  0  0  0  0
+   -0.5238    1.4379    0.9064 H   0  0  0  0  0  0  0  0  0  0  0  0
+  1  2  1  0  0  0  0
+  1  3  1  0  0  0  0
+  1  4  1  0  0  0  0
+  1  5  1  0  0  0  0
+M  END
+$$$$
+gdb_2
+  -OEChem-03231823243D
+
+  4  3  0  0  0  0  0  0  0  0999 V2000
+   -0.0404    1.0241    0.0626 N   0  0  0  0  0  0  0  0  0  0  0  0
+    0.0172    0.0125    0.0042 H   0  0  0  0  0  0  0  0  0  0  0  0
+    0.9158    1.3587   -0.0086 H   0  0  0  0  0  0  0  0  0  0  0  0
+   -0.5203    1.3435   -0.7755 H   0  0  0  0  0  0  0  0  0  0  0  0
+  1  2  1  0  0  0  0
+  1  3  1  0  0  0  0
+  1  4  1  0  0  0  0
+M  END
+$$$$
+gdb_3
+  -OEChem-03231823243D
+
+  3  2  0  0  0  0  0  0  0  0999 V2000
+   -0.0343    0.9775    0.0076 O   0  0  0  0  0  0  0  0  0  0  0  0
+    0.0647    0.0205    0.0015 H   0  0  0  0  0  0  0  0  0  0  0  0
+    0.8717    1.3008    0.0006 H   0  0  0  0  0  0  0  0  0  0  0  0
+  1  2  1  0  0  0  0
+  1  3  1  0  0  0  0
+M  END
+$$$$
+"""
+
+_GDB9_CSV = """mol_id,A,B,C,mu,alpha,homo,lumo,gap,r2,zpve,u0,u298,h298,g298,cv,u0_atom,u298_atom,h298_atom,g298_atom
+gdb_1,157.7118,157.70997,157.70699,0.0,13.21,-0.3877,0.1171,0.5048,35.3641,0.044749,-40.47893,-40.476062,-40.475117,-40.498597,6.469,-395.999595,-398.64329,-401.014647,-372.471772
+gdb_2,293.60975,293.54111,191.39397,1.6256,9.46,-0.257,0.0829,0.3399,26.1563,0.034358,-56.525887,-56.523026,-56.522082,-56.544961,6.316,-276.861363,-278.620271,-280.399259,-259.338802
+gdb_3,799.58812,437.90386,282.94545,1.8511,6.31,-0.2928,0.0687,0.3615,19.0002,0.021375,-76.404702,-76.401867,-76.400922,-76.422349,6.002,-213.087624,-213.974294,-215.159658,-201.407171
+"""
+
+
+@pytest.fixture()
+def qm9_root(tmp_path):
+    root = tmp_path / "qm9raw"
+    root.mkdir()
+    (root / "gdb9.sdf").write_text(_GDB9_SDF)
+    (root / "gdb9.sdf.csv").write_text(_GDB9_CSV)
+    # real-file shape: 9 banner lines, "  index  name ..." rows, count tail
+    (root / "uncharacterized.txt").write_text(
+        "\n" * 9 + "  2  gdb_2 fails\n" + "1 compounds\n"
+    )
+    return str(root)
+
+
+def pytest_qm9_sdf_parser():
+    mols = parse_sdf_v2000(_GDB9_SDF)
+    assert len(mols) == 3
+    syms, pos, bonds = mols[0]
+    assert syms == ["C", "H", "H", "H", "H"]
+    assert pos.shape == (5, 3) and bonds.shape == (4, 2)
+    assert bonds[0].tolist() == [0, 1]  # 0-based
+    # C-H bond length ~1.09 A in the real geometry
+    d = np.linalg.norm(pos[0] - pos[1], axis=-1)
+    assert 1.05 < d < 1.15
+
+
+def pytest_qm9_csv_pyg_ordering():
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".csv", delete=False) as f:
+        f.write(_GDB9_CSV)
+        path = f.name
+    y = read_gdb9_csv(path)
+    os.unlink(path)
+    assert y.shape == (3, 19)
+    # PyG order: index 0 = mu (Debye, unconverted), 10 = g298 (Ha -> eV),
+    # 16 = A (GHz, unconverted)
+    assert y[1, 0] == pytest.approx(1.6256)
+    assert y[0, 10] == pytest.approx(-40.498597 * HAR2EV)
+    assert y[0, 16] == pytest.approx(157.7118)
+
+
+def pytest_qm9_raw_dataset(qm9_root):
+    ds = QM9RawDataset(qm9_root, target_index=10, per_atom=True)
+    # gdb_2 is uncharacterized -> skipped
+    assert len(ds) == 2
+    d = ds[0]
+    assert d.x.shape == (5, 1) and d.x[0, 0] == 6.0  # carbon
+    assert d.target_types == ["graph"]
+    assert d.targets[0][0] == pytest.approx(-40.498597 * HAR2EV / 5, rel=1e-6)
+    assert d.edge_index.shape[0] == 2 and d.num_edges > 0
+    # bond-edge mode: methane has 4 bonds -> 8 directed edges
+    ds_b = QM9RawDataset(qm9_root, edges="bonds")
+    assert ds_b[0].num_edges == 8
+
+
+def pytest_qm9_dsgdb9nsd_xyz(tmp_path):
+    # original-layout file for water with '*^' Fortran exponents
+    (tmp_path / "dsgdb9nsd_000003.xyz").write_text(
+        "3\n"
+        "gdb 3\t799.58812\t437.90386\t282.94545\t1.8511\t6.31\t-0.2928\t"
+        "0.0687\t0.3615\t19.0002\t2.1375*^-2\t-76.404702\t-76.401867\t"
+        "-76.400922\t-76.422349\t6.002\n"
+        "O\t-0.0343\t0.9775\t0.0076\t-0.3872\n"
+        "H\t0.0647\t0.0205\t0.0015\t0.1936\n"
+        "H\t0.8717\t1.3008\t0.0006\t0.1936\n"
+        "1341.307\t1341.307\t2591.043\n"
+    )
+    syms, pos, y = parse_dsgdb9nsd_xyz(str(tmp_path / "dsgdb9nsd_000003.xyz"))
+    assert syms == ["O", "H", "H"]
+    assert y[0] == pytest.approx(1.8511)  # mu
+    assert y[6] == pytest.approx(0.021375 * HAR2EV)  # zpve, *^ exponent
+    assert y[10] == pytest.approx(-76.422349 * HAR2EV)
+    assert np.isnan(y[12])  # atomization energies absent in this layout
+    ds = QM9RawDataset(str(tmp_path))
+    assert len(ds) == 1 and ds[0].x[0, 0] == 8.0
+
+
+def pytest_extxyz_roundtrip(tmp_path):
+    cell = np.diag([7.2, 7.2, 18.6])
+    frames = [
+        {
+            "z": np.array([29, 29, 1]),
+            "pos": np.array([[0.0, 0, 0], [1.8, 1.8, 0], [1.8, 1.8, 2.1]]),
+            "cell": cell,
+            "info": {"energy": -12.345678},
+            "arrays": {"forces": np.array([[0.0, 0, 0.1], [0, 0, -0.2], [0, 0, 0.1]])},
+        }
+    ]
+    path = str(tmp_path / "s0.extxyz")
+    write_extxyz(path, frames)
+    back = list(iter_extxyz(path))
+    assert len(back) == 1
+    fr = back[0]
+    assert fr["symbols"] == ["Cu", "Cu", "H"]
+    assert fr["z"].tolist() == [29, 29, 1]
+    np.testing.assert_allclose(fr["pos"], frames[0]["pos"], atol=1e-6)
+    np.testing.assert_allclose(fr["cell"], cell, atol=1e-6)
+    assert fr["pbc"].all()
+    assert fr["info"]["energy"] == pytest.approx(-12.345678)
+    np.testing.assert_allclose(
+        fr["arrays"]["forces"], frames[0]["arrays"]["forces"], atol=1e-6
+    )
+
+    g = frame_to_graph(fr, radius=4.0, max_neighbours=12)
+    assert g.target_types == ["graph", "node"]
+    assert g.targets[0][0] == pytest.approx(-12.345678 / 3)
+    assert g.targets[1].shape == (3, 3)
+    assert g.edge_attr is not None and g.edge_attr.shape[1] == 1
+    # PBC: corner Cu sees the other Cu through the cell boundary too
+    assert g.num_edges >= 4
+
+
+def pytest_extxyz_dir_force_filter(tmp_path):
+    ok = {
+        "z": np.array([1, 1]),
+        "pos": np.array([[0.0, 0, 0], [0, 0, 0.9]]),
+        "info": {"energy": -1.0},
+        "arrays": {"forces": np.zeros((2, 3))},
+    }
+    bad = dict(ok)
+    bad = {
+        **ok,
+        "arrays": {"forces": np.array([[0.0, 0, 500.0], [0, 0, 0]])},
+    }
+    write_extxyz(str(tmp_path / "a.extxyz"), [ok, bad])
+    graphs = load_extxyz_dir(str(tmp_path), radius=2.0)
+    assert len(graphs) == 1  # 500 eV/A frame dropped
+
+
+def pytest_mptrj_roundtrip(tmp_path):
+    lattice = np.diag([4.0, 4.0, 4.0])
+    rec = {
+        "mp_id": "mp-1",
+        "frame_id": "mp-1-0-0",
+        "z": np.array([26, 8]),
+        "pos": np.array([[0.0, 0, 0], [2.0, 2.0, 2.0]]),
+        "lattice": lattice,
+        "energy": -6.5,  # per atom
+        "forces": np.array([[0.0, 0, 0.3], [0, 0, -0.3]]),
+        "stress": np.eye(3) * 0.1,
+        "magmom": np.array([2.2, 0.1]),
+    }
+    path = str(tmp_path / "MPtrj_tiny.json")
+    write_mptrj_json(path, [rec])
+    # the written file is genuine MPtrj schema: nested dicts + pymatgen sites
+    with open(path) as f:
+        nested = json.load(f)
+    site0 = nested["mp-1"]["mp-1-0-0"]["structure"]["sites"][0]
+    assert site0["species"][0]["element"] == "Fe"
+    z, pos, lat = structure_from_dict(nested["mp-1"]["mp-1-0-0"]["structure"])
+    assert z.tolist() == [26, 8]
+    np.testing.assert_allclose(pos, rec["pos"], atol=1e-8)
+
+    graphs = load_mptrj(path, radius=4.5)
+    assert len(graphs) == 1
+    g = graphs[0]
+    assert g.target_types == ["graph", "node"]
+    assert g.targets[0][0] == pytest.approx(-6.5)
+    assert g.extras["mp_id"] == "mp-1"
+    assert "magmom" in g.extras and "stress" in g.extras
+
+
+def pytest_mptrj_fractional_sites():
+    s = {
+        "lattice": {"matrix": [[2.0, 0, 0], [0, 2.0, 0], [0, 0, 2.0]]},
+        "sites": [
+            {"species": [{"element": "Li", "occu": 1.0}], "abc": [0.5, 0.5, 0.5]}
+        ],
+    }
+    z, pos, lat = structure_from_dict(s)
+    assert z.tolist() == [3]
+    np.testing.assert_allclose(pos[0], [1.0, 1.0, 1.0])
+
+
+def pytest_qm9_raw_trains_end_to_end(qm9_root, tmp_path, monkeypatch):
+    """Real-format QM9 -> loaders -> PNA training steps through the public
+    pipeline (tiny but complete: proves the ingestion path feeds the
+    framework)."""
+    monkeypatch.chdir(tmp_path)
+    import jax
+
+    from hydragnn_tpu.data import create_dataloaders
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import Trainer
+    from hydragnn_tpu.utils.config import update_config
+
+    ds = QM9RawDataset(qm9_root, radius=7.0, max_neighbours=5)
+    samples = [ds[i % len(ds)].clone() for i in range(12)]
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "PNA",
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [8],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Training": {"batch_size": 4, "num_epoch": 1,
+                          "Optimizer": {"learning_rate": 1e-3}},
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["free_energy"],
+                "output_index": [0],
+                "output_dim": [1],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+        }
+    }
+    tr, va, te = samples[:8], samples[8:10], samples[10:]
+    train_loader, val_loader, test_loader = create_dataloaders(tr, va, te, 4)
+    config = update_config(config, train_loader, val_loader, test_loader)
+    arch = dict(config["NeuralNetwork"]["Architecture"])
+    arch["loss_function_type"] = "mse"
+    model = create_model_config(arch, 0)
+    trainer = Trainer(model, config["NeuralNetwork"]["Training"], verbosity=0)
+    batch = next(iter(train_loader))
+    state = trainer.init_state(batch, seed=0)
+    rng = jax.random.PRNGKey(0)
+    for _ in range(2):
+        rng, sub = jax.random.split(rng)
+        state, metrics = trainer._train_step(state, trainer.put_batch(batch), sub)
+    assert np.isfinite(float(metrics["loss"]))
