@@ -247,8 +247,44 @@ def bench_torch_baseline():
     return BATCH_GRAPHS * BASELINE_STEPS / best_dt
 
 
+def bench_extra_rows():
+    """Per-model and MXU-scale rows (round-2 verdict items 2-3): SchNet /
+    EGNN / DimeNet train-step throughput at the headline scale, plus PNA at
+    OC20-scale widths with the dense scatter-free path and bf16, each with
+    XLA-counted TFLOP/s and MFU. Skippable via HYDRAGNN_BENCH_EXTRAS=0."""
+    import os
+
+    if os.getenv("HYDRAGNN_BENCH_EXTRAS", "1") == "0":
+        return []
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.model_bench import bench_model
+
+    configs = [
+        dict(model_type="SchNet", hidden=64, num_graphs=256, nodes=18,
+             degree=4, layers=3),
+        dict(model_type="EGNN", hidden=64, num_graphs=256, nodes=18,
+             degree=4, layers=3),
+        dict(model_type="DimeNet", hidden=64, num_graphs=64, nodes=18,
+             degree=4, layers=3),
+        dict(model_type="PNA", hidden=256, num_graphs=64, nodes=90,
+             degree=12, layers=3),
+        dict(model_type="PNA", hidden=256, num_graphs=64, nodes=90,
+             degree=12, layers=3, dense=True, bf16=True),
+        dict(model_type="PNA", hidden=512, num_graphs=64, nodes=90,
+             degree=12, layers=3, dense=True, bf16=True),
+    ]
+    rows = []
+    for kw in configs:
+        try:
+            rows.append(bench_model(**kw, iters=12))
+        except Exception as e:
+            print(f"extra row {kw} failed: {e}", file=sys.stderr)
+    return rows
+
+
 def main():
     ours = bench_ours()
+    extra = bench_extra_rows()
     try:
         base = bench_torch_baseline()
     except Exception as e:
@@ -261,6 +297,7 @@ def main():
                 "value": round(ours, 2),
                 "unit": "graphs/sec",
                 "vs_baseline": round(ours / base, 3) if base else None,
+                "extra_rows": extra,
             }
         )
     )

@@ -130,11 +130,13 @@ def train_with_loaders(config, trainset, valset, testset, log_name, seed=0):
     print_utils.setup_log(log_name)
 
     training = config["NeuralNetwork"]["Training"]
-    need_triplets = (
-        config["NeuralNetwork"]["Architecture"].get("model_type") == "DimeNet"
-    )
+    from hydragnn_tpu.data.loaders import needs_dense_neighbors
+
+    arch_cfg = config["NeuralNetwork"]["Architecture"]
+    need_triplets = arch_cfg.get("model_type") == "DimeNet"
     train_loader, val_loader, test_loader = create_dataloaders(
-        trainset, valset, testset, training["batch_size"], need_triplets
+        trainset, valset, testset, training["batch_size"], need_triplets,
+        need_neighbors=needs_dense_neighbors(arch_cfg),
     )
     config = update_config(config, train_loader, val_loader, test_loader)
     save_config(config, log_name)

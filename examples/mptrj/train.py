@@ -74,6 +74,10 @@ def main():
     synthetic_path = os.path.join(data_dir, "MPtrj_synthetic.json")
     marker = synthetic_path + ".meta"
     paths = sorted(glob.glob(os.path.join(data_dir, "MPtrj*.json")))
+    real_paths = [p for p in paths if p != synthetic_path]
+    if real_paths:
+        # real MPtrj files present: never mix a leftover synthetic file in
+        paths = real_paths
     stale_synthetic = (
         paths == [synthetic_path]
         and os.path.exists(marker)

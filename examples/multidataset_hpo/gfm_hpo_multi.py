@@ -38,6 +38,9 @@ def main():
         trial.suggest_int("num_headlayers", 1, 3)
         trial.suggest_int("dim_headlayers", 32, 128)
         trial.params["num_epoch"] = int(os.environ.get("HPO_TRIAL_EPOCHS", "3"))
+        trial.params["num_samples"] = int(
+            os.environ.get("HPO_NUM_SAMPLES", "600")
+        )
         return launcher.run(trial)
 
     study.optimize(objective, n_trials=n_trials)

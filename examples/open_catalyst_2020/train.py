@@ -32,8 +32,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from common import example_arg, load_config, train_with_loaders
 
-from hydragnn_tpu.data import GraphData, radius_graph_pbc, split_dataset
-from hydragnn_tpu.data.extxyz import frame_to_graph, iter_extxyz, write_extxyz
+from hydragnn_tpu.data import radius_graph_pbc, split_dataset
+from hydragnn_tpu.data.extxyz import load_extxyz_dir, write_extxyz
 from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
 from hydragnn_tpu.parallel.distributed import (
     get_comm_size_and_rank,
@@ -108,23 +108,14 @@ def preonly(config, modelname, num_samples):
             if f.endswith(".extxyz") or f.endswith(".xyz")
         )
         files = list(nsplit(all_files, world))[rank]
-    # Threshold for atomic forces in eV/angstrom (reference train.py:60)
-    forces_norm_threshold = 100.0
-    samples = []
-    for path in files:
-        for frame in iter_extxyz(path):
-            forces = frame["arrays"].get("forces")
-            if forces is not None and len(forces):
-                if np.linalg.norm(forces, axis=1).max() > forces_norm_threshold:
-                    continue
-            samples.append(
-                frame_to_graph(
-                    frame,
-                    radius=arch["radius"],
-                    max_neighbours=arch["max_neighbours"],
-                    energy_per_atom=False,
-                )
-            )
+    # conversion + the forces_norm_threshold=100 sanity filter live in
+    # load_extxyz_dir (one shared implementation, reference train.py:60)
+    samples = load_extxyz_dir(
+        files=files,
+        radius=arch["radius"],
+        max_neighbours=arch["max_neighbours"],
+        energy_per_atom=False,
+    )
     # local 0.9 split, like the reference (train.py:237-242)
     trainset, valset, testset = split_dataset(samples, 0.9, False)
     for name, ds in [("trainset", trainset), ("valset", valset),

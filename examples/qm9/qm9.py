@@ -55,31 +55,79 @@ def generate_qm9_format(root, num_samples, seed=0):
         targets.append(row)
     write_qm9_sdf(root, molecules, np.asarray(targets))
     with open(os.path.join(root, ".synthetic"), "w") as f:
-        f.write(f"{num_samples} {seed}\n")
+        f.write(f"{num_samples} {seed} {_sdf_hash(root)}\n")
+
+
+def _sdf_hash(root):
+    import hashlib
+
+    with open(os.path.join(root, "gdb9.sdf"), "rb") as f:
+        return hashlib.md5(f.read()).hexdigest()
+
+
+def _synthetic_state(data_dir, num_samples):
+    """(is_synthetic, is_stale). The marker records the generated sdf's
+    hash — if the on-disk sdf doesn't match (user dropped the REAL dataset
+    in over it), the files are treated as real and NEVER regenerated."""
+    marker = os.path.join(data_dir, ".synthetic")
+    if not os.path.exists(marker) or not os.path.exists(
+        os.path.join(data_dir, "gdb9.sdf")
+    ):
+        return False, False
+    fields = open(marker).read().split()
+    if len(fields) < 3:
+        # legacy marker (pre-hash format): only the generator ever wrote
+        # it, so trust it — old behavior, regenerate on count change
+        return True, int(fields[0]) != num_samples
+    if fields[2] != _sdf_hash(data_dir):
+        return False, False  # files are not the ones we generated
+    return True, int(fields[0]) != num_samples
+
+
+def qm9_dataset(num_samples, radius, max_neighbours, seed=0,
+                root="dataset/qm9/raw"):
+    """Synthetic QM9 round-tripped through the real gdb9 format (the
+    single ingestion path) — used by the HPO example and tests."""
+    is_syn, stale = _synthetic_state(root, num_samples)
+    if not os.path.exists(os.path.join(root, "gdb9.sdf")) or (is_syn and stale):
+        generate_qm9_format(root, num_samples, seed)
+    return list(
+        QM9RawDataset(
+            root,
+            radius=radius,
+            max_neighbours=max_neighbours,
+            num_samples=num_samples,
+        )
+    )
 
 
 def main():
     config = load_config(__file__, "qm9.json")
     arch = config["NeuralNetwork"]["Architecture"]
-    num_samples = int(example_arg("num_samples", 1000))
+    raw_flag = example_arg("num_samples")
+    num_samples = int(raw_flag) if raw_flag not in (None, "all", "0") else 1000
     data_dir = str(example_arg("data_dir", "dataset/qm9/raw"))
     have_data = os.path.exists(os.path.join(data_dir, "gdb9.sdf")) or any(
         f.startswith("dsgdb9nsd_")
         for f in (os.listdir(data_dir) if os.path.isdir(data_dir) else [])
     )
-    marker = os.path.join(data_dir, ".synthetic")
-    stale_synthetic = os.path.exists(marker) and not open(
-        marker
-    ).read().startswith(f"{num_samples} ")
-    if not have_data or stale_synthetic:
+    is_synthetic, is_stale = _synthetic_state(data_dir, num_samples)
+    if not have_data or (is_synthetic and is_stale):
         generate_qm9_format(data_dir, num_samples)
+        is_synthetic = True
+    # --num_samples caps REAL data only when given explicitly
+    # (--num_samples all / 0 = the whole dataset); synthetic data is
+    # exactly num_samples molecules by construction
+    cap = None
+    if is_synthetic or raw_flag not in (None, "all", "0"):
+        cap = num_samples
     dataset = QM9RawDataset(
         data_dir,
         target_index=10,  # free energy, the reference example's property
         per_atom=True,
         radius=arch["radius"],
         max_neighbours=arch["max_neighbours"],
-        num_samples=num_samples,
+        num_samples=cap,
     )
     train_example(config, list(dataset), log_name="qm9")
 

@@ -207,22 +207,32 @@ def frame_to_graph(
 
 
 def load_extxyz_dir(
-    dirpath: str,
+    dirpath: Optional[str] = None,
     radius: float = 6.0,
     max_neighbours: int = 50,
     energy_per_atom: bool = True,
     forces_norm_threshold: Optional[float] = 100.0,
     num_samples: Optional[int] = None,
+    files: Optional[List[str]] = None,
 ) -> List[GraphData]:
-    """All ``*.extxyz``/``*.xyz`` frames under a directory -> graphs,
-    dropping frames whose max force norm exceeds the threshold (the
-    reference's ``forces_norm_threshold = 100.0`` eV/A sanity filter,
-    ``open_catalyst_2020/train.py:60``)."""
+    """Extxyz frames -> graphs, dropping frames whose max force norm
+    exceeds the threshold (the reference's ``forces_norm_threshold =
+    100.0`` eV/A sanity filter, ``open_catalyst_2020/train.py:60``).
+
+    Source is either every ``*.extxyz``/``*.xyz`` under ``dirpath`` or an
+    explicit ``files`` list (the parallel-preprocessing case: each rank
+    passes its nsplit share)."""
+    if files is None:
+        if dirpath is None:
+            raise ValueError("need dirpath or files")
+        files = [
+            os.path.join(dirpath, fn)
+            for fn in sorted(os.listdir(dirpath))
+            if fn.endswith(".extxyz") or fn.endswith(".xyz")
+        ]
     out: List[GraphData] = []
-    for fn in sorted(os.listdir(dirpath)):
-        if not (fn.endswith(".extxyz") or fn.endswith(".xyz")):
-            continue
-        for frame in iter_extxyz(os.path.join(dirpath, fn)):
+    for path in files:
+        for frame in iter_extxyz(path):
             if forces_norm_threshold is not None and "forces" in frame["arrays"]:
                 norms = np.linalg.norm(frame["arrays"]["forces"], axis=1)
                 if norms.size and norms.max() > forces_norm_threshold:

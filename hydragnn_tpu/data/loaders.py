@@ -27,6 +27,11 @@ class BatchLayout:
     head_dims: Tuple[int, ...]
     need_triplets: bool = False
     t_pad: int = 0
+    # dense neighbor-list aggregation (scatter-free message passing):
+    # fixed in/out-degree widths, computed over all splits
+    need_neighbors: bool = False
+    k_in: int = 0
+    k_out: int = 0
 
 
 def _sample_triplets(data: GraphData):
@@ -43,11 +48,22 @@ def _lcm(a, b):
     return a * b // math.gcd(a, b)
 
 
+def needs_dense_neighbors(arch_config: dict) -> bool:
+    """Single opt-in rule for dense scatter-free aggregation in the
+    BATCH-collate path: the config flag, except under graph partitioning —
+    there the partitioner builds per-shard lists itself
+    (``partition_graph(need_neighbors=True)``, wired by the driver)."""
+    return bool(arch_config.get("dense_aggregation")) and not arch_config.get(
+        "partition_axis"
+    )
+
+
 def compute_layout(
     datasets: List[List[GraphData]],
     batch_size: int,
     need_triplets: bool = False,
     device_multiple: Optional[int] = None,
+    need_neighbors: bool = False,
 ) -> BatchLayout:
     """``device_multiple``: every padded leading axis is made divisible by
     this (the data-parallel axis size) so sharded batches split evenly."""
@@ -62,6 +78,7 @@ def compute_layout(
     max_nodes = 1
     max_edges = 1
     max_trip = 0
+    k_in = k_out = 1
     first = None
     for ds in datasets:
         for d in ds:
@@ -70,6 +87,12 @@ def compute_layout(
             max_edges = max(max_edges, d.num_edges)
             if need_triplets:
                 max_trip = max(max_trip, _sample_triplets(d)[0].shape[0])
+            if need_neighbors and d.num_edges:
+                from hydragnn_tpu.ops.dense_agg import max_degree
+
+                ki, ko = max_degree(d.edge_index[0], d.edge_index[1])
+                k_in = max(k_in, ki)
+                k_out = max(k_out, ko)
     head_types = tuple(first.target_types)
     head_dims = tuple(
         t.shape[-1] if t.ndim > 1 else t.shape[0] for t in first.targets
@@ -93,6 +116,9 @@ def compute_layout(
         head_dims=head_dims,
         need_triplets=need_triplets,
         t_pad=t_pad,
+        need_neighbors=need_neighbors,
+        k_in=k_in,
+        k_out=k_out,
     )
 
 
@@ -106,37 +132,28 @@ def _collate_with_extras(samples, layout: BatchLayout):
         head_dims=layout.head_dims,
     )
     if layout.need_triplets:
-        t_pad = layout.t_pad
-        n_pad = layout.n_pad
-        ti = np.full((t_pad,), n_pad - 1, np.int32)
-        tj = np.full((t_pad,), n_pad - 1, np.int32)
-        tk = np.full((t_pad,), n_pad - 1, np.int32)
-        tkj = np.zeros((t_pad,), np.int32)
-        tji = np.zeros((t_pad,), np.int32)
-        tmask = np.zeros((t_pad,), bool)
-        off_n = off_e = off_t = 0
-        for s in samples:
-            a, b, c, kj, ji = _sample_triplets(s)
-            t = a.shape[0]
-            ti[off_t : off_t + t] = a + off_n
-            tj[off_t : off_t + t] = b + off_n
-            tk[off_t : off_t + t] = c + off_n
-            tkj[off_t : off_t + t] = kj + off_e
-            tji[off_t : off_t + t] = ji + off_e
-            tmask[off_t : off_t + t] = True
-            off_t += t
-            off_n += s.num_nodes
-            off_e += s.num_edges
+        from hydragnn_tpu.graph.batch import pack_triplets
+
+        trips = [
+            _sample_triplets(s) + (s.num_nodes, s.num_edges) for s in samples
+        ]
         batch = batch.replace(
-            extras={
-                "trip_i": ti,
-                "trip_j": tj,
-                "trip_k": tk,
-                "trip_kj": tkj,
-                "trip_ji": tji,
-                "trip_mask": tmask,
-            }
+            extras=pack_triplets(trips, layout.n_pad, layout.t_pad)
         )
+    if layout.need_neighbors:
+        from hydragnn_tpu.ops.dense_agg import build_neighbor_lists
+
+        nbr = build_neighbor_lists(
+            batch.senders,
+            batch.receivers,
+            batch.edge_mask,
+            layout.n_pad,
+            layout.k_in,
+            layout.k_out,
+        )
+        merged = dict(batch.extras or {})
+        merged.update(nbr)
+        batch = batch.replace(extras=merged)
     return batch
 
 
@@ -296,8 +313,14 @@ def create_dataloaders(
     testset,
     batch_size: int,
     need_triplets: bool = False,
+    need_neighbors: bool = False,
 ):
-    layout = compute_layout([trainset, valset, testset], batch_size, need_triplets)
+    layout = compute_layout(
+        [trainset, valset, testset],
+        batch_size,
+        need_triplets,
+        need_neighbors=need_neighbors,
+    )
     return (
         GraphLoader(trainset, batch_size, layout, shuffle=True),
         GraphLoader(valset, batch_size, layout, shuffle=True),
@@ -328,15 +351,16 @@ def dataset_loading_and_splitting(config: dict):
             )
         datasets[name] = loader.load_serialized_data(files_dir)
 
-    need_triplets = (
-        config["NeuralNetwork"]["Architecture"].get("model_type") == "DimeNet"
-    )
+    arch = config["NeuralNetwork"]["Architecture"]
+    need_triplets = arch.get("model_type") == "DimeNet"
+    need_neighbors = needs_dense_neighbors(arch)
     return create_dataloaders(
         datasets["train"],
         datasets["validate"],
         datasets["test"],
         batch_size=config["NeuralNetwork"]["Training"]["batch_size"],
         need_triplets=need_triplets,
+        need_neighbors=need_neighbors,
     )
 
 

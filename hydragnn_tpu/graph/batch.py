@@ -87,6 +87,49 @@ def pad_sizes_for(
     return n_pad, e_pad, g_pad
 
 
+def pack_triplets(triplets, n_pad: int, t_pad: Optional[int] = None):
+    """Pack per-sample DimeNet triplet tables into one padded extras dict.
+
+    ``triplets``: list of ``(t_i, t_j, t_k, t_kj, t_ji, n_nodes, n_edges)``
+    per sample, in batch order (node/edge offsets accumulate exactly as
+    ``collate_graphs`` lays the samples out). Padded triplet slots point at
+    the padding node ``n_pad - 1`` with mask False. ``t_pad`` defaults to
+    the total rounded up to 8. The ONE canonical packer — the loader, the
+    benches and the driver entry all route through here.
+    """
+    total = sum(t[0].shape[0] for t in triplets)
+    if t_pad is None:
+        t_pad = _round_up(max(total, 1), 8)
+    if total > t_pad:
+        raise ValueError(f"{total} triplets exceed t_pad={t_pad}")
+    ti = np.full((t_pad,), n_pad - 1, np.int32)
+    tj = np.full((t_pad,), n_pad - 1, np.int32)
+    tk = np.full((t_pad,), n_pad - 1, np.int32)
+    tkj = np.zeros((t_pad,), np.int32)
+    tji = np.zeros((t_pad,), np.int32)
+    tmask = np.zeros((t_pad,), bool)
+    off_n = off_e = off_t = 0
+    for a, b, c, kj, ji, n_nodes, n_edges in triplets:
+        t = a.shape[0]
+        ti[off_t : off_t + t] = a + off_n
+        tj[off_t : off_t + t] = b + off_n
+        tk[off_t : off_t + t] = c + off_n
+        tkj[off_t : off_t + t] = kj + off_e
+        tji[off_t : off_t + t] = ji + off_e
+        tmask[off_t : off_t + t] = True
+        off_t += t
+        off_n += int(n_nodes)
+        off_e += int(n_edges)
+    return {
+        "trip_i": ti,
+        "trip_j": tj,
+        "trip_k": tk,
+        "trip_kj": tkj,
+        "trip_ji": tji,
+        "trip_mask": tmask,
+    }
+
+
 def stack_batches(batches):
     """Stack K same-shape collated batches along a new leading axis.
 

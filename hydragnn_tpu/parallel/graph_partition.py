@@ -172,7 +172,7 @@ class PartitionInfo:
     """Static partition geometry + the inverse maps to un-partition outputs."""
 
     def __init__(self, num_parts, nl, el, halo, node_perm, part_of_node,
-                 local_of_node, n_real, halo_edges=0, tl=0):
+                 local_of_node, n_real, halo_edges=0, tl=0, k_in=0, k_out=0):
         self.num_parts = num_parts
         self.nl = nl  # local node budget (incl. 1 dummy row)
         self.el = el  # local edge budget
@@ -183,6 +183,8 @@ class PartitionInfo:
         self.n_real = n_real
         self.halo_edges = halo_edges  # per-peer EDGE halo budget (triplets)
         self.tl = tl  # local triplet budget
+        self.k_in = k_in  # dense neighbor-list widths (0 = lists not built)
+        self.k_out = k_out
 
     @property
     def budgets(self) -> dict:
@@ -192,6 +194,8 @@ class PartitionInfo:
             "halo": self.halo,
             "halo_edges": self.halo_edges,
             "tl": self.tl,
+            "k_in": self.k_in,
+            "k_out": self.k_out,
         }
 
     def gather_nodes(self, per_part_rows: np.ndarray) -> np.ndarray:
@@ -211,6 +215,7 @@ def partition_graph(
     edge_multiple: int = 8,
     halo_multiple: int = 8,
     need_triplets: bool = False,
+    need_neighbors: bool = False,
     budgets: Optional[dict] = None,
 ) -> Tuple[GraphBatch, PartitionInfo]:
     """Split one giant graph into ``num_parts`` static-shape shards.
@@ -396,6 +401,38 @@ def partition_graph(
             "halo_send_edges": edge_halo.send.reshape(P * P, edge_halo.h),
         }
 
+    # ---- dense neighbor lists (scatter-free aggregation) -----------------
+    # Built against each shard's EXTENDED node table (local rows + halo
+    # region), so the conv's dense path gathers halo senders exactly like
+    # the segment path does; gradients reach halo rows through the custom
+    # VJP's reverse lists and flow back to owners via halo_extend's AD.
+    nbr_extras = {}
+    if need_neighbors:
+        from hydragnn_tpu.ops.dense_agg import (
+            build_neighbor_lists,
+            max_degree,
+        )
+
+        ext_n = nl + P * halo
+        k_in = budgets.get("k_in", 1)
+        k_out = budgets.get("k_out", 1)
+        for p in range(P):
+            ki, ko = max_degree(senders[p], receivers[p], edge_mask[p])
+            k_in, k_out = max(k_in, ki), max(k_out, ko)
+        stacked = None
+        for p in range(P):
+            lists = build_neighbor_lists(
+                senders[p], receivers[p], edge_mask[p], ext_n, k_in, k_out
+            )
+            if stacked is None:
+                stacked = {
+                    k: np.zeros((P,) + v.shape, v.dtype)
+                    for k, v in lists.items()
+                }
+            for k, v in lists.items():
+                stacked[k][p] = v
+        nbr_extras = stacked
+
     # ---- targets ---------------------------------------------------------
     targets = []
     for ih, (t, d) in enumerate(zip(head_types, head_dims)):
@@ -436,12 +473,15 @@ def partition_graph(
                 k: (v if k == "halo_send_edges" else flat(v))
                 for k, v in trip_extras.items()
             },
+            **{k: flat(v) for k, v in nbr_extras.items()},
         },
     )
     info = PartitionInfo(
         P, nl, el, halo, perm, part_of_node, local_of_node, n,
         halo_edges=edge_halo.h if edge_halo is not None else 0,
         tl=trip_extras["trip_i"].shape[1] if trip_extras else 0,
+        k_in=nbr_extras["nbr_idx"].shape[2] if nbr_extras else 0,
+        k_out=nbr_extras["rev_idx"].shape[2] if nbr_extras else 0,
     )
     return batch, info
 

@@ -162,13 +162,79 @@ class Visualizer:
         ax.callbacks.connect("ylim_changed", callback)
         return ax
 
+    def _analysis_column(self, axcol, t, p, title, weight=1.0, density=True):
+        """One (scatter+contour, conditional-mean, error-PDF) column — the
+        repeated unit of the reference's analysis grids
+        (``visualizer.py:134-279``)."""
+        ax = axcol[0]
+        if t.size:
+            ax.scatter(t, p, s=4, alpha=0.35, edgecolor="b", facecolor="none")
+            if density and t.size > 10 and np.ptp(t) > 0 and np.ptp(p) > 0:
+                xc, yc, H = self._hist2d_contour(t, p)
+                ax.contour(xc, yc, np.log1p(H), levels=8, linewidths=0.7)
+            self.add_identity(ax, "r--", linewidth=1)
+        ax.set_title(f"{title}, number of samples = {t.size}")
+        ax.set_xlabel("True")
+        ax.set_ylabel("Predicted")
+        ax = axcol[1]
+        centers, cm = self._err_condmean(t, (p - t) * weight)
+        ax.plot(centers, cm, "ro")
+        ax.set_title("Conditional mean abs. error")
+        ax.set_xlabel("True")
+        ax.set_ylabel("abs. error")
+        ax = axcol[2]
+        if t.size:
+            hist1d, edges = np.histogram(p - t, bins=40, density=True)
+            ax.plot(0.5 * (edges[:-1] + edges[1:]), hist1d, "ro")
+        ax.set_title(f"{title}: error PDF")
+        ax.set_xlabel("Error")
+        ax.set_ylabel("PDF")
+
     def create_plot_global_analysis(
         self, true_values, predicted_values, output_names=None
     ):
-        """Per-head analysis grid: parity density contour, |error|
-        conditional mean, and error histogram
-        (``visualizer.py:134-279``)."""
+        """Per-head analysis figure, reference-density
+        (``visualizer.py:134-279``): scalar heads get the 1x3-column
+        (parity scatter + density contour, conditional mean |error|,
+        error PDF); vector heads get the full 3x3 grid analysing vector
+        LENGTH, component SUM, and raw COMPONENTS each through that same
+        column. One file per head (``<name>_scatter_condm_err.png``),
+        plus the combined cross-head overview."""
         n = len(true_values)
+        for ihead in range(n):
+            name = (
+                output_names[ihead]
+                if output_names and ihead < len(output_names)
+                else f"head{ihead}"
+            )
+            d = self.head_dims[ihead] if ihead < len(self.head_dims) else 1
+            t = np.asarray(true_values[ihead])
+            p = np.asarray(predicted_values[ihead])
+            if d <= 1:
+                t, p = t.reshape(-1), p.reshape(-1)
+                fig, axs = plt.subplots(3, 1, figsize=(5.5, 13))
+                self._analysis_column(axs, t, p, "Scalar output")
+            else:
+                t, p = t.reshape(-1, d), p.reshape(-1, d)
+                fig, axs = plt.subplots(3, 3, figsize=(18, 16))
+                vlen_t = np.linalg.norm(t, axis=1)
+                vlen_p = np.linalg.norm(p, axis=1)
+                self._analysis_column(
+                    axs[:, 0], vlen_t, vlen_p, "Vector output: length",
+                    weight=1.0 / np.sqrt(d),
+                )
+                self._analysis_column(
+                    axs[:, 1], t.sum(1), p.sum(1), "Vector output: sum",
+                    weight=1.0 / d,
+                )
+                self._analysis_column(
+                    axs[:, 2], t.reshape(-1), p.reshape(-1),
+                    "Vector output: components",
+                )
+            fig.tight_layout()
+            self._save(fig, f"{name}_scatter_condm_err.png")
+
+        # combined cross-head overview (one column per head)
         fig, axes = plt.subplots(3, n, figsize=(5 * n, 12), squeeze=False)
         for ihead in range(n):
             t = np.asarray(true_values[ihead]).reshape(-1)
@@ -178,20 +244,8 @@ class Visualizer:
                 if output_names and ihead < len(output_names)
                 else f"head{ihead}"
             )
-            ax = axes[0][ihead]
-            if t.size:
-                xc, yc, H = self._hist2d_contour(t, p)
-                ax.contourf(xc, yc, np.log1p(H), levels=12)
-                self.add_identity(ax, "r--", linewidth=1)
-            ax.set_title(f"{name} parity density")
-            ax = axes[1][ihead]
-            centers, cm = self._err_condmean(t, p - t)
-            ax.plot(centers, cm)
-            ax.set_xlabel(f"true {name}")
-            ax.set_ylabel("mean |error|")
-            ax = axes[2][ihead]
-            ax.hist(p - t, bins=40)
-            ax.set_xlabel(f"error {name}")
+            self._analysis_column(axes[:, ihead], t, p, name)
+        fig.tight_layout()
         self._save(fig, "global_analysis.png")
 
     def create_parity_plot_vector(

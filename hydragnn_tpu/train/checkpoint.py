@@ -8,15 +8,24 @@ leaves are gathered to host before writing — the single-file contract is kept
 even with ZeRO-style sharded optimizer state (reference consolidates via
 ``consolidate_state_dict``; here ``jax.device_get`` does the same job).
 
-Format: flax msgpack (framework-neutral, no pickle of code objects).
+Format: an 8-byte magic+version header and a CRC32 of the payload, then
+flax msgpack (framework-neutral, no pickle of code objects). Writes are
+atomic (tmp + rename) so a killed job can't leave a truncated checkpoint
+that parses; loads verify the checksum and fail loudly on corruption.
+Legacy headerless files from earlier rounds still load.
 """
 
+import binascii
 import os
+import struct
 from typing import Any, Dict
 
 import jax
 import numpy as np
 from flax import serialization
+
+_MAGIC = b"HGTPCKPT"  # 8 bytes; last byte bumps with the format
+_VERSION = 1
 
 
 def _consolidate(leaf):
@@ -71,14 +80,38 @@ def save_model(state_or_dict, name: str, path: str = "./logs/"):
     blob = serialization.msgpack_serialize(
         jax.tree_util.tree_map(np.asarray, sd)
     )
-    with open(os.path.join(out_dir, name + ".pk"), "wb") as f:
-        f.write(blob)
+    header = _MAGIC + struct.pack(
+        "<II", _VERSION, binascii.crc32(blob) & 0xFFFFFFFF
+    )
+    final = os.path.join(out_dir, name + ".pk")
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(header + blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)  # atomic: never a half-written checkpoint
 
 
 def load_state_dict(name: str, path: str = "./logs/") -> Dict[str, Any]:
     fname = os.path.join(path, name, name + ".pk")
     with open(fname, "rb") as f:
-        return serialization.msgpack_restore(f.read())
+        raw = f.read()
+    if raw[: len(_MAGIC)] == _MAGIC:
+        version, crc = struct.unpack_from("<II", raw, len(_MAGIC))
+        if version > _VERSION:
+            raise ValueError(
+                f"checkpoint {fname} has format version {version}; this "
+                f"build reads up to {_VERSION}"
+            )
+        blob = raw[len(_MAGIC) + 8 :]
+        if (binascii.crc32(blob) & 0xFFFFFFFF) != crc:
+            raise ValueError(
+                f"checkpoint {fname} is corrupt (CRC mismatch) — refusing "
+                "to restore silently bad weights"
+            )
+    else:
+        blob = raw  # legacy headerless msgpack from earlier rounds
+    return serialization.msgpack_restore(blob)
 
 
 def restore_into(template, restored):
@@ -86,6 +119,21 @@ def restore_into(template, restored):
     onto the raw msgpack dict — the analog of the reference's DDP "module."
     prefix fixup on old checkpoints (``model.py:109-114``)."""
     return serialization.from_state_dict(template, restored)
+
+
+def restore_params_only(state, restored: Dict[str, Any]):
+    """Cross-config resume: restore model params + batch stats from a
+    checkpoint while keeping the fresh optimizer state — the supported
+    path when the training config changed between save and resume (new
+    optimizer/schedule; the reference reloads ``model_state_dict`` the
+    same way and rebuilds the optimizer, ``model.py:98-119``). Model
+    architecture must still match; a changed architecture fails loudly in
+    ``from_state_dict``."""
+    new_params = serialization.from_state_dict(state.params, restored["params"])
+    new_stats = serialization.from_state_dict(
+        state.batch_stats, restored.get("batch_stats", state.batch_stats)
+    )
+    return state.replace(params=new_params, batch_stats=new_stats)
 
 
 def checkpoint_exists(name: str, path: str = "./logs/") -> bool:

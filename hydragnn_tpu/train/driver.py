@@ -116,6 +116,7 @@ def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
     head_types = tuple(arch["output_type"])
     head_dims = tuple(arch["output_dim"])
     need_triplets = arch["model_type"] == "DimeNet"
+    need_neighbors = bool(arch.get("dense_aggregation"))
     n_dev = len(jax.devices())
     # ONE budget union across splits -> one compiled executable for all
     budgets = scan_budgets(
@@ -124,6 +125,7 @@ def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
         head_types,
         head_dims,
         need_triplets,
+        need_neighbors,
     )
     out = []
     for loader, shuffle in (
@@ -138,6 +140,7 @@ def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
                 head_types,
                 head_dims,
                 need_triplets=need_triplets,
+                need_neighbors=need_neighbors,
                 shuffle=shuffle,
                 axis=arch["partition_axis"],
                 budgets=budgets,

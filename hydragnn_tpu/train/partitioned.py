@@ -22,7 +22,8 @@ from hydragnn_tpu.train.trainer import TrainState, _nbatch
 from hydragnn_tpu.utils import tracer as tr
 
 
-def scan_budgets(datasets, num_parts, head_types, head_dims, need_triplets=False):
+def scan_budgets(datasets, num_parts, head_types, head_dims, need_triplets=False,
+                 need_neighbors=False):
     """Union of the natural partition budgets over several datasets — pass
     the result to every split's ``PartitionedLoader`` so train/val/test
     share ONE compiled step/eval executable."""
@@ -33,7 +34,7 @@ def scan_budgets(datasets, num_parts, head_types, head_dims, need_triplets=False
         for s in ds:
             _, info = partition_graph(
                 s, num_parts, tuple(head_types), tuple(head_dims),
-                need_triplets=need_triplets,
+                need_triplets=need_triplets, need_neighbors=need_neighbors,
             )
             for k, v in info.budgets.items():
                 budgets[k] = max(budgets.get(k, 0), v)
@@ -53,6 +54,7 @@ class PartitionedLoader:
         head_types,
         head_dims,
         need_triplets: bool = False,
+        need_neighbors: bool = False,
         shuffle: bool = True,
         seed: int = 42,
         axis: str = "graph",
@@ -72,14 +74,15 @@ class PartitionedLoader:
         if budgets is None:
             budgets = scan_budgets(
                 [dataset], num_parts, self.head_types, self.head_dims,
-                need_triplets,
+                need_triplets, need_neighbors,
             )
         self._batches = []
         self.infos = []
         for s in dataset:
             b, info = partition_graph(
                 s, num_parts, self.head_types, self.head_dims,
-                need_triplets=need_triplets, budgets=budgets,
+                need_triplets=need_triplets, need_neighbors=need_neighbors,
+                budgets=budgets,
             )
             self._batches.append(b)
             self.infos.append(info)

@@ -25,3 +25,11 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# persistent XLA compile cache: recompiles of the jitted train/eval
+# programs dominate CI wall-clock on this 1-core host; with the cache warm
+# the full default suite drops by minutes (driver paths already enable it,
+# this covers direct-Trainer unit tests too)
+from hydragnn_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()

@@ -1,0 +1,148 @@
+"""Dense neighbor-list aggregation: numerical parity with the segment
+path (forward AND gradients — the custom VJP routes the backward pass
+through reverse neighbor lists) plus host-side list construction."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.graph import collate_graphs, pad_sizes_for
+from hydragnn_tpu.models import create_model_config, init_model_params
+from hydragnn_tpu.ops.dense_agg import (
+    build_neighbor_lists,
+    dense_minmax,
+    dense_moments,
+    dense_sum,
+    gather_neighbors,
+    max_degree,
+)
+
+from test_models_forward import arch_config, make_batch
+
+
+from hydragnn_tpu.ops.dense_agg import attach_neighbor_lists as _with_neighbors
+
+
+def pytest_neighbor_list_construction():
+    senders = np.array([0, 2, 1, 0, 3])
+    receivers = np.array([1, 1, 0, 3, 3])
+    mask = np.array([True, True, True, True, False])  # last edge is padding
+    k_in, k_out = max_degree(senders, receivers, mask)
+    assert (k_in, k_out) == (2, 2)
+    ex = build_neighbor_lists(senders, receivers, mask, 4, k_in, k_out)
+    # node 1 receives from 0 and 2, in edge order
+    assert ex["nbr_idx"][1].tolist() == [0, 2]
+    assert ex["nbr_mask"][1].tolist() == [True, True]
+    assert ex["nbr_edge"][1].tolist() == [0, 1]
+    # node 2 receives nothing
+    assert ex["nbr_mask"][2].tolist() == [False, False]
+    # padding edge 4 excluded: node 3 receives only edge 3 (from node 0)
+    assert ex["nbr_mask"][3].tolist() == [True, False]
+    assert ex["nbr_idx"][3, 0] == 0
+    # reverse list: node 0 sends edges 0 (slot 0 of node 1) and 3 (slot 0
+    # of node 3) -> flat positions 1*2+0 and 3*2+0
+    assert sorted(ex["rev_idx"][0][ex["rev_mask"][0]].tolist()) == [2, 6]
+
+
+def pytest_gather_neighbors_vjp_matches_autodiff():
+    """The reverse-list backward equals the scatter-add the plain gather
+    would produce."""
+    rng = np.random.default_rng(0)
+    n, d = 40, 8
+    senders = rng.integers(0, n, 160)
+    receivers = rng.integers(0, n, 160)
+    mask = np.ones(160, bool)
+    k_in, k_out = max_degree(senders, receivers, mask)
+    ex = build_neighbor_lists(senders, receivers, mask, n, k_in, k_out)
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    nbr = jnp.asarray(ex["nbr_idx"])
+    nmask = jnp.asarray(ex["nbr_mask"])
+    rev = jnp.asarray(ex["rev_idx"])
+    rmask = jnp.asarray(ex["rev_mask"])
+
+    def f_custom(x):
+        g = gather_neighbors(x, nbr, rev, rmask)
+        return (jnp.where(nmask[..., None], g, 0.0) ** 2).sum()
+
+    def f_plain(x):
+        g = x[nbr]
+        return (jnp.where(nmask[..., None], g, 0.0) ** 2).sum()
+
+    g_custom = jax.grad(f_custom)(x)
+    g_plain = jax.grad(f_plain)(x)
+    np.testing.assert_allclose(
+        np.asarray(g_custom), np.asarray(g_plain), rtol=1e-5, atol=1e-5
+    )
+
+
+def pytest_dense_reductions_match_segment():
+    rng = np.random.default_rng(1)
+    n, e, d = 30, 120, 16
+    senders = rng.integers(0, n, e)
+    receivers = rng.integers(0, n - 5, e)  # leave some empty receivers
+    mask = rng.random(e) < 0.8
+    # the collate contract: padding edges target the padding node slot, so
+    # their zeroed data never reaches a real receiver's min/max
+    senders[~mask] = n - 1
+    receivers[~mask] = n - 1
+    k_in, k_out = max_degree(senders, receivers, mask)
+    ex = build_neighbor_lists(senders, receivers, mask, n, k_in, k_out)
+    h_edges = rng.standard_normal((e, d)).astype(np.float32)
+
+    from hydragnn_tpu.graph import segment_minmax_fused, segment_moments_fused
+
+    hm = jnp.where(jnp.asarray(mask)[:, None], jnp.asarray(h_edges), 0.0)
+    s, cnt, sq = segment_moments_fused(
+        hm, jnp.asarray(receivers), n, weights=jnp.asarray(mask)
+    )
+    deg_ref = jnp.maximum(cnt, 1.0)
+    mean_ref = s / deg_ref
+    std_ref = jnp.sqrt(jnp.maximum(sq / deg_ref - mean_ref**2, 0.0) + 1e-5)
+    mn_ref, mx_ref = segment_minmax_fused(
+        hm, jnp.asarray(receivers), n, has=cnt > 0
+    )
+
+    # dense path: messages arranged [N, K, D] via nbr_edge
+    h_dense = jnp.asarray(h_edges)[jnp.asarray(ex["nbr_edge"])]
+    nmask = jnp.asarray(ex["nbr_mask"])
+    mean_d, std_d, deg_d, has_d = dense_moments(h_dense, nmask)
+    mn_d, mx_d = dense_minmax(h_dense, nmask, has_d)
+
+    np.testing.assert_allclose(mean_d, mean_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(std_d, std_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mn_d, mn_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mx_d, mx_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        dense_sum(h_dense, nmask), s, rtol=1e-5, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("with_edges", [False, True])
+def pytest_pna_dense_path_parity(with_edges):
+    """Full PNAStack: identical outputs and parameter gradients through the
+    dense and segment paths."""
+    batch = make_batch()
+    if with_edges:
+        cfg = arch_config("PNA")
+        cfg["edge_dim"] = 1
+    else:
+        cfg = arch_config("PNA")
+    model = create_model_config(cfg)
+    params = init_model_params(model, batch)
+    dense_batch = _with_neighbors(batch)
+
+    def loss(p, b):
+        outputs = model.apply(p, b, train=False)
+        return sum(jnp.sum(o**2) for o in outputs)
+
+    l_seg, g_seg = jax.value_and_grad(loss)(params, batch)
+    l_den, g_den = jax.value_and_grad(loss)(params, dense_batch)
+    np.testing.assert_allclose(float(l_seg), float(l_den), rtol=1e-4)
+    flat_seg = jax.tree_util.tree_leaves(g_seg)
+    flat_den = jax.tree_util.tree_leaves(g_den)
+    for a, b in zip(flat_seg, flat_den):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )

@@ -67,14 +67,17 @@ def unittest_train_model(
 
         perc_train = config["NeuralNetwork"]["Training"]["perc_train"]
         for name, rel in config["Dataset"]["path"].items():
-            data_path = os.path.join(workdir, rel)
-            config["Dataset"]["path"][name] = data_path
             if name == "total":
                 num = num_samples_tot
             elif name == "train":
                 num = int(num_samples_tot * perc_train)
             else:
                 num = int(num_samples_tot * (1 - perc_train) * 0.5)
+            # key the cached dataset dir by its size: tests with different
+            # num_samples_tot must not silently share (and therefore train
+            # on whichever size generated first)
+            data_path = os.path.join(workdir, f"{rel}_{num}")
+            config["Dataset"]["path"][name] = data_path
             if not os.path.exists(data_path) or not os.listdir(data_path):
                 deterministic_graph_data(data_path, number_configurations=num)
 
@@ -174,6 +177,24 @@ def pytest_train_model_multistep_dispatch(model_type):
         overwrite_config={
             "NeuralNetwork": {"Training": {"steps_per_dispatch": 4}}
         },
+        num_samples_tot=300,
+    )
+
+
+@pytest.mark.parametrize("model_type", ["PNA"])
+def pytest_train_model_dense_aggregation(model_type):
+    """Scatter-free dense neighbor-list aggregation (dense_aggregation:
+    true) through the public API must hit the same accuracy ceilings as
+    the segment path — it is the performance mode for MXU-scale configs
+    (ops/dense_agg.py)."""
+    unittest_train_model(
+        model_type,
+        "ci.json",
+        False,
+        overwrite_config={
+            "NeuralNetwork": {"Architecture": {"dense_aggregation": True}}
+        },
+        num_samples_tot=300,
     )
 
 
@@ -189,6 +210,7 @@ def pytest_train_model_nll_loss(model_type):
         overwrite_config={
             "NeuralNetwork": {"Architecture": {"ilossweights_nll": 1}}
         },
+        num_samples_tot=300,
     )
 
 
@@ -208,4 +230,5 @@ def pytest_train_model_whole_training_dispatch(model_type):
                 }
             }
         },
+        num_samples_tot=300,
     )

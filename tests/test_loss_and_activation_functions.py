@@ -3,6 +3,8 @@ training steps without error (reference
 ``tests/test_loss_and_activation_functions.py`` — 'does not assert
 anything' beyond completing)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -26,11 +28,24 @@ ACTIVATIONS = [
 ]
 
 
-@pytest.mark.parametrize("loss_name", LOSSES)
-@pytest.mark.parametrize("activation", ACTIVATIONS)
+# Default CI covers every loss (with one activation) and every activation
+# (with one loss) — 11 compiles instead of the 28-combo cross product;
+# HYDRAGNN_FULL_TEST=1 restores the full matrix. SAGE backbone: the
+# simplest conv, so each combo's (cached) compile is cheapest — the combo
+# under test is the loss/activation plumbing, not the conv.
+FULL = int(os.getenv("HYDRAGNN_FULL_TEST", "0")) == 1
+if FULL:
+    COMBOS = [(l, a) for l in LOSSES for a in ACTIVATIONS]
+else:
+    COMBOS = [(l, "relu") for l in LOSSES] + [
+        ("mse", a) for a in ACTIVATIONS if a != "relu"
+    ]
+
+
+@pytest.mark.parametrize("loss_name,activation", COMBOS)
 def pytest_loss_activation(loss_name, activation):
     batch = make_batch()
-    cfg = arch_config("PNA")
+    cfg = arch_config("SAGE")
     cfg["activation_function"] = activation
     cfg["loss_function_type"] = loss_name
     model = create_model_config(cfg)

@@ -49,3 +49,83 @@ def pytest_checkpoint_roundtrip():
     for a, b in zip(ref["outputs"], out["outputs"]):
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
     assert np.allclose(float(ref["loss"]), float(out["loss"]), atol=1e-7)
+
+
+def pytest_checkpoint_integrity_and_versioning():
+    """Hardened format: corruption is detected (CRC), future versions are
+    refused, legacy headerless blobs still load."""
+    import pytest as _pytest
+
+    from hydragnn_tpu.train import checkpoint as ck
+
+    batch = make_batch()
+    model = create_model_config(arch_config("PNA"))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    state = trainer.init_state(batch)
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(state, "ck", path=tmp)
+        fname = os.path.join(tmp, "ck", "ck.pk")
+        raw = open(fname, "rb").read()
+        assert raw[:8] == ck._MAGIC
+        # no stray tmp file left behind by the atomic write
+        assert not os.path.exists(fname + ".tmp")
+
+        # flip one payload byte -> CRC mismatch
+        bad = bytearray(raw)
+        bad[len(raw) // 2] ^= 0xFF
+        open(fname, "wb").write(bytes(bad))
+        with _pytest.raises(ValueError, match="corrupt"):
+            load_state_dict("ck", path=tmp)
+
+        # future version -> refused with a clear message
+        import struct as _struct
+
+        fut = ck._MAGIC + _struct.pack("<II", 99, 0) + raw[16:]
+        open(fname, "wb").write(fut)
+        with _pytest.raises(ValueError, match="version"):
+            load_state_dict("ck", path=tmp)
+
+        # legacy headerless msgpack still loads
+        open(fname, "wb").write(raw[16:])
+        legacy = load_state_dict("ck", path=tmp)
+        assert "params" in legacy
+
+
+def pytest_checkpoint_restore_across_config_change():
+    """Resume after the TRAINING config changed: params/batch-stats restore,
+    optimizer state is rebuilt fresh (reference reloads model_state_dict and
+    reconstructs the optimizer the same way)."""
+    from hydragnn_tpu.train.checkpoint import restore_params_only
+
+    batch = make_batch()
+    model = create_model_config(arch_config("PNA"))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    state = trainer.init_state(batch)
+    rng = jax.random.PRNGKey(0)
+    for _ in range(2):
+        rng, sub = jax.random.split(rng)
+        state, _ = trainer._train_step(state, trainer.put_batch(batch), sub)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(state, "xcfg", path=tmp)
+        # resume with a DIFFERENT optimizer (SGD): opt_state trees differ,
+        # restore_into would fail — restore_params_only is the resume path
+        trainer2 = Trainer(
+            model, {"Optimizer": {"type": "SGD", "learning_rate": 1e-2}}
+        )
+        state2 = trainer2.init_state(batch)
+        state2 = restore_params_only(state2, load_state_dict("xcfg", path=tmp))
+
+    dev_batch = trainer.put_batch(batch)
+    ref = trainer._eval_step(state.params, state.batch_stats, dev_batch)
+    out = trainer2._eval_step(state2.params, state2.batch_stats, dev_batch)
+    for a, b in zip(ref["outputs"], out["outputs"]):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # and training continues under the new optimizer
+    rng, sub = jax.random.split(rng)
+    state2, metrics = trainer2._train_step(state2, dev_batch, sub)
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
