@@ -40,12 +40,17 @@ _PEAK_TFLOPS = {
 _DEFAULT_PEAK = 197.0
 
 
+_FALSY = ("0", "false", "no", "off")
+
+
 def _arg(flag, default=None):
     for a in sys.argv[1:]:
         if a == f"--{flag}":
             return True
         if a.startswith(f"--{flag}="):
-            return a.split("=", 1)[1]
+            v = a.split("=", 1)[1]
+            # boolean spellings: --dense=0 / --bf16=false mean OFF
+            return False if v.lower() in _FALSY else v
     return default
 
 

@@ -87,6 +87,17 @@ def apply_cli_overrides(config: dict) -> dict:
     return config
 
 
+def example_flag(flag: str) -> bool:
+    """Boolean flag reader: bare ``--foo`` or truthy value is True;
+    ``--foo=0`` / ``--foo=false`` is explicitly False."""
+    v = example_arg(flag)
+    if v is None:
+        return False
+    if v is True:
+        return True
+    return str(v).lower() not in ("0", "false", "no", "off")
+
+
 def example_arg(flag: str, default=None):
     """Tiny argv reader: ``--key=value``, ``--key value``, or bare ``--key``
     (boolean). Examples use a handful of flags; both spellings work."""

@@ -144,8 +144,10 @@ def main():
     }
 
     # --dense: scatter-free neighbor-list aggregation inside each shard
-    # (ops/dense_agg.py; 1.7-2.9x faster at this scale on v5e)
-    dense = bool(example_arg("dense"))
+    # (ops/dense_agg.py; 1.7-3.3x faster at this scale on v5e)
+    from common import example_flag
+
+    dense = example_flag("dense")
 
     t0 = time.time()
     pbatch, info = partition_graph(

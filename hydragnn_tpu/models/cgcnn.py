@@ -23,15 +23,30 @@ class CGConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
-        parts = [x[batch.receivers], x[batch.senders]]
-        if self.edge_dim and self.edge_dim > 0:
-            parts.append(batch.edge_attr)
+        extras = batch.extras or {}
+        dense = "nbr_idx" in extras
+        if dense:  # dense scatter-free path (ops/dense_agg.py)
+            from hydragnn_tpu.ops.dense_agg import dense_sum, gather_neighbors
+
+            x_j = gather_neighbors(
+                x, extras["nbr_idx"], extras["rev_idx"], extras["rev_mask"]
+            )
+            parts = [jnp.broadcast_to(x[:, None, :], x_j.shape), x_j]
+            if self.edge_dim and self.edge_dim > 0:
+                parts.append(batch.edge_attr[extras["nbr_edge"]])
+        else:
+            parts = [x[batch.receivers], x[batch.senders]]
+            if self.edge_dim and self.edge_dim > 0:
+                parts.append(batch.edge_attr)
         z = jnp.concatenate(parts, axis=-1)
         gate = jax.nn.sigmoid(TorchLinear(self.channels, name="lin_f")(z))
         core = jax.nn.softplus(TorchLinear(self.channels, name="lin_s")(z))
         msg = gate * core
-        msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
-        out = x + segment_sum(msg, batch.receivers, x.shape[0])
+        if dense:
+            out = x + dense_sum(msg, extras["nbr_mask"])
+        else:
+            msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
+            out = x + segment_sum(msg, batch.receivers, x.shape[0])
         return out, pos
 
 

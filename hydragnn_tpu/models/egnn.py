@@ -17,6 +17,17 @@ from hydragnn_tpu.models.base import HydraBase
 from hydragnn_tpu.models.common import TorchLinear
 
 
+def _safe_sqrt(x):
+    """sqrt with a finite gradient at 0. Degenerate zero-distance pairs
+    (padding edges; dense-layout fill slots) sit exactly at radial=0, where
+    sqrt's inf derivative turns a zero cotangent into NaN (0*inf) once pos
+    is parameter-dependent (equivariant layers >= 2). Double-where keeps
+    real-edge values and gradients bit-identical and kills the NaN."""
+    nonzero = x > 0
+    safe = jnp.where(nonzero, x, 1.0)
+    return jnp.where(nonzero, jnp.sqrt(safe), 0.0)
+
+
 class E_GCL(nn.Module):
     in_dim: int
     out_dim: int
@@ -36,23 +47,66 @@ class E_GCL(nn.Module):
             out = halo_reduce(out, batch.extras["halo_send"], self.partition_axis)
         return out
 
+    def _sender_sum_dense(self, data, extras, batch):
+        """Dense-frame sender aggregation: reverse-list sum
+        (ops/dense_agg.py), plus the partition halo fold."""
+        from hydragnn_tpu.ops.dense_agg import aggregate_to_senders
+
+        out = aggregate_to_senders(
+            data,
+            extras["nbr_idx"],
+            extras["nbr_mask"],
+            extras["rev_idx"],
+            extras["rev_mask"],
+        )
+        if self.partition_axis is not None:
+            from hydragnn_tpu.parallel.graph_partition import halo_reduce
+
+            out = halo_reduce(out, batch.extras["halo_send"], self.partition_axis)
+        return out
+
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
         n = x.shape[0]
         row, col = batch.senders, batch.receivers
+        extras = batch.extras or {}
+        dense = "nbr_idx" in extras
+        if dense:
+            # dense scatter-free frame: per-edge values live as [N, K, *]
+            # keyed by (receiver, slot); j = sender, i = receiver
+            from hydragnn_tpu.ops.dense_agg import gather_neighbors
 
-        coord_diff = pos[row] - pos[col]
-        radial = (coord_diff * coord_diff).sum(-1, keepdims=True)
-        norm = jnp.sqrt(radial) + 1.0  # norm_diff=True
-        coord_diff = coord_diff / norm
-
-        parts = [x[row], x[col], radial]
-        if self.edge_attr_dim > 0:
-            parts.append(batch.edge_attr)
+            nmask = extras["nbr_mask"]
+            emask_nd = nmask[..., None]
+            # ONE fused gather for features+positions (halves the gather /
+            # reverse-gather traffic — the dominant dense-mode cost here)
+            both_j = gather_neighbors(
+                jnp.concatenate([x, pos], axis=-1),
+                extras["nbr_idx"],
+                extras["rev_idx"],
+                extras["rev_mask"],
+            )
+            x_j, pos_j = both_j[..., : x.shape[-1]], both_j[..., x.shape[-1] :]
+            coord_diff = pos_j - pos[:, None, :]
+            radial = (coord_diff * coord_diff).sum(-1, keepdims=True)
+            norm = _safe_sqrt(radial) + 1.0  # norm_diff=True
+            coord_diff = coord_diff / norm
+            parts = [x_j, jnp.broadcast_to(x[:, None, :], x_j.shape), radial]
+            if self.edge_attr_dim > 0:
+                parts.append(batch.edge_attr[extras["nbr_edge"]])
+        else:
+            emask_nd = batch.edge_mask[:, None]
+            coord_diff = pos[row] - pos[col]
+            radial = (coord_diff * coord_diff).sum(-1, keepdims=True)
+            norm = _safe_sqrt(radial) + 1.0  # norm_diff=True
+            coord_diff = coord_diff / norm
+            parts = [x[row], x[col], radial]
+            if self.edge_attr_dim > 0:
+                parts.append(batch.edge_attr)
         e = jnp.concatenate(parts, axis=-1)
         e = jax.nn.relu(TorchLinear(self.hidden_dim, name="edge_mlp_0")(e))
         e = jax.nn.relu(TorchLinear(self.hidden_dim, name="edge_mlp_1")(e))
-        e = jnp.where(batch.edge_mask[:, None], e, 0.0)
+        e = jnp.where(emask_nd, e, 0.0)
 
         if self.equivariant:
             cw = jax.nn.relu(TorchLinear(self.hidden_dim, name="coord_mlp_0")(e))
@@ -62,18 +116,17 @@ class E_GCL(nn.Module):
             cw = cw @ self.param("coord_mlp_1", small, (self.hidden_dim, 1))
             cw = jnp.tanh(cw)  # tanh=True bounds the update
             trans = jnp.clip(coord_diff * cw, -100.0, 100.0)
-            trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
+            trans = jnp.where(emask_nd, trans, 0.0)
             # the coord update (trans + count) and the node-model message
             # aggregation all land at the SAME sender index — ONE packed
-            # scatter (and one halo_reduce) instead of two
-            both = self._sender_sum(
-                jnp.concatenate(
-                    [e, trans, batch.edge_mask.astype(trans.dtype)[:, None]],
-                    -1,
-                ),
-                row,
-                n,
-                batch,
+            # pass (and one halo_reduce) instead of two
+            packed = jnp.concatenate(
+                [e, trans, emask_nd.astype(trans.dtype)], -1
+            )
+            both = (
+                self._sender_sum_dense(packed, extras, batch)
+                if dense
+                else self._sender_sum(packed, row, n, batch)
             )
             agg = both[:, : self.hidden_dim]
             coord_agg = both[:, self.hidden_dim : self.hidden_dim + 3]
@@ -81,7 +134,11 @@ class E_GCL(nn.Module):
             pos = pos + coord_agg / jnp.maximum(cnt, 1.0)[:, None]
         else:
             # node model: aggregate edge features at the sender index (row)
-            agg = self._sender_sum(e, row, n, batch)
+            agg = (
+                self._sender_sum_dense(e, extras, batch)
+                if dense
+                else self._sender_sum(e, row, n, batch)
+            )
         h = jnp.concatenate([x, agg], axis=-1)
         h = jax.nn.relu(TorchLinear(self.hidden_dim, name="node_mlp_0")(h))
         h = TorchLinear(self.out_dim, name="node_mlp_1")(h)

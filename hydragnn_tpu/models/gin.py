@@ -21,9 +21,18 @@ class GINConv(nn.Module):
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
         eps = self.param("eps", nn.initializers.constant(self.eps_init), ())
-        msg = x[batch.senders]
-        msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
-        aggr = segment_sum(msg, batch.receivers, x.shape[0])
+        extras = batch.extras or {}
+        if "nbr_idx" in extras:  # dense scatter-free path (ops/dense_agg.py)
+            from hydragnn_tpu.ops.dense_agg import dense_sum, gather_neighbors
+
+            x_j = gather_neighbors(
+                x, extras["nbr_idx"], extras["rev_idx"], extras["rev_mask"]
+            )
+            aggr = dense_sum(x_j, extras["nbr_mask"])
+        else:
+            msg = x[batch.senders]
+            msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
+            aggr = segment_sum(msg, batch.receivers, x.shape[0])
         h = (1.0 + eps) * x + aggr
         h = TorchLinear(self.out_dim, name="mlp_0")(h)
         h = nn.relu(h)  # GINStack hardcodes ReLU inside the conv MLP

@@ -36,12 +36,23 @@ class MFConv(nn.Module):
         b_l = self.param("b_l", uniform, (k, self.out_dim))
         w_r = self.param("w_r", uniform, (k, self.in_dim, self.out_dim))
 
-        msg = x[batch.senders]
-        msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
-        h = segment_sum(msg, batch.receivers, n)
-        deg = segment_count(
-            batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
-        )
+        extras = batch.extras or {}
+        if "nbr_idx" in extras:  # dense scatter-free path (ops/dense_agg.py)
+            from hydragnn_tpu.ops.dense_agg import dense_sum, gather_neighbors
+
+            nmask = extras["nbr_mask"]
+            x_j = gather_neighbors(
+                x, extras["nbr_idx"], extras["rev_idx"], extras["rev_mask"]
+            )
+            h = dense_sum(x_j, nmask)
+            deg = nmask.sum(axis=1).astype(jnp.float32)
+        else:
+            msg = x[batch.senders]
+            msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
+            h = segment_sum(msg, batch.receivers, n)
+            deg = segment_count(
+                batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
+            )
         deg = jnp.clip(deg.astype(jnp.int32), 0, self.max_degree)
         out = (
             jnp.einsum("nf,nfo->no", h, w_l[deg])

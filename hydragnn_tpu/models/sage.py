@@ -18,17 +18,28 @@ class SAGEConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
-        msg = x[batch.senders]
-        msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
-        # mean over real incoming edges only: sum then divide by real degree
-        n = x.shape[0]
-        from hydragnn_tpu.graph import segment_count, segment_sum
+        extras = batch.extras or {}
+        if "nbr_idx" in extras:  # dense scatter-free path (ops/dense_agg.py)
+            from hydragnn_tpu.ops.dense_agg import dense_sum, gather_neighbors
 
-        total = segment_sum(msg, batch.receivers, n)
-        deg = segment_count(
-            batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
-        )
-        aggr = total / jnp.maximum(deg, 1.0)[:, None]
+            nmask = extras["nbr_mask"]
+            x_j = gather_neighbors(
+                x, extras["nbr_idx"], extras["rev_idx"], extras["rev_mask"]
+            )
+            deg = nmask.sum(axis=1).astype(x.dtype)
+            aggr = dense_sum(x_j, nmask) / jnp.maximum(deg, 1.0)[:, None]
+        else:
+            msg = x[batch.senders]
+            msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
+            # mean over real incoming edges only: sum / real degree
+            n = x.shape[0]
+            from hydragnn_tpu.graph import segment_count, segment_sum
+
+            total = segment_sum(msg, batch.receivers, n)
+            deg = segment_count(
+                batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
+            )
+            aggr = total / jnp.maximum(deg, 1.0)[:, None]
         out = TorchLinear(self.out_dim, name="lin_l")(aggr) + TorchLinear(
             self.out_dim, use_bias=False, name="lin_r"
         )(x)

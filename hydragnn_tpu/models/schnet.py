@@ -30,6 +30,15 @@ def shifted_softplus(x):
     return jax.nn.softplus(x) - math.log(2.0)
 
 
+def _safe_sqrt(x):
+    """sqrt with a finite gradient at 0 (double-where): degenerate
+    zero-distance pairs (padding edges, dense-layout fill slots) otherwise
+    turn a zero cotangent into NaN once pos is parameter-dependent."""
+    nonzero = x > 0
+    safe = jnp.where(nonzero, x, 1.0)
+    return jnp.where(nonzero, jnp.sqrt(safe), 0.0)
+
+
 class GaussianSmearing(nn.Module):
     start: float
     stop: float
@@ -39,7 +48,8 @@ class GaussianSmearing(nn.Module):
     def __call__(self, dist):
         offset = jnp.linspace(self.start, self.stop, self.num_gaussians)
         coeff = -0.5 / (offset[1] - offset[0]) ** 2
-        d = dist[:, None] - offset[None, :]
+        # rank-agnostic: [E] -> [E, G] and dense [N, K] -> [N, K, G]
+        d = dist[..., None] - offset
         return jnp.exp(coeff * d * d)
 
 
@@ -59,13 +69,34 @@ class CFConv(nn.Module):
     def __call__(self, x, pos, batch, train: bool = False):
         n = x.shape[0]
         send, recv = batch.senders, batch.receivers
-        if self.use_edge_attr:
+        extras = batch.extras or {}
+        dense = "nbr_idx" in extras
+        if dense:
+            # dense scatter-free frame (ops/dense_agg.py): every per-edge
+            # quantity lives as [N, K, *]; pos gathers go through the
+            # custom-VJP gather so the equivariant backward stays
+            # scatter-free too
+            from hydragnn_tpu.ops.dense_agg import gather_neighbors
+
+            nbr, nmask = extras["nbr_idx"], extras["nbr_mask"]
+            rev, rmask = extras["rev_idx"], extras["rev_mask"]
+            pos_j = gather_neighbors(pos, nbr, rev, rmask)
+            pos_i = jnp.broadcast_to(pos[:, None, :], pos_j.shape)
+            if self.use_edge_attr:
+                edge_weight = jnp.linalg.norm(
+                    batch.edge_attr[extras["nbr_edge"]], axis=-1
+                )
+            else:
+                diff = pos_j - pos_i
+                edge_weight = _safe_sqrt((diff * diff).sum(-1))
+            emask = nmask
+        elif self.use_edge_attr:
             # reference: edge_weight = edge_attr.norm(dim=-1) on the
             # normalized lengths (SCFStack.py:123-131)
             edge_weight = jnp.linalg.norm(batch.edge_attr, axis=-1)
         else:
             diff = pos[send] - pos[recv]
-            edge_weight = jnp.sqrt((diff * diff).sum(-1) + 1e-12)
+            edge_weight = _safe_sqrt((diff * diff).sum(-1))
         edge_attr = GaussianSmearing(0.0, self.cutoff, self.num_gaussians)(
             edge_weight
         )
@@ -75,8 +106,11 @@ class CFConv(nn.Module):
         w = shifted_softplus(w)
         w = TorchLinear(self.num_filters, name="filter_1")(w)
         cos_cut = 0.5 * (jnp.cos(edge_weight * math.pi / self.cutoff) + 1.0)
-        w = w * cos_cut[:, None]
-        w = jnp.where(batch.edge_mask[:, None], w, 0.0)
+        w = w * cos_cut[..., None]
+        if dense:
+            w = jnp.where(emask[..., None], w, 0.0)
+        else:
+            w = jnp.where(batch.edge_mask[:, None], w, 0.0)
 
         glorot = nn.initializers.xavier_uniform()
         lin1 = self.param("lin1", glorot, (self.in_dim, self.num_filters))
@@ -84,8 +118,11 @@ class CFConv(nn.Module):
 
         if self.equivariant:
             # coord update (SCFStack.py:173-181): aggregate at senders
-            diff = pos[send] - pos[recv]
-            norm = jnp.sqrt((diff * diff).sum(-1, keepdims=True)) + 1.0
+            if dense:
+                diff = pos_j - pos_i
+            else:
+                diff = pos[send] - pos[recv]
+            norm = _safe_sqrt((diff * diff).sum(-1, keepdims=True)) + 1.0
             coord_diff = diff / norm
             cw = TorchLinear(self.num_filters, name="coord_mlp_0")(w)
             cw = jax.nn.relu(cw)
@@ -94,26 +131,55 @@ class CFConv(nn.Module):
             )
             cw = cw @ self.param("coord_mlp_1", small, (self.num_filters, 1))
             trans = jnp.clip(coord_diff * cw, -100.0, 100.0)
-            trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
-            # trans and the count share one segment pass + one halo_reduce
-            both = segment_sum(
-                jnp.concatenate(
-                    [trans, batch.edge_mask.astype(trans.dtype)[:, None]], -1
-                ),
-                send,
-                n,
-            )
-            if self.partition_axis is not None:
-                from hydragnn_tpu.parallel.graph_partition import halo_reduce
+            if dense:
+                # sender-side sum through the reverse lists (scatter-free);
+                # per-sender count = real out-degree
+                from hydragnn_tpu.ops.dense_agg import aggregate_to_senders
 
-                both = halo_reduce(
-                    both, batch.extras["halo_send"], self.partition_axis
+                trans = jnp.where(nmask[..., None], trans, 0.0)
+                agg = aggregate_to_senders(trans, nbr, nmask, rev, rmask)
+                cnt = rmask.sum(axis=1).astype(trans.dtype)
+                if self.partition_axis is not None:
+                    from hydragnn_tpu.parallel.graph_partition import (
+                        halo_reduce,
+                    )
+
+                    both = halo_reduce(
+                        jnp.concatenate([agg, cnt[:, None]], -1),
+                        batch.extras["halo_send"],
+                        self.partition_axis,
+                    )
+                    agg, cnt = both[:, :3], both[:, 3]
+            else:
+                trans = jnp.where(batch.edge_mask[:, None], trans, 0.0)
+                # trans and the count share one segment pass + halo_reduce
+                both = segment_sum(
+                    jnp.concatenate(
+                        [trans, batch.edge_mask.astype(trans.dtype)[:, None]],
+                        -1,
+                    ),
+                    send,
+                    n,
                 )
-            agg, cnt = both[:, :3], both[:, 3]
+                if self.partition_axis is not None:
+                    from hydragnn_tpu.parallel.graph_partition import (
+                        halo_reduce,
+                    )
+
+                    both = halo_reduce(
+                        both, batch.extras["halo_send"], self.partition_axis
+                    )
+                agg, cnt = both[:, :3], both[:, 3]
             pos = pos + agg / jnp.maximum(cnt, 1.0)[:, None]
 
-        msg = h[send] * w
-        aggr = segment_sum(msg, recv, n)
+        if dense:
+            from hydragnn_tpu.ops.dense_agg import dense_sum, gather_neighbors
+
+            h_j = gather_neighbors(h, nbr, rev, rmask)
+            aggr = dense_sum(h_j * w, nmask)
+        else:
+            msg = h[send] * w
+            aggr = segment_sum(msg, recv, n)
         lin2 = self.param("lin2", glorot, (self.num_filters, self.out_dim))
         bias2 = self.param("bias2", nn.initializers.zeros, (self.out_dim,))
         out = aggr @ lin2 + bias2

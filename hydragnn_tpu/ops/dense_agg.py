@@ -137,6 +137,39 @@ def _gather_bwd(res, g):
 gather_neighbors.defvjp(_gather_fwd, _gather_bwd)
 
 
+@jax.custom_vjp
+def aggregate_to_senders(h, nbr_idx, nbr_mask, rev_idx, rev_mask):
+    """Sum dense per-edge values ``h [N, K_in, D]`` (keyed by receiver x
+    slot) onto their SENDER nodes -> ``[N, D]``, scatter-free.
+
+    Forward reads each sender's outgoing slots through the reverse list;
+    backward is the exact dual — a gather through the forward list:
+    ``grad_h[r, k] = g_out[nbr_idx[r, k]]`` — so EGNN/SchNet-style
+    sender-side aggregations stay scatter-free in both directions too.
+    """
+    n, k_in, d = h.shape
+    flat = h.reshape(n * k_in, d)
+    contrib = flat[rev_idx]  # [N, K_out, D]
+    return jnp.where(rev_mask[..., None], contrib, 0.0).sum(axis=1)
+
+
+def _agg_send_fwd(h, nbr_idx, nbr_mask, rev_idx, rev_mask):
+    return (
+        aggregate_to_senders(h, nbr_idx, nbr_mask, rev_idx, rev_mask),
+        (nbr_idx, nbr_mask),
+    )
+
+
+def _agg_send_bwd(res, g):
+    nbr_idx, nbr_mask = res
+    gh = g[nbr_idx]  # [N, K_in, D]
+    gh = jnp.where(nbr_mask[..., None], gh, 0.0)
+    return gh, None, None, None, None
+
+
+aggregate_to_senders.defvjp(_agg_send_fwd, _agg_send_bwd)
+
+
 def dense_moments(h, nbr_mask):
     """(mean, std, deg, has) over the K axis of masked messages
     ``h [N, K, D]`` — PNA's count/mean/std statistics without a scatter.

@@ -119,16 +119,31 @@ def pytest_dense_reductions_match_segment():
     )
 
 
-@pytest.mark.parametrize("with_edges", [False, True])
-def pytest_pna_dense_path_parity(with_edges):
-    """Full PNAStack: identical outputs and parameter gradients through the
-    dense and segment paths."""
+@pytest.mark.parametrize(
+    "model_type,variant",
+    [
+        ("PNA", "plain"),
+        ("PNA", "edges"),
+        ("GIN", "plain"),
+        ("SAGE", "plain"),
+        ("MFC", "plain"),
+        ("CGCNN", "edges"),
+        ("SchNet", "plain"),
+        ("SchNet", "equivariant"),
+        ("EGNN", "plain"),
+        ("EGNN", "equivariant"),
+    ],
+)
+def pytest_dense_path_parity(model_type, variant):
+    """Full stacks: identical outputs and parameter gradients through the
+    dense and segment paths (receiver-side AND sender-side aggregations,
+    equivariant coordinate updates included)."""
     batch = make_batch()
-    if with_edges:
-        cfg = arch_config("PNA")
+    cfg = arch_config(model_type)
+    if variant == "edges":
         cfg["edge_dim"] = 1
-    else:
-        cfg = arch_config("PNA")
+    if variant == "equivariant":
+        cfg["equivariance"] = True
     model = create_model_config(cfg)
     params = init_model_params(model, batch)
     dense_batch = _with_neighbors(batch)

@@ -305,13 +305,11 @@ def pytest_partitioned_train_step_parity():
         )
 
 
-def pytest_partitioned_dense_aggregation_parity():
-    """Dense neighbor lists under graph partitioning: per-shard lists over
-    the extended (local+halo) node table, gather through halos, backward
-    through reverse lists — outputs must equal the unpartitioned segment
-    model exactly like the standard partitioned path does."""
-    sample = _giant_graph(seed=7)
-    ref_model, part_model = _models("PNA")
+def _check_partitioned_dense_parity(model_type, extra, seed):
+    """Shared parity contract: partitioned+dense forward must equal the
+    unpartitioned segment model; returns pieces for extra checks."""
+    sample = _giant_graph(seed=seed)
+    ref_model, part_model = _models(model_type, extra)
     single = _single_batch(sample)
     variables = init_model_params(ref_model, single, seed=0)
     ref_out = ref_model.apply(variables, single, train=False)
@@ -334,6 +332,17 @@ def pytest_partitioned_dense_aggregation_parity():
     node_ref = np.asarray(ref_out[1])[:n]
     node_part = info.gather_nodes(np.asarray(part_out[1]))
     np.testing.assert_allclose(node_part, node_ref, rtol=2e-4, atol=2e-5)
+    return part_model, variables, pbatch, mesh
+
+
+def pytest_partitioned_dense_aggregation_parity():
+    """Dense neighbor lists under graph partitioning: per-shard lists over
+    the extended (local+halo) node table, gather through halos, backward
+    through reverse lists — outputs must equal the unpartitioned segment
+    model exactly like the standard partitioned path does."""
+    part_model, variables, pbatch, mesh = _check_partitioned_dense_parity(
+        "PNA", None, seed=7
+    )
 
     # and the partitioned TRAIN step runs with dense lists
     import optax
@@ -353,3 +362,10 @@ def pytest_partitioned_dense_aggregation_parity():
     step = make_partitioned_train_step(part_model, tx, mesh, "graph")
     state, metrics = step(state, pbatch, jax.random.PRNGKey(0))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def pytest_partitioned_dense_egnn_sender_side():
+    """EGNN under partition + dense lists: sender-side reverse-list
+    aggregation composes with halo_reduce; forward parity vs the
+    unpartitioned segment model."""
+    _check_partitioned_dense_parity("EGNN", {"equivariance": True}, seed=9)
