@@ -42,29 +42,62 @@ class GATv2Conv(nn.Module):
         x_l = (x @ w_l + b_l).reshape(n, h, c)
         x_r = (x @ w_r + b_r).reshape(n, h, c)
 
-        # real edges + one self-loop per node (add_self_loops=True)
-        loop = jnp.arange(n, dtype=batch.senders.dtype)
-        send = jnp.concatenate([batch.senders, loop])
-        recv = jnp.concatenate([batch.receivers, loop])
-        emask = jnp.concatenate([batch.edge_mask, batch.node_mask])
+        extras = batch.extras or {}
+        if "nbr_idx" in extras:
+            # dense scatter-free path: attention softmax is LOCAL over the
+            # K neighbor slots + 1 self-loop slot — no segment ops at all
+            from hydragnn_tpu.ops.dense_agg import gather_neighbors
 
-        g = x_l[send] + x_r[recv]
-        g = jax.nn.leaky_relu(g, self.negative_slope)
-        alpha = (g * att).sum(axis=-1)  # [E+N, H]
-        # fused attention: softmax numerator (weighted messages) and
-        # denominator share ONE scatter pass instead of softmax-normalize +
-        # aggregate (3 scatter passes -> 2). Attention dropout applies to
-        # the numerator only — identical to dropping normalized alphas,
-        # since the 1/(1-p) scaling commutes with the division.
-        ex = segment_softmax_unnorm(alpha, recv, n, mask=emask)  # [E+N, H]
-        exd = nn.Dropout(rate=self.dropout, deterministic=not train)(ex)
-        packed = jnp.concatenate(
-            [x_l[send] * exd[..., None], ex[..., None]], axis=-1
-        )  # [E+N, H, C+1]
-        s = segment_sum(
-            packed.reshape(packed.shape[0], h * (c + 1)), recv, n
-        ).reshape(n, h, c + 1)
-        out = s[..., :c] / jnp.maximum(s[..., -1:], 1e-16)  # [N, H, C]
+            nmask = extras["nbr_mask"]  # [N, K]
+            xl_j = gather_neighbors(
+                x_l.reshape(n, h * c),
+                extras["nbr_idx"],
+                extras["rev_idx"],
+                extras["rev_mask"],
+            ).reshape(n, -1, h, c)  # [N, K, H, C]
+            # slot axis = K neighbors then the self-loop (add_self_loops)
+            msgs = jnp.concatenate([xl_j, x_l[:, None]], axis=1)
+            g = jax.nn.leaky_relu(
+                msgs + x_r[:, None], self.negative_slope
+            )
+            alpha = (g * att).sum(axis=-1)  # [N, K+1, H]
+            allmask = jnp.concatenate(
+                [nmask, batch.node_mask[:, None]], axis=1
+            )[..., None]
+            alpha = jnp.where(allmask, alpha, -1e9)
+            amax = alpha.max(axis=1, keepdims=True)
+            amax = jnp.where(jnp.isfinite(amax), amax, 0.0)
+            ex = jnp.exp(alpha - amax)
+            ex = jnp.where(allmask, ex, 0.0)
+            exd = nn.Dropout(rate=self.dropout, deterministic=not train)(ex)
+            num = (msgs * exd[..., None]).sum(axis=1)  # [N, H, C]
+            den = ex.sum(axis=1)  # [N, H]
+            out = num / jnp.maximum(den[..., None], 1e-16)
+        else:
+            # real edges + one self-loop per node (add_self_loops=True)
+            loop = jnp.arange(n, dtype=batch.senders.dtype)
+            send = jnp.concatenate([batch.senders, loop])
+            recv = jnp.concatenate([batch.receivers, loop])
+            emask = jnp.concatenate([batch.edge_mask, batch.node_mask])
+
+            g = x_l[send] + x_r[recv]
+            g = jax.nn.leaky_relu(g, self.negative_slope)
+            alpha = (g * att).sum(axis=-1)  # [E+N, H]
+            # fused attention: softmax numerator (weighted messages) and
+            # denominator share ONE scatter pass instead of
+            # softmax-normalize + aggregate (3 scatter passes -> 2).
+            # Attention dropout applies to the numerator only — identical
+            # to dropping normalized alphas, since the 1/(1-p) scaling
+            # commutes with the division.
+            ex = segment_softmax_unnorm(alpha, recv, n, mask=emask)
+            exd = nn.Dropout(rate=self.dropout, deterministic=not train)(ex)
+            packed = jnp.concatenate(
+                [x_l[send] * exd[..., None], ex[..., None]], axis=-1
+            )  # [E+N, H, C+1]
+            s = segment_sum(
+                packed.reshape(packed.shape[0], h * (c + 1)), recv, n
+            ).reshape(n, h, c + 1)
+            out = s[..., :c] / jnp.maximum(s[..., -1:], 1e-16)  # [N, H, C]
 
         if self.concat:
             out = out.reshape(n, h * c)

@@ -33,3 +33,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from hydragnn_tpu.utils.compile_cache import enable_compile_cache
 
 enable_compile_cache()
+
+# ---- CI tiers -------------------------------------------------------------
+# HYDRAGNN_FAST_TEST=1: skip the end-to-end/subprocess-heavy files — the
+# ~6-minute smoke tier on the 1-core CI host (BASELINE.md "CI economics").
+# HYDRAGNN_FULL_TEST=1 (read inside the files) widens matrices instead.
+if int(os.getenv("HYDRAGNN_FAST_TEST", "0")) == 1:
+    collect_ignore = [
+        "test_graphs.py",  # e2e accuracy trainings
+        "test_examples.py",  # example subprocesses
+        "test_multiprocess.py",  # two-process distributed runs
+        "test_partitioned_run_training.py",  # partitioned e2e trainings
+        "test_model_loadpred.py",  # train+reload e2e runs
+        "test_hpo.py",  # HPO trial loops
+    ]

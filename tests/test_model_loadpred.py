@@ -59,7 +59,7 @@ def pytest_checkpoint_integrity_and_versioning():
     from hydragnn_tpu.train import checkpoint as ck
 
     batch = make_batch()
-    model = create_model_config(arch_config("PNA"))
+    model = create_model_config(arch_config("SAGE"))
     trainer = Trainer(
         model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
     )
@@ -100,7 +100,7 @@ def pytest_checkpoint_restore_across_config_change():
     from hydragnn_tpu.train.checkpoint import restore_params_only
 
     batch = make_batch()
-    model = create_model_config(arch_config("PNA"))
+    model = create_model_config(arch_config("SAGE"))
     trainer = Trainer(
         model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
     )
