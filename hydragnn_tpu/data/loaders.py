@@ -32,6 +32,8 @@ class BatchLayout:
     need_neighbors: bool = False
     k_in: int = 0
     k_out: int = 0
+    # per-edge incoming-triplet list width (DimeNet dense path)
+    kt: int = 0
 
 
 def _sample_triplets(data: GraphData):
@@ -79,6 +81,7 @@ def compute_layout(
     max_edges = 1
     max_trip = 0
     k_in = k_out = 1
+    kt = 1
     first = None
     for ds in datasets:
         for d in ds:
@@ -86,7 +89,11 @@ def compute_layout(
             max_nodes = max(max_nodes, d.num_nodes)
             max_edges = max(max_edges, d.num_edges)
             if need_triplets:
-                max_trip = max(max_trip, _sample_triplets(d)[0].shape[0])
+                trips = _sample_triplets(d)
+                max_trip = max(max_trip, trips[0].shape[0])
+                if need_neighbors and trips[4].size:
+                    # widest per-edge incoming-triplet group in the sample
+                    kt = max(kt, int(np.bincount(trips[4]).max()))
             if need_neighbors and d.num_edges:
                 from hydragnn_tpu.ops.dense_agg import max_degree
 
@@ -119,6 +126,7 @@ def compute_layout(
         need_neighbors=need_neighbors,
         k_in=k_in,
         k_out=k_out,
+        kt=kt,
     )
 
 
@@ -141,7 +149,10 @@ def _collate_with_extras(samples, layout: BatchLayout):
             extras=pack_triplets(trips, layout.n_pad, layout.t_pad)
         )
     if layout.need_neighbors:
-        from hydragnn_tpu.ops.dense_agg import build_neighbor_lists
+        from hydragnn_tpu.ops.dense_agg import (
+            build_group_lists,
+            build_neighbor_lists,
+        )
 
         nbr = build_neighbor_lists(
             batch.senders,
@@ -153,6 +164,16 @@ def _collate_with_extras(samples, layout: BatchLayout):
         )
         merged = dict(batch.extras or {})
         merged.update(nbr)
+        if layout.need_triplets:
+            # DimeNet dense path: per-edge incoming-triplet member lists
+            tl, tm = build_group_lists(
+                merged["trip_ji"],
+                merged["trip_mask"],
+                layout.e_pad,
+                layout.kt,
+            )
+            merged["tripnbr_idx"] = tl
+            merged["tripnbr_mask"] = tm
         batch = batch.replace(extras=merged)
     return batch
 

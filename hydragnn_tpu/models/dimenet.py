@@ -249,7 +249,17 @@ class DimeNetConv(nn.Module):
                 x_kj, ex["halo_send_edges"], self.partition_axis
             )
         x_kj = jnp.where(trip_mask[:, None], x_kj[idx_kj] * sbf_b, 0.0)
-        x_kj = segment_sum(x_kj, idx_ji, num_edges)
+        if "tripnbr_idx" in ex:
+            # dense scatter-free triplet aggregation: precomputed per-edge
+            # member lists; backward is a pure gather by idx_ji
+            # (ops/dense_agg.group_sum)
+            from hydragnn_tpu.ops.dense_agg import group_sum
+
+            x_kj = group_sum(
+                x_kj, ex["tripnbr_idx"], ex["tripnbr_mask"], idx_ji, trip_mask
+            )
+        else:
+            x_kj = segment_sum(x_kj, idx_ji, num_edges)
         x_kj = act(TorchLinear(self.hidden_dim, use_bias=False, name="int_up")(x_kj))
         hh = x_ji + x_kj
         for bi in range(self.num_before_skip):
@@ -261,7 +271,16 @@ class DimeNetConv(nn.Module):
         # OutputPPBlock: edge states -> node states
         o = TorchLinear(self.hidden_dim, use_bias=False, name="out_lin_rbf")(rbf) * hh
         o = jnp.where(batch.edge_mask[:, None], o, 0.0)
-        o = segment_sum(o, i, n)
+        if "nbr_edge" in ex and self.partition_axis is None:
+            # edges -> receivers through the neighbor-edge lists (each edge
+            # has exactly one receiver: group_sum applies)
+            from hydragnn_tpu.ops.dense_agg import group_sum
+
+            o = group_sum(
+                o, ex["nbr_edge"], ex["nbr_mask"], i, batch.edge_mask
+            )
+        else:
+            o = segment_sum(o, i, n)
         o = TorchLinear(self.out_emb_size, use_bias=False, name="out_up")(o)
         o = act(TorchLinear(self.out_emb_size, name="out_0")(o))
         o = TorchLinear(self.out_dim, use_bias=False, name="out_final")(o)

@@ -65,8 +65,9 @@ class GATv2Conv(nn.Module):
                 [nmask, batch.node_mask[:, None]], axis=1
             )[..., None]
             alpha = jnp.where(allmask, alpha, -1e9)
+            # fully-masked (padded) nodes: amax = -1e9 (finite by the
+            # mask convention), exp(0)=1, then re-masked to 0 below
             amax = alpha.max(axis=1, keepdims=True)
-            amax = jnp.where(jnp.isfinite(amax), amax, 0.0)
             ex = jnp.exp(alpha - amax)
             ex = jnp.where(allmask, ex, 0.0)
             exd = nn.Dropout(rate=self.dropout, deterministic=not train)(ex)

@@ -64,48 +64,24 @@ def build_neighbor_lists(
                                outgoing edge — the backward-gather index
       ``rev_mask  [N, K_out]``
     Real edges only (``edge_mask`` False rows are padding and excluded).
+    Built on :func:`build_group_lists` (one slot-assignment implementation
+    for every single-owner grouping).
     """
     senders = np.asarray(senders, np.int64)
-    receivers = np.asarray(receivers, np.int64)
-    rows = np.arange(senders.shape[0])
-    if edge_mask is not None:
-        keep = np.asarray(edge_mask, bool)
-        senders, receivers, rows = senders[keep], receivers[keep], rows[keep]
-
-    nbr_idx = np.zeros((num_nodes, k_in), np.int32)
-    nbr_edge = np.zeros((num_nodes, k_in), np.int32)
-    nbr_mask = np.zeros((num_nodes, k_in), bool)
-    rev_idx = np.zeros((num_nodes, k_out), np.int32)
-    rev_mask = np.zeros((num_nodes, k_out), bool)
-
-    # stable order by receiver: slot = running index within the receiver
-    order = np.argsort(receivers, kind="stable")
-    r_sorted = receivers[order]
-    slot_in = np.arange(r_sorted.shape[0]) - np.searchsorted(
-        r_sorted, r_sorted, side="left"
+    # incoming lists: edges grouped by receiver; sender per slot
+    nbr_edge, nbr_mask = build_group_lists(
+        receivers, edge_mask, num_nodes, k_in
     )
-    if np.any(slot_in >= k_in):
-        raise ValueError(
-            f"in-degree exceeds layout k_in={k_in}; recompute the layout"
-        )
-    nbr_idx[r_sorted, slot_in] = senders[order]
-    nbr_edge[r_sorted, slot_in] = rows[order]
-    nbr_mask[r_sorted, slot_in] = True
-
-    # reverse: for each sender, the flat [N*K_in] slot its edge landed in
-    flat = (r_sorted * k_in + slot_in).astype(np.int64)
-    s_sorted_order = np.argsort(senders[order], kind="stable")
-    s_sorted = senders[order][s_sorted_order]
-    slot_out = np.arange(s_sorted.shape[0]) - np.searchsorted(
-        s_sorted, s_sorted, side="left"
+    nbr_idx = np.where(nbr_mask, senders[nbr_edge], 0).astype(np.int32)
+    # flat [N*K_in] dense slot of every edge row
+    flat_of_edge = np.zeros(senders.shape[0], np.int64)
+    rr, ss = np.nonzero(nbr_mask)
+    flat_of_edge[nbr_edge[rr, ss]] = rr * k_in + ss
+    # reverse lists: edges grouped by sender; flat slot per entry
+    out_edge, rev_mask = build_group_lists(
+        senders, edge_mask, num_nodes, k_out
     )
-    if np.any(slot_out >= k_out):
-        raise ValueError(
-            f"out-degree exceeds layout k_out={k_out}; recompute the layout"
-        )
-    rev_idx[s_sorted, slot_out] = flat[s_sorted_order].astype(np.int32)
-    rev_mask[s_sorted, slot_out] = True
-
+    rev_idx = np.where(rev_mask, flat_of_edge[out_edge], 0).astype(np.int32)
     return {
         "nbr_idx": nbr_idx,
         "nbr_edge": nbr_edge,
@@ -135,6 +111,63 @@ def _gather_bwd(res, g):
 
 
 gather_neighbors.defvjp(_gather_fwd, _gather_bwd)
+
+
+@jax.custom_vjp
+def group_sum(values, lists, lists_mask, owner_ids, valid):
+    """Generic scatter-free segment sum for SINGLE-OWNER groupings.
+
+    ``values [T, D]`` where every valid row belongs to exactly one group
+    (``owner_ids [T]``, ``valid [T]`` row validity); ``lists [G, K]``
+    enumerates each group's member rows with ``lists_mask`` validity.
+    Forward is a gather + masked K-axis sum (= ``segment_sum(values,
+    owner_ids, G)`` over valid rows, without the scatter); backward is the
+    exact dual — a gather ``g[owner_ids]`` masked by ``valid`` (padded
+    rows share owner slot 0, so an unmasked backward would corrupt real
+    rows' gradients). Covers DimeNet's triplet->edge and edge->node
+    aggregations (and any other one-owner grouping) with precomputed
+    host-side lists.
+    """
+    member = values[lists]  # [G, K, D]
+    return jnp.where(lists_mask[..., None], member, 0.0).sum(axis=1)
+
+
+def _group_sum_fwd(values, lists, lists_mask, owner_ids, valid):
+    return group_sum(values, lists, lists_mask, owner_ids, valid), (
+        owner_ids,
+        valid,
+    )
+
+
+def _group_sum_bwd(res, g):
+    owner_ids, valid = res
+    gv = jnp.where(valid[:, None], g[owner_ids], 0.0)
+    return gv, None, None, None, None
+
+
+group_sum.defvjp(_group_sum_fwd, _group_sum_bwd)
+
+
+def build_group_lists(owner_ids, valid_mask, num_groups: int, k: int):
+    """Host-side (numpy): invert a single-owner mapping into fixed-width
+    member lists. Returns (lists [G, k] int32, mask [G, k] bool)."""
+    owner_ids = np.asarray(owner_ids, np.int64)
+    rows = np.arange(owner_ids.shape[0])
+    if valid_mask is not None:
+        keep = np.asarray(valid_mask, bool)
+        owner_ids, rows = owner_ids[keep], rows[keep]
+    lists = np.zeros((num_groups, k), np.int32)
+    mask = np.zeros((num_groups, k), bool)
+    order = np.argsort(owner_ids, kind="stable")
+    o_sorted = owner_ids[order]
+    slot = np.arange(o_sorted.shape[0]) - np.searchsorted(
+        o_sorted, o_sorted, side="left"
+    )
+    if o_sorted.size and np.any(slot >= k):
+        raise ValueError(f"group size exceeds layout k={k}; recompute the layout")
+    lists[o_sorted, slot] = rows[order]
+    mask[o_sorted, slot] = True
+    return lists, mask
 
 
 @jax.custom_vjp
@@ -215,4 +248,16 @@ def attach_neighbor_lists(batch):
     )
     merged = dict(batch.extras or {})
     merged.update({k: jnp.asarray(v) for k, v in extras.items()})
+    if "trip_ji" in merged:
+        # DimeNet batches: per-edge incoming-triplet member lists too
+        tji = np.asarray(merged["trip_ji"])
+        tmask = np.asarray(merged["trip_mask"])
+        kt = (
+            int(np.bincount(tji[tmask]).max()) if tmask.any() else 1
+        )
+        tl, tm = build_group_lists(
+            tji, tmask, int(batch.senders.shape[-1]), kt
+        )
+        merged["tripnbr_idx"] = jnp.asarray(tl)
+        merged["tripnbr_mask"] = jnp.asarray(tm)
     return batch.replace(extras=merged)

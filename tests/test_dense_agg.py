@@ -127,6 +127,7 @@ def pytest_dense_reductions_match_segment():
 _COMBOS = [
     ("PNA", "edges"),
     ("GAT", "plain"),
+    ("DimeNet", "plain"),
     ("GIN", "plain"),
     ("SchNet", "equivariant"),
     ("EGNN", "equivariant"),
@@ -147,7 +148,7 @@ def pytest_dense_path_parity(model_type, variant):
     """Full stacks: identical outputs and parameter gradients through the
     dense and segment paths (receiver-side AND sender-side aggregations,
     equivariant coordinate updates included)."""
-    batch = make_batch()
+    batch = make_batch(with_triplets=(model_type == "DimeNet"))
     cfg = arch_config(model_type)
     if variant == "edges":
         cfg["edge_dim"] = 1

@@ -121,11 +121,12 @@ FULL = int(os.getenv("HYDRAGNN_FULL_TEST", "0")) == 1
 # Default CI keeps one run per feature axis + the fast models; set
 # HYDRAGNN_FULL_TEST=1 for the reference's full 33-run matrix
 # (tests/test_graphs.py:193-224).
-# PNA + SchNet here; GIN is covered by the conv-head run,
-# EGNN by the equivariant run — every model still trains
-# e2e in the default tier, just not twice
-_DEFAULT_SINGLEHEAD = ["PNA", "SchNet"]
-_DEFAULT_MULTIHEAD = ["PNA"]
+# Default-tier e2e coverage: every model trains to the accuracy ceilings
+# at least once — PNA+SchNet (singlehead), PNA+GAT (multihead), CGCNN
+# (lengths), EGNN (equivariant), GIN+MFC (conv head), SAGE+DimeNet
+# (singlehead additions below).
+_DEFAULT_SINGLEHEAD = ["PNA", "SchNet", "SAGE", "DimeNet"]
+_DEFAULT_MULTIHEAD = ["PNA", "GAT"]
 
 
 @pytest.mark.parametrize(
@@ -143,7 +144,8 @@ def pytest_train_model_multihead(model_type):
 
 
 @pytest.mark.parametrize(
-    "model_type", ["PNA", "CGCNN", "SchNet", "EGNN"] if FULL else ["PNA"]
+    "model_type",
+    ["PNA", "CGCNN", "SchNet", "EGNN"] if FULL else ["PNA", "CGCNN"],
 )
 def pytest_train_model_lengths(model_type):
     unittest_train_model(model_type, "ci.json", True)
@@ -163,7 +165,7 @@ def pytest_train_model_vectoroutput(model_type):
     "model_type",
     ["SAGE", "GIN", "GAT", "MFC", "PNA", "SchNet", "DimeNet", "EGNN"]
     if FULL
-    else ["GIN"],
+    else ["GIN", "MFC"],
 )
 def pytest_train_model_conv_head(model_type):
     unittest_train_model(model_type, "ci_conv_head.json", False)
