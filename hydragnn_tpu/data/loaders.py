@@ -171,6 +171,7 @@ def _collate_with_extras(samples, layout: BatchLayout):
                 merged["trip_mask"],
                 layout.e_pad,
                 layout.kt,
+                label="kt",
             )
             merged["tripnbr_idx"] = tl
             merged["tripnbr_mask"] = tm

@@ -49,15 +49,80 @@ def structure_from_dict(s: dict) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     )
 
 
+def iter_mptrj_entries(path: str, chunk: int = 1 << 22) -> Iterator[tuple]:
+    """Stream ``(mp_id, frames_dict)`` pairs from the top level of an
+    MPtrj JSON WITHOUT loading the whole file (the real
+    ``MPtrj_2022.9_full.json`` is tens of GB; ``json.load`` would exhaust
+    host RAM). Incremental scan: find each top-level key, then
+    ``raw_decode`` just that entry's value from a growing buffer.
+
+    A file that ends before the top-level closing brace raises (a
+    truncated download must not silently train on a partial dataset —
+    ``json.load`` would have raised too). ``chunk`` is the refill size
+    (small values exercise the boundary handling in tests)."""
+    decoder = json.JSONDecoder()
+    with open(path) as f:
+        buf = f.read(chunk)
+
+        def _fill(need_more=True):
+            nonlocal buf
+            data = f.read(chunk)
+            if not data and need_more:
+                raise ValueError(f"truncated MPtrj JSON: {path}")
+            buf += data
+            return bool(data)
+
+        # opening brace
+        i = buf.find("{")
+        while i < 0:
+            _fill()
+            i = buf.find("{")
+        buf = buf[i + 1 :]
+        while True:
+            # next key or closing brace
+            while True:
+                stripped = buf.lstrip(" \t\r\n,")
+                if stripped[:1] in ('"', "}"):
+                    buf = stripped
+                    break
+                if not _fill(need_more=False):
+                    raise ValueError(
+                        f"truncated MPtrj JSON (no closing brace): {path}"
+                    )
+            if buf[:1] == "}":
+                return
+            # parse "key":
+            while True:
+                try:
+                    key, end = decoder.raw_decode(buf)
+                    break
+                except json.JSONDecodeError:
+                    _fill()
+            buf = buf[end:].lstrip(" \t\r\n")
+            while buf[:1] != ":":
+                _fill()
+                buf = buf.lstrip(" \t\r\n")
+            buf = buf[1:]
+            # parse the value (one mp_id's frames dict)
+            while True:
+                try:
+                    value, end = decoder.raw_decode(buf.lstrip(" \t\r\n"))
+                    lead = len(buf) - len(buf.lstrip(" \t\r\n"))
+                    buf = buf[lead + end :]
+                    break
+                except json.JSONDecodeError:
+                    _fill()
+            yield key, value
+
+
 def iter_mptrj(
     path: str,
     energy_per_atom: bool = True,
 ) -> Iterator[dict]:
     """Yield flat records: ``z, pos, lattice, energy, forces, stress,
-    magmom, mp_id, frame_id`` from the nested two-level JSON."""
-    with open(path) as f:
-        d = json.load(f)
-    for mp_id, frames in d.items():
+    magmom, mp_id, frame_id`` from the nested two-level JSON (streamed —
+    constant memory in the number of mp_ids)."""
+    for mp_id, frames in iter_mptrj_entries(path):
         for frame_id, k in frames.items():
             z, pos, lattice = structure_from_dict(k["structure"])
             if energy_per_atom:

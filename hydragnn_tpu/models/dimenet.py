@@ -249,10 +249,12 @@ class DimeNetConv(nn.Module):
                 x_kj, ex["halo_send_edges"], self.partition_axis
             )
         x_kj = jnp.where(trip_mask[:, None], x_kj[idx_kj] * sbf_b, 0.0)
-        if "tripnbr_idx" in ex:
+        if "tripnbr_idx" in ex and self.partition_axis is None:
             # dense scatter-free triplet aggregation: precomputed per-edge
             # member lists; backward is a pure gather by idx_ji
-            # (ops/dense_agg.group_sum)
+            # (ops/dense_agg.group_sum). Not under partition: per-shard
+            # trip_ji rows are shard-local, the flattened lists would
+            # collide across shards.
             from hydragnn_tpu.ops.dense_agg import group_sum
 
             x_kj = group_sum(

@@ -70,7 +70,7 @@ def build_neighbor_lists(
     senders = np.asarray(senders, np.int64)
     # incoming lists: edges grouped by receiver; sender per slot
     nbr_edge, nbr_mask = build_group_lists(
-        receivers, edge_mask, num_nodes, k_in
+        receivers, edge_mask, num_nodes, k_in, label="k_in"
     )
     nbr_idx = np.where(nbr_mask, senders[nbr_edge], 0).astype(np.int32)
     # flat [N*K_in] dense slot of every edge row
@@ -79,7 +79,7 @@ def build_neighbor_lists(
     flat_of_edge[nbr_edge[rr, ss]] = rr * k_in + ss
     # reverse lists: edges grouped by sender; flat slot per entry
     out_edge, rev_mask = build_group_lists(
-        senders, edge_mask, num_nodes, k_out
+        senders, edge_mask, num_nodes, k_out, label="k_out"
     )
     rev_idx = np.where(rev_mask, flat_of_edge[out_edge], 0).astype(np.int32)
     return {
@@ -148,9 +148,12 @@ def _group_sum_bwd(res, g):
 group_sum.defvjp(_group_sum_fwd, _group_sum_bwd)
 
 
-def build_group_lists(owner_ids, valid_mask, num_groups: int, k: int):
+def build_group_lists(
+    owner_ids, valid_mask, num_groups: int, k: int, label: str = "k"
+):
     """Host-side (numpy): invert a single-owner mapping into fixed-width
-    member lists. Returns (lists [G, k] int32, mask [G, k] bool)."""
+    member lists. Returns (lists [G, k] int32, mask [G, k] bool).
+    ``label`` names the budget in overflow errors (k_in/k_out/kt)."""
     owner_ids = np.asarray(owner_ids, np.int64)
     rows = np.arange(owner_ids.shape[0])
     if valid_mask is not None:
@@ -164,7 +167,9 @@ def build_group_lists(owner_ids, valid_mask, num_groups: int, k: int):
         o_sorted, o_sorted, side="left"
     )
     if o_sorted.size and np.any(slot >= k):
-        raise ValueError(f"group size exceeds layout k={k}; recompute the layout")
+        raise ValueError(
+            f"group size exceeds layout {label}={k}; recompute the layout"
+        )
     lists[o_sorted, slot] = rows[order]
     mask[o_sorted, slot] = True
     return lists, mask
@@ -256,7 +261,7 @@ def attach_neighbor_lists(batch):
             int(np.bincount(tji[tmask]).max()) if tmask.any() else 1
         )
         tl, tm = build_group_lists(
-            tji, tmask, int(batch.senders.shape[-1]), kt
+            tji, tmask, int(batch.senders.shape[-1]), kt, label="kt"
         )
         merged["tripnbr_idx"] = jnp.asarray(tl)
         merged["tripnbr_mask"] = jnp.asarray(tm)

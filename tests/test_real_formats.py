@@ -316,3 +316,51 @@ def pytest_qm9_raw_trains_end_to_end(qm9_root, tmp_path, monkeypatch):
         rng, sub = jax.random.split(rng)
         state, metrics = trainer._train_step(state, trainer.put_batch(batch), sub)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def pytest_mptrj_streaming_parser(tmp_path):
+    """iter_mptrj_entries streams top-level entries without json.load-ing
+    the whole file (the real MPtrj json is tens of GB) — robust to
+    pretty-printed whitespace and chunk boundaries."""
+    from hydragnn_tpu.data.mptrj import iter_mptrj_entries
+
+    recs = []
+    for t in range(5):
+        recs.append(
+            {
+                "mp_id": f"mp-{t}",
+                "frame_id": f"mp-{t}-0-0",
+                "z": np.array([26, 8]),
+                "pos": np.array([[0.0, 0, 0], [2.0, 2.0, 2.0]]),
+                "lattice": np.diag([4.0, 4.0, 4.0]),
+                "energy": -6.5 - t,
+                "forces": np.zeros((2, 3)),
+                "magmom": np.array([1.0, 0.0]),
+            }
+        )
+    compact = str(tmp_path / "MPtrj_c.json")
+    write_mptrj_json(compact, recs)
+    pretty = str(tmp_path / "MPtrj_p.json")
+    with open(pretty, "w") as f:
+        json.dump(json.load(open(compact)), f, indent=2)
+    for p in (compact, pretty):
+        # chunk=64 forces many refills: keys/values straddle boundaries
+        for chunk in (64, 1 << 22):
+            keys = [k for k, _ in iter_mptrj_entries(p, chunk=chunk)]
+            assert keys == [f"mp-{t}" for t in range(5)]
+        graphs = load_mptrj(p, radius=4.5)
+        assert len(graphs) == 5
+        assert graphs[3].targets[0][0] == pytest.approx(-9.5)
+
+    # a truncated download must raise, not silently yield a partial dataset
+    raw = open(compact).read()
+    cut = str(tmp_path / "MPtrj_cut.json")
+    with open(cut, "w") as f:
+        f.write(raw[: int(len(raw) * 0.6)])
+    with pytest.raises((ValueError,)):
+        list(iter_mptrj_entries(cut, chunk=64))
+    nobrace = str(tmp_path / "MPtrj_nobrace.json")
+    with open(nobrace, "w") as f:
+        f.write(raw.rstrip()[:-1])  # drop only the closing brace
+    with pytest.raises(ValueError, match="closing brace"):
+        list(iter_mptrj_entries(nobrace, chunk=64))
