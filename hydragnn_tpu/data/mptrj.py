@@ -92,27 +92,49 @@ def iter_mptrj_entries(path: str, chunk: int = 1 << 22) -> Iterator[tuple]:
             if buf[:1] == "}":
                 return
             # parse "key":
-            while True:
-                try:
-                    key, end = decoder.raw_decode(buf)
-                    break
-                except json.JSONDecodeError:
-                    _fill()
+            key, end = _decode_growing(decoder, lambda: buf, _fill)
             buf = buf[end:].lstrip(" \t\r\n")
             while buf[:1] != ":":
                 _fill()
                 buf = buf.lstrip(" \t\r\n")
-            buf = buf[1:]
+            buf = buf[1:].lstrip(" \t\r\n")
             # parse the value (one mp_id's frames dict)
-            while True:
-                try:
-                    value, end = decoder.raw_decode(buf.lstrip(" \t\r\n"))
-                    lead = len(buf) - len(buf.lstrip(" \t\r\n"))
-                    buf = buf[lead + end :]
-                    break
-                except json.JSONDecodeError:
-                    _fill()
+            value, end = _decode_growing(decoder, lambda: buf, _fill)
+            buf = buf[end:]
             yield key, value
+
+
+def _decode_growing(decoder, get_buf, fill):
+    """raw_decode against a growing buffer. Distinguishes an INCOMPLETE
+    value (error at/near the end of the buffer, or an unterminated string
+    whose closing quote hasn't arrived) from a genuine syntax error —
+    the latter re-raises immediately instead of buffering the rest of a
+    tens-of-GB file. Refill size doubles per retry so a large entry costs
+    O(V) re-parses of geometric prefixes (~2x total), not O(V^2/chunk)."""
+    rounds = 1
+    at_eof = False
+    while True:
+        buf = get_buf()
+        try:
+            return decoder.raw_decode(buf)
+        except json.JSONDecodeError as e:
+            incomplete = (
+                e.pos >= len(buf) - 1
+                or e.msg.startswith("Unterminated string")
+            )
+            if not incomplete:
+                raise
+            if at_eof:
+                raise ValueError(
+                    "truncated MPtrj JSON (value incomplete at EOF)"
+                ) from e
+            for _ in range(rounds):
+                if not fill(need_more=False):
+                    # EOF mid-refill: the value may have JUST completed —
+                    # one final decode decides truncated vs done
+                    at_eof = True
+                    break
+            rounds = min(rounds * 2, 64)
 
 
 def iter_mptrj(

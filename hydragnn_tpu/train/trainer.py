@@ -101,6 +101,51 @@ def _is_oom(exc: BaseException) -> bool:
     )
 
 
+def _offset_local_shard(batch: GraphBatch, rank: int) -> GraphBatch:
+    """Multi-host assembly correctness: each process collates its local
+    shard with LOCAL row indices, but the globally-assembled arrays have
+    global row semantics inside jit — every index array must be offset by
+    this process's position, or shard p's gathers silently read shard 0's
+    rows (caught by the cross-process loss-parity test). Handles plain
+    [..., E] and stacked [K, ..., E] layouts alike (offsets are per-shard
+    constants)."""
+    n_off = rank * batch.x.shape[-2]
+    e_off = rank * batch.senders.shape[-1]
+    g_off = rank * batch.n_node.shape[-1]
+    rep = dict(
+        senders=np.asarray(batch.senders, np.int64) + n_off,
+        receivers=np.asarray(batch.receivers, np.int64) + n_off,
+        node_graph=np.asarray(batch.node_graph, np.int64) + g_off,
+    )
+    rep = {k: v.astype(np.int32) for k, v in rep.items()}
+    if batch.extras:
+        ex = dict(batch.extras)
+        for key in ("trip_i", "trip_j", "trip_k", "nbr_idx"):
+            if key in ex:
+                ex[key] = (np.asarray(ex[key], np.int64) + n_off).astype(
+                    np.int32
+                )
+        for key in ("trip_kj", "trip_ji", "nbr_edge"):
+            if key in ex:
+                ex[key] = (np.asarray(ex[key], np.int64) + e_off).astype(
+                    np.int32
+                )
+        if "rev_idx" in ex:
+            # flat (row * k_in + slot): global row offset scales by k_in
+            k_in = ex["nbr_idx"].shape[-1]
+            ex["rev_idx"] = (
+                np.asarray(ex["rev_idx"], np.int64) + n_off * k_in
+            ).astype(np.int32)
+        if "tripnbr_idx" in ex:
+            # member lists reference triplet-table rows
+            t_off = rank * ex["trip_mask"].shape[-1]
+            ex["tripnbr_idx"] = (
+                np.asarray(ex["tripnbr_idx"], np.int64) + t_off
+            ).astype(np.int32)
+        rep["extras"] = ex
+    return batch.replace(**rep)
+
+
 def _decompact_traced(batch: GraphBatch) -> GraphBatch:
     """Inverse of the wire compaction, INSIDE the jitted program (free —
     XLA fuses the casts; eager device casts would cost a dispatch each):
@@ -290,6 +335,7 @@ class Trainer:
             if self._batch_sharding is None:
                 self._batch_sharding = NamedSharding(self.mesh, P("data"))
             if jax.process_count() > 1:
+                batch = _offset_local_shard(batch, jax.process_index())
                 return jax.tree_util.tree_map(
                     lambda a: jax.make_array_from_process_local_data(
                         self._batch_sharding, np.asarray(a)
@@ -314,6 +360,7 @@ class Trainer:
             if self._stacked_sharding is None:
                 self._stacked_sharding = NamedSharding(self.mesh, P(None, "data"))
             if jax.process_count() > 1:
+                stacked = _offset_local_shard(stacked, jax.process_index())
                 return jax.tree_util.tree_map(
                     lambda a: jax.make_array_from_process_local_data(
                         self._stacked_sharding, np.asarray(a)

@@ -13,6 +13,57 @@ import os
 import sys
 
 
+def make_samples(num, seed):
+    """Deterministic local-shard samples (shared with the test's
+    reference-loss computation)."""
+    import numpy as np
+
+    class _S:
+        pass
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        n = 6
+        s = _S()
+        s.x = rng.random((n, 1)).astype(np.float32)
+        s.pos = rng.random((n, 3)).astype(np.float32)
+        src = np.arange(n)
+        dst = (src + 1) % n
+        s.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        s.edge_attr = None
+        s.targets = [np.array([s.x.sum()], np.float32), s.x.copy()]
+        out.append(s)
+    return out
+
+
+def worker_arch():
+    return {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+                "type": "mlp",
+            },
+        },
+        "task_weights": [1.0, 1.0],
+        "num_conv_layers": 2,
+    }
+
+
 def main():
     proc_id, num_procs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     os.environ["XLA_FLAGS"] = (
@@ -54,26 +105,7 @@ def main():
     from hydragnn_tpu.parallel.mesh import make_mesh
     from hydragnn_tpu.train.trainer import Trainer
 
-    class _S:
-        pass
-
-    def samples(num, seed):
-        rng = np.random.default_rng(seed)
-        out = []
-        for _ in range(num):
-            n = 6
-            s = _S()
-            s.x = rng.random((n, 1)).astype(np.float32)
-            s.pos = rng.random((n, 3)).astype(np.float32)
-            src = np.arange(n)
-            dst = (src + 1) % n
-            s.edge_index = np.stack(
-                [np.concatenate([src, dst]), np.concatenate([dst, src])]
-            ).astype(np.int64)
-            s.edge_attr = None
-            s.targets = [np.array([s.x.sum()], np.float32), s.x.copy()]
-            out.append(s)
-        return out
+    samples = make_samples
 
     # every process collates ITS OWN local shard (different data per rank);
     # put_batch assembles the global array from the local shards
@@ -90,30 +122,7 @@ def main():
         head_dims=(1, 1),
     )
 
-    model = create_model_config(
-        {
-            "model_type": "GIN",
-            "input_dim": 1,
-            "hidden_dim": 8,
-            "output_dim": [1, 1],
-            "output_type": ["graph", "node"],
-            "output_heads": {
-                "graph": {
-                    "num_sharedlayers": 1,
-                    "dim_sharedlayers": 8,
-                    "num_headlayers": 1,
-                    "dim_headlayers": [8],
-                },
-                "node": {
-                    "num_headlayers": 1,
-                    "dim_headlayers": [8],
-                    "type": "mlp",
-                },
-            },
-            "task_weights": [1.0, 1.0],
-            "num_conv_layers": 2,
-        }
-    )
+    model = create_model_config(worker_arch())
     mesh = make_mesh(None, "data")  # all 2*num_procs global devices
     trainer = Trainer(
         model,

@@ -64,3 +64,75 @@ def pytest_two_process_training_step():
         if line.startswith("MPOK")
     ]
     assert len(losses) == 2 and losses[0] == losses[1], losses
+
+    # ...and it is the CORRECT global loss: equal to a single-process step
+    # on the two shards assembled with global index offsets. (Round-2
+    # regression guard: per-process local indices shipped unoffset once
+    # made shard 1's gathers read shard 0's rows — finite, agreeing, and
+    # wrong.)
+    expected = _reference_global_loss()
+    assert abs(float(losses[0]) - expected) < 5e-5, (losses[0], expected)
+
+
+def _reference_global_loss():
+    import numpy as np
+
+    import jax
+
+    from hydragnn_tpu.graph import collate_graphs, pad_sizes_for
+    from hydragnn_tpu.graph.batch import GraphBatch
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+    from _multiprocess_worker import make_samples, worker_arch
+
+    local_graphs = 4
+    n_pad, e_pad, g_pad = pad_sizes_for(
+        6, 12, local_graphs, node_multiple=8, edge_multiple=8, graph_multiple=8
+    )
+    shards = [
+        collate_graphs(
+            make_samples(local_graphs, seed=100 + rank),
+            n_pad, e_pad, g_pad,
+            head_types=("graph", "node"), head_dims=(1, 1),
+        )
+        for rank in range(2)
+    ]
+    acc = {f: [] for f in ("x", "pos", "senders", "receivers", "node_graph",
+                            "n_node", "n_edge", "node_mask", "edge_mask",
+                            "graph_mask")}
+    tgt = [[] for _ in shards[0].targets]
+    for p, b in enumerate(shards):
+        acc["x"].append(b.x); acc["pos"].append(b.pos)
+        acc["senders"].append(np.asarray(b.senders) + p * n_pad)
+        acc["receivers"].append(np.asarray(b.receivers) + p * n_pad)
+        acc["node_graph"].append(np.asarray(b.node_graph) + p * g_pad)
+        acc["n_node"].append(b.n_node); acc["n_edge"].append(b.n_edge)
+        acc["node_mask"].append(b.node_mask)
+        acc["edge_mask"].append(b.edge_mask)
+        acc["graph_mask"].append(b.graph_mask)
+        for i, t in enumerate(b.targets):
+            tgt[i].append(t)
+    gbatch = GraphBatch(
+        x=np.concatenate(acc["x"]),
+        pos=np.concatenate(acc["pos"]),
+        senders=np.concatenate(acc["senders"]).astype(np.int32),
+        receivers=np.concatenate(acc["receivers"]).astype(np.int32),
+        edge_attr=None,
+        node_graph=np.concatenate(acc["node_graph"]).astype(np.int32),
+        n_node=np.concatenate(acc["n_node"]),
+        n_edge=np.concatenate(acc["n_edge"]),
+        node_mask=np.concatenate(acc["node_mask"]),
+        edge_mask=np.concatenate(acc["edge_mask"]),
+        graph_mask=np.concatenate(acc["graph_mask"]),
+        targets=tuple(np.concatenate(t) for t in tgt),
+    )
+    model = create_model_config(worker_arch())
+    trainer = Trainer(
+        model, training_config={"Optimizer": {"type": "AdamW",
+                                               "learning_rate": 1e-3}}
+    )
+    state = trainer.init_state(gbatch)
+    state, metrics = trainer._train_step(
+        state, trainer.put_batch(gbatch), jax.random.PRNGKey(0)
+    )
+    return float(metrics["loss"])

@@ -352,12 +352,14 @@ def pytest_mptrj_streaming_parser(tmp_path):
         assert len(graphs) == 5
         assert graphs[3].targets[0][0] == pytest.approx(-9.5)
 
-    # a truncated download must raise, not silently yield a partial dataset
+    # a truncated download must raise LOUDLY, not silently yield a partial
+    # dataset (ValueError at EOF mid-value, or JSONDecodeError when the
+    # cut lands mid-literal and reads as a syntax error)
     raw = open(compact).read()
     cut = str(tmp_path / "MPtrj_cut.json")
     with open(cut, "w") as f:
         f.write(raw[: int(len(raw) * 0.6)])
-    with pytest.raises((ValueError,)):
+    with pytest.raises((ValueError, json.JSONDecodeError)):
         list(iter_mptrj_entries(cut, chunk=64))
     nobrace = str(tmp_path / "MPtrj_nobrace.json")
     with open(nobrace, "w") as f:
