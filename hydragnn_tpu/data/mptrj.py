@@ -115,11 +115,23 @@ def _decode_growing(decoder, get_buf, fill):
     at_eof = False
     while True:
         buf = get_buf()
+        # strip per attempt: refills can land right after a ':' so the
+        # value starts behind fresh whitespace raw_decode won't skip
+        stripped = buf.lstrip(" \t\r\n")
+        lead = len(buf) - len(stripped)
         try:
-            return decoder.raw_decode(buf)
+            value, end = decoder.raw_decode(stripped)
+            return value, lead + end
         except json.JSONDecodeError as e:
+            # incomplete if the error sits inside the final (possibly
+            # split) token: non-string JSON tokens — numbers, literals,
+            # \uXXXX escapes — are < 16 chars, so a failure in the last 16
+            # chars means "need more bytes"; split strings report
+            # "Unterminated string" at the string's start. Anything
+            # earlier is a genuine syntax error: re-raise with position
+            # instead of buffering the rest of a tens-of-GB file.
             incomplete = (
-                e.pos >= len(buf) - 1
+                e.pos >= len(stripped) - 16
                 or e.msg.startswith("Unterminated string")
             )
             if not incomplete:

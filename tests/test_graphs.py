@@ -237,3 +237,30 @@ def pytest_train_model_whole_training_dispatch(model_type):
         },
         num_samples_tot=300,
     )
+
+
+@pytest.mark.skipif(not FULL, reason="cross-mode matrix: FULL tier")
+@pytest.mark.parametrize(
+    "training_overwrite",
+    [
+        {"device_resident_dataset": True, "fit_chunk_epochs": 10},
+        {"steps_per_dispatch": 4},
+    ],
+    ids=["whole_training", "multistep"],
+)
+def pytest_train_model_dense_cross_modes(training_overwrite):
+    """dense_aggregation composes with the whole-training and multi-step
+    dispatch modes (the extras ride stage_batches/stack_batches): same
+    reference ceilings through the public API."""
+    unittest_train_model(
+        "PNA",
+        "ci.json",
+        False,
+        overwrite_config={
+            "NeuralNetwork": {
+                "Architecture": {"dense_aggregation": True},
+                "Training": training_overwrite,
+            }
+        },
+        num_samples_tot=300,
+    )
