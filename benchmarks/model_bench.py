@@ -41,6 +41,7 @@ _DEFAULT_PEAK = 197.0
 
 
 _FALSY = ("0", "false", "no", "off")
+_BOOL_FLAGS = ("bf16", "dense")
 
 
 def _arg(flag, default=None):
@@ -49,8 +50,12 @@ def _arg(flag, default=None):
             return True
         if a.startswith(f"--{flag}="):
             v = a.split("=", 1)[1]
-            # boolean spellings: --dense=0 / --bf16=false mean OFF
-            return False if v.lower() in _FALSY else v
+            # boolean spellings (--dense=0 / --bf16=false mean OFF) apply
+            # only to the boolean flags; numeric flags pass through so
+            # int() can validate them (--iters=0 must not become False)
+            if flag in _BOOL_FLAGS:
+                return v.lower() not in _FALSY
+            return v
     return default
 
 
@@ -158,6 +163,8 @@ def bench_model(
 ):
     """Measure one jitted train step. Returns a dict with fence-true
     ms/step, graphs/sec, XLA-counted TFLOP/s, and MFU vs the chip's peak."""
+    if iters < 1:
+        raise ValueError(f"iters must be >= 1, got {iters}")
     import jax
 
     from hydragnn_tpu.models import create_model_config

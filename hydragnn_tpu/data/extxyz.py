@@ -61,58 +61,87 @@ def iter_extxyz(path: str) -> Iterator[dict]:
     ``pbc`` [3] bool, ``info`` (remaining comment keys, floats where they
     parse), ``arrays`` (extra per-atom columns, e.g. forces)."""
     with open(path) as f:
+        iframe = 0
         while True:
             header = f.readline()
             if not header:
                 return
             if not header.strip():
                 continue
-            natoms = int(header.split()[0])
-            comment = f.readline()
-            kv = _parse_comment(comment)
-            spec = kv.pop("Properties", "species:S:1:pos:R:3")
-            columns = _parse_properties(str(spec))
-            cell = None
-            if "Lattice" in kv:
-                cell = np.fromstring(str(kv.pop("Lattice")), sep=" ").reshape(3, 3)
-            pbc = np.array([False] * 3)
-            if "pbc" in kv:
-                pbc = np.array(
-                    [t in ("T", "True", "1") for t in str(kv.pop("pbc")).split()]
-                )
-            elif cell is not None:
-                pbc = np.array([True] * 3)
-            info = {}
-            for k, v in kv.items():
-                try:
-                    info[k] = float(v)  # type: ignore[arg-type]
-                except (TypeError, ValueError):
-                    info[k] = v
-            data: Dict[str, list] = {name: [] for name, _, _ in columns}
-            for _ in range(natoms):
-                fields = f.readline().split()
-                at = 0
-                for name, caster, n in columns:
-                    data[name].append([caster(x) for x in fields[at : at + n]])
-                    at += n
-            symbols = [row[0] for row in data.pop("species")]
-            pos = np.asarray(data.pop("pos"), dtype=np.float64)
-            arrays = {
-                k: np.asarray(v, dtype=np.float64).squeeze(-1)
-                if np.asarray(v).shape[-1] == 1
-                else np.asarray(v, dtype=np.float64)
-                for k, v in data.items()
-                if k not in ("species", "pos")
-            }
-            yield {
-                "symbols": symbols,
-                "z": np.asarray([atomic_number(s) for s in symbols], np.int64),
-                "pos": pos,
-                "cell": cell,
-                "pbc": pbc,
-                "info": info,
-                "arrays": arrays,
-            }
+            try:
+                yield _parse_frame(f, header)
+            except Exception as e:
+                raise ValueError(
+                    f"{path}: malformed extxyz frame {iframe}: {e}"
+                ) from e
+            iframe += 1
+
+
+def _parse_frame(f, header: str) -> dict:
+    natoms = int(header.split()[0])
+    comment = f.readline()
+    kv = _parse_comment(comment)
+    spec = kv.pop("Properties", "species:S:1:pos:R:3")
+    columns = _parse_properties(str(spec))
+    ncols_expected = sum(n for _, _, n in columns)
+    cell = None
+    if "Lattice" in kv:
+        cell = np.fromstring(str(kv.pop("Lattice")), sep=" ").reshape(3, 3)
+    pbc = np.array([False] * 3)
+    if "pbc" in kv:
+        pbc = np.array(
+            [t in ("T", "True", "1") for t in str(kv.pop("pbc")).split()]
+        )
+    elif cell is not None:
+        pbc = np.array([True] * 3)
+    info = {}
+    for k, v in kv.items():
+        try:
+            info[k] = float(v)  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            info[k] = v
+    data: Dict[str, list] = {name: [] for name, _, _ in columns}
+    for iatom in range(natoms):
+        line = f.readline()
+        if not line:
+            raise ValueError(
+                f"file ends inside atom table (atom {iatom} of {natoms})"
+            )
+        fields = line.split()
+        if len(fields) < ncols_expected:
+            raise ValueError(
+                f"atom {iatom}: {len(fields)} columns, Properties spec "
+                f"needs {ncols_expected}"
+            )
+        at = 0
+        for name, caster, n in columns:
+            data[name].append([caster(x) for x in fields[at : at + n]])
+            at += n
+    symbols = [row[0] for row in data.pop("species")]
+    pos = np.asarray(data.pop("pos"), dtype=np.float64)
+    # numeric columns (R/I/L) become float arrays; string-typed extras (any
+    # Properties ...:S:n besides species) stay as object arrays instead of
+    # crashing a legitimate file on float64 coercion
+    numeric = {name for name, caster, _ in columns if caster is not str}
+    arrays = {}
+    for k, v in data.items():
+        if k in ("species", "pos"):
+            continue
+        if k in numeric:
+            a = np.asarray(v, dtype=np.float64)
+            arrays[k] = a.squeeze(-1) if a.shape[-1] == 1 else a
+        else:
+            a = np.asarray(v, dtype=object)
+            arrays[k] = a.squeeze(-1) if a.shape[-1] == 1 else a
+    return {
+        "symbols": symbols,
+        "z": np.asarray([atomic_number(s) for s in symbols], np.int64),
+        "pos": pos,
+        "cell": cell,
+        "pbc": pbc,
+        "info": info,
+        "arrays": arrays,
+    }
 
 
 def read_extxyz(path: str) -> List[dict]:
@@ -134,13 +163,26 @@ def write_extxyz(path: str, frames, append: bool = False):
                 parts.append(
                     'Lattice="' + " ".join(f"{v:.8f}" for v in cell.ravel()) + '"'
                 )
-                parts.append('pbc="T T T"')
+                pbc = fr.get("pbc")
+                flags = (
+                    "T T T"
+                    if pbc is None
+                    else " ".join("T" if b else "F" for b in np.asarray(pbc))
+                )
+                parts.append(f'pbc="{flags}"')
             props = "species:S:1:pos:R:3"
             arrays = dict(fr.get("arrays", {}))
+            col_type = {}
             for k, v in arrays.items():
                 v = np.asarray(v)
                 ncols = 1 if v.ndim == 1 else v.shape[1]
-                props += f":{k}:R:{ncols}"
+                if v.dtype == bool:
+                    col_type[k] = "L"  # extxyz logical encoding (T/F)
+                elif np.issubdtype(v.dtype, np.number):
+                    col_type[k] = "R"
+                else:
+                    col_type[k] = "S"
+                props += f":{k}:{col_type[k]}:{ncols}"
             parts.insert(0, f"Properties={props}")
             for k, v in fr.get("info", {}).items():
                 s = str(v)
@@ -152,8 +194,14 @@ def write_extxyz(path: str, frames, append: bool = False):
                 row = f"{syms[i]:<3s} " + " ".join(f"{c:.8f}" for c in pos[i])
                 for k, v in arrays.items():
                     v = np.asarray(v)
-                    vals = v[i] if v.ndim > 1 else [v[i]]
-                    row += " " + " ".join(f"{float(c):.8f}" for c in np.atleast_1d(vals))
+                    vals = np.atleast_1d(v[i] if v.ndim > 1 else [v[i]])
+                    t = col_type[k]
+                    if t == "L":
+                        row += " " + " ".join("T" if c else "F" for c in vals)
+                    elif t == "S":
+                        row += " " + " ".join(str(c) for c in vals)
+                    else:
+                        row += " " + " ".join(f"{float(c):.8f}" for c in vals)
                 f.write(row + "\n")
 
 
@@ -172,8 +220,11 @@ def frame_to_graph(
     z = frame["z"].astype(np.float32).reshape(-1, 1)
     pos = frame["pos"].astype(np.float32)
     if frame.get("cell") is not None and bool(np.any(frame["pbc"])):
+        # per-axis pbc mask: a slab (pbc="T T F") must not form edges
+        # through the vacuum axis
         edge_index, lengths = radius_graph_pbc(
-            pos.astype(np.float64), frame["cell"], radius, max_neighbours
+            pos.astype(np.float64), frame["cell"], radius, max_neighbours,
+            pbc=frame["pbc"],
         )
     else:
         edge_index = radius_graph(pos, radius, max_neighbours)

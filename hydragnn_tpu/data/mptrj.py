@@ -162,11 +162,25 @@ def iter_mptrj(
             if energy_per_atom:
                 energy = k.get("energy_per_atom")
                 if energy is None:
-                    energy = k["corrected_total_energy"] / len(z)
+                    total = k.get("corrected_total_energy")
+                    if total is None:
+                        raise KeyError(
+                            f"{mp_id}/{frame_id}: record has neither "
+                            "'energy_per_atom' nor 'corrected_total_energy'"
+                        )
+                    energy = total / len(z)
             else:
-                energy = k.get(
-                    "corrected_total_energy", k.get("energy_per_atom", 0.0) * len(z)
-                )
+                energy = k.get("corrected_total_energy")
+                if energy is None:
+                    per_atom = k.get("energy_per_atom")
+                    if per_atom is None:
+                        # loud failure, mirroring extxyz.frame_to_graph —
+                        # a malformed record must not train on a 0.0 label
+                        raise KeyError(
+                            f"{mp_id}/{frame_id}: record has neither "
+                            "'corrected_total_energy' nor 'energy_per_atom'"
+                        )
+                    energy = per_atom * len(z)
             yield {
                 "mp_id": mp_id,
                 "frame_id": frame_id,

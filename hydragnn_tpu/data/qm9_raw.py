@@ -103,7 +103,10 @@ def read_gdb9_csv(path: str) -> np.ndarray:
     with open(path, newline="") as f:
         reader = csv.reader(f)
         header = next(reader)
-        assert header[0].lower().startswith("mol"), f"unexpected header {header[:2]}"
+        if not header[0].lower().startswith("mol"):
+            # explicit raise (not assert): must survive python -O, or a
+            # wrong-format file parses silently with misaligned targets
+            raise ValueError(f"unexpected gdb9 csv header {header[:2]}")
         for rec in reader:
             if not rec:
                 continue
@@ -197,10 +200,11 @@ class QM9RawDataset:
                 if os.path.exists(skip_path)
                 else []
             )
-            assert len(mols) == targets.shape[0], (
-                f"sdf has {len(mols)} molecules but csv has "
-                f"{targets.shape[0]} rows"
-            )
+            if len(mols) != targets.shape[0]:
+                raise ValueError(
+                    f"sdf has {len(mols)} molecules but csv has "
+                    f"{targets.shape[0]} rows — misaligned inputs"
+                )
             it = (
                 (i, syms, pos, bonds, targets[i])
                 for i, (syms, pos, bonds) in enumerate(mols)

@@ -104,8 +104,13 @@ def radius_graph_pbc(
     radius: float,
     max_neighbors: int = 32,
     loop: bool = False,
+    pbc: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Periodic radius graph over the 27 minimum-image shifts.
+
+    ``pbc`` is a per-axis [3] bool mask (default fully periodic): image
+    shifts along a non-periodic axis are excluded, so a slab with
+    pbc="T T F" never forms edges across the vacuum axis.
 
     Returns (edge_index, edge_length). Raises if a pair is connected through
     more than one image — the same "duplicate edges" guard as the reference
@@ -118,6 +123,9 @@ def radius_graph_pbc(
     shifts = np.array(
         [[i, j, k] for i in (-1, 0, 1) for j in (-1, 0, 1) for k in (-1, 0, 1)]
     )
+    if pbc is not None:
+        pbc = np.asarray(pbc, dtype=bool)
+        shifts = shifts[np.all((shifts == 0) | pbc[None, :], axis=1)]
     shift_vecs = shifts @ cell  # [27, 3]
     senders, receivers, lengths = [], [], []
     seen = set()

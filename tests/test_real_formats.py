@@ -366,3 +366,94 @@ def pytest_mptrj_streaming_parser(tmp_path):
         f.write(raw.rstrip()[:-1])  # drop only the closing brace
     with pytest.raises(ValueError, match="closing brace"):
         list(iter_mptrj_entries(nobrace, chunk=64))
+
+
+# --- round-3 advisor-hardening regressions ---------------------------------
+
+
+def pytest_extxyz_partial_pbc_slab(tmp_path):
+    """A pbc=\"T T F\" slab must not form edges through the vacuum axis
+    (advisor round 2): two atoms 2.0 apart along z in a cell with only 3.0
+    of z extent are within a 1.5 cutoff ONLY via the z image shift."""
+    frames = [
+        {
+            "z": np.array([1, 1]),
+            "pos": np.array([[0.5, 0.5, 0.5], [0.5, 0.5, 2.5]]),
+            "cell": np.diag([8.0, 8.0, 3.0]),
+            "pbc": np.array([True, True, False]),
+            "info": {"energy": -1.0},
+            "arrays": {},
+        }
+    ]
+    path = str(tmp_path / "slab.extxyz")
+    write_extxyz(path, frames)
+    fr = list(iter_extxyz(path))[0]
+    assert fr["pbc"].tolist() == [True, True, False]  # round-trips
+    g = frame_to_graph(fr, radius=1.5, max_neighbours=8)
+    assert g.num_edges == 0  # no edge across the non-periodic axis
+    # fully periodic: same geometry DOES connect through the z image
+    fr_full = {**fr, "pbc": np.array([True, True, True])}
+    g_full = frame_to_graph(fr_full, radius=1.5, max_neighbours=8)
+    assert g_full.num_edges == 2
+
+
+def pytest_extxyz_truncated_frame_reports_context(tmp_path):
+    path = str(tmp_path / "trunc.extxyz")
+    with open(path, "w") as f:
+        f.write('3\nProperties=species:S:1:pos:R:3 energy=-1.0\n')
+        f.write("H 0.0 0.0 0.0\n")  # file ends after 1 of 3 atoms
+    with pytest.raises(ValueError, match="trunc.extxyz.*frame 0"):
+        list(iter_extxyz(path))
+
+
+def pytest_extxyz_short_atom_line_reports_context(tmp_path):
+    path = str(tmp_path / "short.extxyz")
+    with open(path, "w") as f:
+        f.write('1\nProperties=species:S:1:pos:R:3 energy=-1.0\n')
+        f.write("H 0.0 0.0\n")  # missing a pos column
+    with pytest.raises(ValueError, match="columns"):
+        list(iter_extxyz(path))
+
+
+def pytest_extxyz_string_extra_column(tmp_path):
+    path = str(tmp_path / "tags.extxyz")
+    with open(path, "w") as f:
+        f.write('2\nProperties=species:S:1:pos:R:3:tag:S:1 energy=-1.0\n')
+        f.write("H 0.0 0.0 0.0 surface\n")
+        f.write("H 0.0 0.0 0.9 adsorbate\n")
+    frames = list(iter_extxyz(path))
+    assert frames[0]["arrays"]["tag"].tolist() == ["surface", "adsorbate"]
+
+
+def pytest_mptrj_missing_energy_raises(tmp_path):
+    nested = {
+        "mp-1": {
+            "mp-1-0-0": {
+                "structure": {
+                    "lattice": {"matrix": np.diag([4.0, 4.0, 4.0]).tolist()},
+                    "sites": [
+                        {
+                            "species": [{"element": "Fe", "occu": 1.0}],
+                            "abc": [0.0, 0.0, 0.0],
+                        }
+                    ],
+                },
+                "force": [[0.0, 0.0, 0.0]],
+            }
+        }
+    }
+    path = str(tmp_path / "noenergy.json")
+    with open(path, "w") as f:
+        json.dump(nested, f)
+    with pytest.raises(KeyError):
+        list(iter_mptrj(path, energy_per_atom=False))
+    with pytest.raises(KeyError):
+        list(iter_mptrj(path, energy_per_atom=True))
+
+
+def pytest_qm9_csv_bad_header_raises(tmp_path):
+    path = str(tmp_path / "gdb9.sdf.csv")
+    with open(path, "w") as f:
+        f.write("wrong,header,row\n")
+    with pytest.raises(ValueError, match="header"):
+        read_gdb9_csv(path)
