@@ -1,0 +1,305 @@
+"""The epoch driver (``train_validate_test.py:54-250`` analog).
+
+Split out of ``trainer.py`` (round-3 verdict item 10). Orchestrates the
+``Trainer``'s execution modes — streaming per-batch, HBM-staged epochs,
+whole-training ``fit_staged`` chunks — plus the host-side per-epoch work:
+plateau LR (host path), early stopping, best-checkpoint persistence,
+TensorBoard scalars, SLURM wall-clock guard, visualizer hooks.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+from hydragnn_tpu.train.checkpoint import save_model
+from hydragnn_tpu.train.common import TrainState, _env_flag, _is_oom
+from hydragnn_tpu.train.optimizer import (
+    get_learning_rate,
+    set_learning_rate,
+)
+from hydragnn_tpu.train.scheduler import (
+    BestCheckpoint,
+    EarlyStopping,
+    ReduceLROnPlateau,
+)
+from hydragnn_tpu.utils.print_utils import print_distributed
+
+
+def train_validate_test(
+    trainer,
+    state: TrainState,
+    train_loader,
+    val_loader,
+    test_loader,
+    config_nn: dict,
+    log_name: str,
+    verbosity: int = 0,
+    writer=None,
+    create_plots: bool = False,
+    plot_init_solution: bool = False,
+):
+    """Epoch driver (``train_validate_test.py:54-250``)."""
+    training = config_nn["Training"]
+    num_epoch = training["num_epoch"]
+    early = EarlyStopping(training.get("patience", 5)) if training.get(
+        "EarlyStopping", False
+    ) else None
+    ckpt = (
+        BestCheckpoint(log_name, warmup=training.get("checkpoint_warmup", 10))
+        if training.get("Checkpoint", False)
+        else None
+    )
+    scheduler = ReduceLROnPlateau(lr=get_learning_rate(state.opt_state))
+    rng = jax.random.PRNGKey(1337)
+
+    visualizer = None
+    if create_plots:
+        from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+        node_feature = []
+        nodes_num_list = []
+        for d in test_loader.dataset:
+            node_feature.extend(np.asarray(d.x).tolist())
+            nodes_num_list.append(d.num_nodes)
+        visualizer = Visualizer(
+            log_name,
+            node_feature=node_feature,
+            num_heads=trainer.model.num_heads,
+            head_dims=list(trainer.model.output_dim),
+            num_nodes_list=nodes_num_list,
+        )
+        visualizer.num_nodes_plot()
+        if plot_init_solution:
+            _, _, true_values, predicted_values = trainer.predict(
+                state, test_loader
+            )
+            visualizer.create_scatter_plots(
+                true_values,
+                predicted_values,
+                output_names=config_nn["Variables_of_interest"].get(
+                    "output_names"
+                ),
+                iepoch=-1,
+            )
+
+    total_loss_train = np.zeros(num_epoch)
+    total_loss_val = np.zeros(num_epoch)
+    total_loss_test = np.zeros(num_epoch)
+    skip_valtest = int(os.getenv("HYDRAGNN_VALTEST", "1")) == 0
+
+    # device-resident mode: stage the (collated) training set in HBM once;
+    # every epoch is then a single scan dispatch with no H2D traffic
+    staged = None
+    if _env_flag("HYDRAGNN_DEVICE_RESIDENT", training, "device_resident_dataset"):
+        staged = trainer.stage_batches(list(train_loader))
+
+    # whole-training dispatch: fit_chunk_epochs > 0 runs training in chunks
+    # of N epochs, each chunk ONE XLA program (on-device plateau LR, early
+    # stop, best-state tracking); host work between chunks only — logging,
+    # TensorBoard, checkpoint, SLURM wall-clock guard
+    fit_chunk = int(
+        os.getenv(
+            "HYDRAGNN_FIT_CHUNK", str(training.get("fit_chunk_epochs", 0))
+        )
+    )
+
+    def _log_epoch(ep, train_loss, val_loss, test_loss, train_tasks):
+        total_loss_train[ep] = train_loss
+        total_loss_val[ep] = val_loss
+        total_loss_test[ep] = test_loss
+        print_distributed(
+            verbosity,
+            f"Epoch: {ep:04d}, Train Loss: {train_loss:.8f}, "
+            f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}",
+        )
+        if writer is not None:
+            writer.add_scalar("train error", train_loss, ep)
+            writer.add_scalar("validate error", val_loss, ep)
+            writer.add_scalar("test error", test_loss, ep)
+            for itask, tl in enumerate(np.atleast_1d(train_tasks)):
+                writer.add_scalar(f"train error of task {itask}", float(tl), ep)
+
+    ran_fit = staged is not None and fit_chunk > 0
+    if ran_fit:
+        staged_val = (
+            None if skip_valtest else trainer.stage_batches(list(val_loader))
+        )
+        staged_test = (
+            None if skip_valtest else trainer.stage_batches(list(test_loader))
+        )
+        from hydragnn_tpu.parallel.distributed import check_remaining
+
+        sched = None
+        best_state = None
+        best_saved = np.inf
+        epoch0 = 0
+        # full sample->batch reshuffle at chunk boundaries (the staged scan
+        # only permutes batch ORDER within a chunk; this restores the
+        # reference DistributedSampler's per-epoch sample shuffling at
+        # chunk granularity, at the price of re-staging H2D per chunk)
+        restage = _env_flag(
+            "HYDRAGNN_RESTAGE_PER_CHUNK", training, "restage_per_chunk"
+        )
+        while epoch0 < num_epoch:
+            n = min(fit_chunk, num_epoch - epoch0)
+            if restage and epoch0 > 0:
+                train_loader.set_epoch(epoch0)
+                # release the old stack FIRST — holding it through the
+                # re-stage would double the training set's HBM footprint
+                staged = None
+                staged = trainer.stage_batches(list(train_loader))
+            t0 = time.time()
+            # pad_to keeps every chunk at the same scan length — the short
+            # final chunk must not recompile the whole-training program
+            state, best_state, sched, rng, series = trainer.fit_staged(
+                state,
+                staged,
+                n,
+                rng,
+                staged_val=staged_val,
+                staged_test=staged_test,
+                sched=sched,
+                best_state=best_state,
+                pad_to=fit_chunk,
+            )
+            chunk_time = time.time() - t0
+            for i in range(n):
+                if np.isnan(series["train_loss"][i]):
+                    continue
+                _log_epoch(
+                    epoch0 + i,
+                    series["train_loss"][i],
+                    series["val_loss"][i],
+                    series["test_loss"][i],
+                    series["train_tasks"][i],
+                )
+            # persist the best state after every chunk that improved it —
+            # a preempted job resumes from the last improvement, like the
+            # reference's per-epoch BestCheckpoint (utils/model.py:207-248)
+            if ckpt is not None:
+                bv = float(np.asarray(sched.best_val))
+                if np.isfinite(bv) and bv < best_saved:
+                    save_model(best_state, log_name, ckpt.path)
+                    best_saved = bv
+            epoch0 += n
+            if bool(np.asarray(sched.stopped)):
+                ep_stop = epoch0 - n + int(np.argmax(series["stopped"]))
+                print_distributed(
+                    verbosity, f"Early stopping at epoch {ep_stop}"
+                )
+                break
+            # the next unit of work is an indivisible fit_chunk-epoch
+            # dispatch — reserve a whole chunk's wall time, not one epoch's
+            if not check_remaining(chunk_time):
+                print_distributed(
+                    verbosity, "Stopping: not enough job wall-clock time left"
+                )
+                break
+
+    epoch_time = 0.0
+    staged_evals = None
+    for epoch in range(num_epoch if not ran_fit else 0):
+        t0 = time.time()
+        train_loader.set_epoch(epoch)
+        if staged is not None:
+            state, rng, train_loss, train_tasks = trainer.train_epoch_staged(
+                state, staged, rng
+            )
+        else:
+            state, rng, train_loss, train_tasks = trainer.train_epoch(
+                state, train_loader, rng
+            )
+        if skip_valtest:
+            val_loss, val_tasks = train_loss, train_tasks
+            test_loss, test_tasks = train_loss, train_tasks
+        elif staged is not None:
+            # device-resident epoch driver: evals run staged too (one
+            # dispatch + one readback per split, no per-batch H2D). Any
+            # staging/dispatch memory failure downgrades PERMANENTLY to the
+            # streaming evaluate — the eval sets have their own footprint
+            # on top of the staged training set.
+            if staged_evals is None:
+                try:
+                    vb, tb = list(val_loader), list(test_loader)
+                    if not vb or not tb:
+                        raise ValueError("empty eval loader")
+                    staged_evals = (
+                        trainer.stage_batches(vb),
+                        trainer.stage_batches(tb),
+                    )
+                except Exception as e:
+                    if isinstance(e, ValueError) or _is_oom(e):
+                        staged_evals = False
+                    else:
+                        raise
+            if staged_evals:
+                try:
+                    val_loss, val_tasks = trainer.evaluate_staged(
+                        state, staged_evals[0]
+                    )
+                    test_loss, test_tasks = trainer.evaluate_staged(
+                        state, staged_evals[1]
+                    )
+                except Exception as e:
+                    if _is_oom(e):
+                        staged_evals = False
+                    else:
+                        raise
+            if not staged_evals:
+                val_loss, val_tasks = trainer.evaluate(state, val_loader)
+                test_loss, test_tasks = trainer.evaluate(state, test_loader)
+        else:
+            val_loss, val_tasks = trainer.evaluate(state, val_loader)
+            test_loss, test_tasks = trainer.evaluate(state, test_loader)
+
+        new_lr = scheduler.step(val_loss)
+        if abs(new_lr - get_learning_rate(state.opt_state)) > 1e-12:
+            state = state.replace(
+                opt_state=set_learning_rate(state.opt_state, new_lr)
+            )
+
+        _log_epoch(epoch, train_loss, val_loss, test_loss, train_tasks)
+
+        if visualizer is not None and visualizer.plot_hist_solution:
+            _, _, tv, pv = trainer.predict(state, test_loader)
+            visualizer.plot_history(
+                total_loss_train[: epoch + 1],
+                total_loss_val[: epoch + 1],
+                total_loss_test[: epoch + 1],
+            )
+
+        if ckpt is not None:
+            ckpt(state, epoch, val_loss, save_model)
+        if early is not None and early(val_loss):
+            print_distributed(verbosity, f"Early stopping at epoch {epoch}")
+            break
+
+        epoch_time = time.time() - t0
+        from hydragnn_tpu.parallel.distributed import check_remaining
+
+        if not check_remaining(epoch_time):
+            print_distributed(
+                verbosity, "Stopping: not enough job wall-clock time left"
+            )
+            break
+
+    if visualizer is not None:
+        _, _, true_values, predicted_values = trainer.predict(state, test_loader)
+        visualizer.plot_history(
+            total_loss_train,
+            total_loss_val,
+            total_loss_test,
+        )
+        visualizer.create_plot_global(
+            true_values,
+            predicted_values,
+            output_names=config_nn["Variables_of_interest"].get("output_names"),
+        )
+        visualizer.create_scatter_plots(
+            true_values,
+            predicted_values,
+            output_names=config_nn["Variables_of_interest"].get("output_names"),
+        )
+    return state

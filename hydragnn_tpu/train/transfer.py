@@ -1,0 +1,77 @@
+"""Host->device wire format: compaction and multi-host index offsetting.
+
+Split out of ``trainer.py`` (round-3 verdict item 10). Two halves of one
+contract: what the host ships (:func:`_offset_local_shard`, and the
+compaction applied by ``Trainer._compact_for_transfer``) and what the
+jitted program undoes (:func:`_decompact_traced`).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from hydragnn_tpu.graph.batch import GraphBatch
+
+
+def _offset_local_shard(batch: GraphBatch, rank: int) -> GraphBatch:
+    """Multi-host assembly correctness: each process collates its local
+    shard with LOCAL row indices, but the globally-assembled arrays have
+    global row semantics inside jit — every index array must be offset by
+    this process's position, or shard p's gathers silently read shard 0's
+    rows (caught by the cross-process loss-parity test). Handles plain
+    [..., E] and stacked [K, ..., E] layouts alike (offsets are per-shard
+    constants)."""
+    n_off = rank * batch.x.shape[-2]
+    e_off = rank * batch.senders.shape[-1]
+    g_off = rank * batch.n_node.shape[-1]
+    rep = dict(
+        senders=np.asarray(batch.senders, np.int64) + n_off,
+        receivers=np.asarray(batch.receivers, np.int64) + n_off,
+        node_graph=np.asarray(batch.node_graph, np.int64) + g_off,
+    )
+    rep = {k: v.astype(np.int32) for k, v in rep.items()}
+    if batch.extras:
+        ex = dict(batch.extras)
+        for key in ("trip_i", "trip_j", "trip_k", "nbr_idx"):
+            if key in ex:
+                ex[key] = (np.asarray(ex[key], np.int64) + n_off).astype(
+                    np.int32
+                )
+        for key in ("trip_kj", "trip_ji", "nbr_edge"):
+            if key in ex:
+                ex[key] = (np.asarray(ex[key], np.int64) + e_off).astype(
+                    np.int32
+                )
+        if "rev_idx" in ex:
+            # flat (row * k_in + slot): global row offset scales by k_in
+            k_in = ex["nbr_idx"].shape[-1]
+            ex["rev_idx"] = (
+                np.asarray(ex["rev_idx"], np.int64) + n_off * k_in
+            ).astype(np.int32)
+        if "tripnbr_idx" in ex:
+            # member lists reference triplet-table rows
+            t_off = rank * ex["trip_mask"].shape[-1]
+            ex["tripnbr_idx"] = (
+                np.asarray(ex["tripnbr_idx"], np.int64) + t_off
+            ).astype(np.int32)
+        rep["extras"] = ex
+    return batch.replace(**rep)
+
+
+def _decompact_traced(batch: GraphBatch) -> GraphBatch:
+    """Inverse of the wire compaction, INSIDE the jitted program (free —
+    XLA fuses the casts; eager device casts would cost a dispatch each):
+    upcast int16 index arrays, synthesize zero positions for the [1, 3]
+    placeholder shipped when the model never reads ``pos``."""
+    rep = {}
+    if batch.senders.dtype != jnp.int32:
+        rep = dict(
+            senders=batch.senders.astype(jnp.int32),
+            receivers=batch.receivers.astype(jnp.int32),
+            node_graph=batch.node_graph.astype(jnp.int32),
+        )
+    if batch.pos.shape[-2] == 1 and batch.x.shape[-2] != 1:
+        # NaN, not zeros: a conv that reads positions while declaring
+        # conv_needs_pos=False would otherwise train on plausible all-zero
+        # coordinates; NaN makes that bug blow up in the first loss value
+        rep["pos"] = jnp.full(batch.x.shape[:-1] + (3,), jnp.nan, jnp.float32)
+    return batch.replace(**rep) if rep else batch
