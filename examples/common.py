@@ -148,6 +148,7 @@ def train_with_loaders(config, trainset, valset, testset, log_name, seed=0):
     train_loader, val_loader, test_loader = create_dataloaders(
         trainset, valset, testset, training["batch_size"], need_triplets,
         need_neighbors=needs_dense_neighbors(arch_cfg),
+        num_buckets=training.get("batch_buckets"),
     )
     config = update_config(config, train_loader, val_loader, test_loader)
     save_config(config, log_name)

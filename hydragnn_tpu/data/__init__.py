@@ -2,11 +2,13 @@ from hydragnn_tpu.data.dataobj import GraphData
 from hydragnn_tpu.data.radius_graph import radius_graph, radius_graph_pbc
 from hydragnn_tpu.data.loaders import (
     BatchLayout,
+    BucketedLayout,
     ConcatDataset,
     GraphLoader,
     compute_layout,
     create_dataloaders,
     dataset_loading_and_splitting,
+    padding_efficiency,
     total_to_train_val_test_pkls,
     transform_raw_data_to_serialized,
 )

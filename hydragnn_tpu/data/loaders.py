@@ -8,14 +8,15 @@ DistributedSampler semantics (seeded by epoch via ``set_epoch``, sharded
 evenly across processes with wrap-around padding).
 """
 
+import bisect
 import os
-from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
 from hydragnn_tpu.data.dataobj import GraphData
-from hydragnn_tpu.graph.batch import collate_graphs, pad_sizes_for
+from hydragnn_tpu.graph.batch import _round_up, collate_graphs, pad_sizes_for
 
 
 @dataclass
@@ -34,6 +35,46 @@ class BatchLayout:
     k_out: int = 0
     # per-edge incoming-triplet list width (DimeNet dense path)
     kt: int = 0
+
+
+@dataclass
+class BucketedLayout:
+    """2-4 size-bucketed :class:`BatchLayout`\\ s per split (round-3 verdict
+    item 3): instead of ONE layout sized at the dataset max — which wastes
+    most of each batch's FLOPs and HBM on padding when graph sizes are
+    heterogeneous (OC20: ~20-250 atoms) — samples are binned by node count
+    and each bucket gets a layout sized at ITS max. Compile count stays
+    bounded: one XLA program per bucket (<= 4), vs the reference's PyG
+    dynamic batching which recompiles nothing because it is eager
+    (``preprocess/load_data.py:226-297``).
+
+    ``node_bounds[b]`` is the inclusive node-count upper bound of bucket
+    ``b`` (ascending); a sample with ``num_nodes`` goes to the first bucket
+    whose bound covers it."""
+
+    layouts: List[BatchLayout] = field(default_factory=list)
+    node_bounds: List[int] = field(default_factory=list)
+
+    def bucket_for(self, num_nodes: int) -> int:
+        b = bisect.bisect_left(self.node_bounds, num_nodes)
+        return min(b, len(self.layouts) - 1)
+
+    # shared head schema (identical across buckets)
+    @property
+    def head_types(self):
+        return self.layouts[0].head_types
+
+    @property
+    def head_dims(self):
+        return self.layouts[0].head_dims
+
+    @property
+    def need_triplets(self):
+        return self.layouts[0].need_triplets
+
+    @property
+    def need_neighbors(self):
+        return self.layouts[0].need_neighbors
 
 
 def _sample_triplets(data: GraphData):
@@ -60,50 +101,85 @@ def needs_dense_neighbors(arch_config: dict) -> bool:
     )
 
 
-def compute_layout(
-    datasets: List[List[GraphData]],
-    batch_size: int,
-    need_triplets: bool = False,
-    device_multiple: Optional[int] = None,
-    need_neighbors: bool = False,
-) -> BatchLayout:
-    """``device_multiple``: every padded leading axis is made divisible by
-    this (the data-parallel axis size) so sharded batches split evenly."""
-    if device_multiple is None:
-        try:
-            import jax
-
-            device_multiple = jax.device_count()
-        except Exception:
-            device_multiple = 1
-    mult = _lcm(8, max(device_multiple, 1))
-    max_nodes = 1
-    max_edges = 1
-    max_trip = 0
-    k_in = k_out = 1
-    kt = 1
+def _sample_stats(datasets, need_triplets, need_neighbors):
+    """One pass over all samples -> per-sample size arrays (nodes, edges,
+    triplets, neighbor-list widths) + the head schema from the first."""
+    nodes, edges, trips_n, kts, kis, kos = [], [], [], [], [], []
     first = None
     for ds in datasets:
         for d in ds:
             first = first or d
-            max_nodes = max(max_nodes, d.num_nodes)
-            max_edges = max(max_edges, d.num_edges)
+            nodes.append(d.num_nodes)
+            edges.append(d.num_edges)
+            t = kt = ki = ko = 0
             if need_triplets:
                 trips = _sample_triplets(d)
-                max_trip = max(max_trip, trips[0].shape[0])
+                t = trips[0].shape[0]
                 if need_neighbors and trips[4].size:
                     # widest per-edge incoming-triplet group in the sample
-                    kt = max(kt, int(np.bincount(trips[4]).max()))
+                    kt = int(np.bincount(trips[4]).max())
             if need_neighbors and d.num_edges:
                 from hydragnn_tpu.ops.dense_agg import max_degree
 
                 ki, ko = max_degree(d.edge_index[0], d.edge_index[1])
-                k_in = max(k_in, ki)
-                k_out = max(k_out, ko)
+            trips_n.append(t)
+            kts.append(kt)
+            kis.append(ki)
+            kos.append(ko)
     head_types = tuple(first.target_types)
     head_dims = tuple(
         t.shape[-1] if t.ndim > 1 else t.shape[0] for t in first.targets
     )
+    return (
+        np.asarray(nodes),
+        np.asarray(edges),
+        np.asarray(trips_n),
+        np.asarray(kts),
+        np.asarray(kis),
+        np.asarray(kos),
+        head_types,
+        head_dims,
+    )
+
+
+def _partition_node_bounds(nodes: np.ndarray, num_buckets: int) -> List[int]:
+    """Bucket boundaries minimizing total padded node rows: exact DP over
+    the distinct node counts (cost of a bucket = its sample count x its max
+    node count — exactly the rows the padded layout will allocate)."""
+    uniq, counts = np.unique(nodes, return_counts=True)
+    m = len(uniq)
+    k = min(num_buckets, m)
+    if k <= 1:
+        return [int(uniq[-1])]
+    prefix = np.concatenate([[0], np.cumsum(counts)])
+    INF = float("inf")
+    # dp[b][j]: min cost covering the first j distinct sizes with b buckets
+    dp = np.full((k + 1, m + 1), INF)
+    cut = np.zeros((k + 1, m + 1), np.int64)
+    dp[0][0] = 0.0
+    prefix = prefix.astype(np.float64)
+    for b in range(1, k + 1):
+        for j in range(1, m + 1):
+            # vectorized min over the cut point i (O(k*m) numpy ops total,
+            # not an O(k*m^2) Python loop — m can be thousands of distinct
+            # sizes at parser-scale datasets)
+            cand = dp[b - 1][:j] + (prefix[j] - prefix[:j]) * float(uniq[j - 1])
+            i = int(np.argmin(cand))
+            dp[b][j] = cand[i]
+            cut[b][j] = i
+    bounds = []
+    j = m
+    for b in range(k, 0, -1):
+        bounds.append(int(uniq[j - 1]))
+        j = int(cut[b][j])
+    return bounds[::-1]
+
+
+def _layout_from_maxima(
+    max_nodes, max_edges, max_trip, kt, k_in, k_out,
+    batch_size, mult, device_multiple, head_types, head_dims,
+    need_triplets, need_neighbors,
+) -> BatchLayout:
     n_pad, e_pad, g_pad = pad_sizes_for(
         max_nodes,
         max_edges,
@@ -124,10 +200,147 @@ def compute_layout(
         need_triplets=need_triplets,
         t_pad=t_pad,
         need_neighbors=need_neighbors,
-        k_in=k_in,
-        k_out=k_out,
-        kt=kt,
+        k_in=max(int(k_in), 1),
+        k_out=max(int(k_out), 1),
+        kt=max(int(kt), 1),
     )
+
+
+def compute_layout(
+    datasets: List[List[GraphData]],
+    batch_size: int,
+    need_triplets: bool = False,
+    device_multiple: Optional[int] = None,
+    need_neighbors: bool = False,
+    num_buckets: int = 1,
+) -> Union[BatchLayout, "BucketedLayout"]:
+    """``device_multiple``: every padded leading axis is made divisible by
+    this (the data-parallel axis size) so sharded batches split evenly.
+
+    ``num_buckets > 1`` returns a :class:`BucketedLayout`: samples are
+    binned by node count (boundaries chosen by an exact DP minimizing
+    padded node rows) and each bucket is sized at its own maxima — the
+    low-waste answer to heterogeneous graph sizes (SURVEY §5's
+    padding/bucketing "hard part"). Compiles stay bounded at one program
+    per bucket."""
+    if device_multiple is None:
+        try:
+            import jax
+
+            device_multiple = jax.device_count()
+        except Exception:
+            device_multiple = 1
+    mult = _lcm(8, max(device_multiple, 1))
+    nodes, edges, trips_n, kts, kis, kos, head_types, head_dims = (
+        _sample_stats(datasets, need_triplets, need_neighbors)
+    )
+
+    def build(mask) -> BatchLayout:
+        return _layout_from_maxima(
+            max(int(nodes[mask].max()), 1),
+            max(int(edges[mask].max()), 1),
+            int(trips_n[mask].max()) if need_triplets else 0,
+            kts[mask].max() if len(kts) else 1,
+            kis[mask].max() if len(kis) else 1,
+            kos[mask].max() if len(kos) else 1,
+            batch_size, mult, device_multiple, head_types, head_dims,
+            need_triplets, need_neighbors,
+        )
+
+    def build_budget(mask) -> BatchLayout:
+        """Bucket layout sized at ``batch_size x bucket MEAN`` (not max):
+        the loader packs graphs greedily under these budgets, so every
+        batch fits by construction and padding waste is the distance from
+        the budget to the last graph that did not fit, not max-vs-mean.
+        ``g_pad`` allows however many of the bucket's smallest graphs fit
+        the node budget."""
+        mn, me = nodes[mask], edges[mask]
+        mt = trips_n[mask]
+        n_budget = int(max(batch_size * float(mn.mean()), mn.max()) + 1)
+        e_budget = int(max(batch_size * float(me.mean()), me.max(), 1))
+        n_pad = _round_up(n_budget, mult)
+        e_pad = _round_up(e_budget, mult)
+        g_cap = max(batch_size, n_pad // max(int(mn.min()), 1))
+        g_pad = _round_up(g_cap + 1, max(device_multiple, 1))
+        t_pad = 0
+        if need_triplets:
+            t_budget = int(max(batch_size * float(mt.mean()), mt.max(), 1))
+            t_pad = _round_up(t_budget, mult)
+        return BatchLayout(
+            n_pad=n_pad,
+            e_pad=e_pad,
+            g_pad=g_pad,
+            head_types=head_types,
+            head_dims=head_dims,
+            need_triplets=need_triplets,
+            t_pad=t_pad,
+            need_neighbors=need_neighbors,
+            k_in=max(int(kis[mask].max()) if len(kis) else 1, 1),
+            k_out=max(int(kos[mask].max()) if len(kos) else 1, 1),
+            kt=max(int(kts[mask].max()) if len(kts) else 1, 1),
+        )
+
+    everything = np.ones(len(nodes), bool)
+    if num_buckets <= 1:
+        return build(everything)
+    bounds = _partition_node_bounds(nodes, num_buckets)
+    layouts = []
+    lo = 0
+    for hi in bounds:
+        mask = (nodes > lo) & (nodes <= hi)
+        layouts.append(build_budget(mask))
+        lo = hi
+    return BucketedLayout(layouts=layouts, node_bounds=bounds)
+
+
+def _pack_indices(
+    idx: np.ndarray,
+    nodes: np.ndarray,
+    edges: np.ndarray,
+    trips: np.ndarray,
+    layout: BatchLayout,
+) -> List[np.ndarray]:
+    """Greedy budget packing: fill a batch until the next graph would
+    overflow the bucket's node/edge/triplet budget or the graph cap.
+    Every batch fits its layout by construction."""
+    cap = layout.g_pad - 1  # the padding-graph slot stays reserved
+    batches, cur = [], []
+    n = e = t = 0
+    for i in idx:
+        ni, ei, ti = int(nodes[i]), int(edges[i]), int(trips[i])
+        if cur and (
+            n + ni > layout.n_pad - 1
+            or e + ei > layout.e_pad
+            or (layout.need_triplets and t + ti > layout.t_pad)
+            or len(cur) >= cap
+        ):
+            batches.append(np.asarray(cur, np.int64))
+            cur, n, e, t = [], 0, 0, 0
+        cur.append(int(i))
+        n += ni
+        e += ei
+        t += ti
+    if cur:
+        batches.append(np.asarray(cur, np.int64))
+    return batches
+
+
+def padding_efficiency(datasets, layout, batch_size: int) -> float:
+    """Real node rows / padded node rows over one epoch's worth of batches
+    — the round-3 verdict's acceptance metric for bucketed layouts.
+    Simulates the loader's own packing (shuffle off, one shard)."""
+    samples = [d for ds in datasets for d in ds]
+    real = int(sum(d.num_nodes for d in samples))
+    loader = GraphLoader(
+        samples, batch_size, layout, shuffle=False, num_shards=1, shard_id=0,
+    )
+    if isinstance(layout, BucketedLayout):
+        padded = sum(
+            layout.layouts[b].n_pad for b, _ in loader._batch_plan()
+        )
+    else:
+        padded = len(loader) * layout.n_pad
+    return real / max(padded, 1)
 
 
 def _collate_with_extras(samples, layout: BatchLayout):
@@ -221,12 +434,13 @@ class GraphLoader:
         self,
         dataset: List[GraphData],
         batch_size: int,
-        layout: BatchLayout,
+        layout: Union[BatchLayout, BucketedLayout],
         shuffle: bool = True,
         seed: int = 42,
         num_shards: Optional[int] = None,
         shard_id: Optional[int] = None,
         prefetch: Optional[int] = None,
+        contiguous_buckets: Optional[bool] = None,
     ):
         from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
@@ -242,6 +456,19 @@ class GraphLoader:
         if prefetch is None:
             prefetch = int(os.getenv("HYDRAGNN_PREFETCH", "0"))
         self.prefetch = prefetch
+        self._plan_cache = None  # (epoch, plan) — packing is O(dataset)
+        # contiguous_buckets: shuffle samples within buckets and the ORDER
+        # of bucket segments, but keep same-bucket batches adjacent — runs
+        # of identical shapes let steps_per_dispatch stack K batches into
+        # one XLA program on dispatch-latency-bound hosts
+        if contiguous_buckets is None:
+            contiguous_buckets = bool(
+                int(os.getenv("HYDRAGNN_BUCKET_CONTIGUOUS", "0"))
+            )
+        self.contiguous_buckets = contiguous_buckets
+        # lazy: one sizes pass over the dataset (bucketed layouts only)
+        self._bucket_ids = None
+        self._sizes = None
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -260,11 +487,104 @@ class GraphLoader:
             idx = idx[self.shard_id :: self.num_shards]
         return idx
 
+    def _bucket_assignments(self):
+        """One pass over the dataset caching (bucket id, node/edge/triplet
+        counts) per sample — the packer's inputs."""
+        if self._bucket_ids is None:
+            ids, nodes, edges, trips = [], [], [], []
+            for i in range(len(self.dataset)):
+                d = self.dataset[i]
+                ids.append(self.layout.bucket_for(d.num_nodes))
+                nodes.append(d.num_nodes)
+                edges.append(d.num_edges)
+                trips.append(
+                    _sample_triplets(d)[0].shape[0]
+                    if self.layout.need_triplets
+                    else 0
+                )
+            self._bucket_ids = np.asarray(ids, np.int64)
+            self._sizes = (
+                np.asarray(nodes, np.int64),
+                np.asarray(edges, np.int64),
+                np.asarray(trips, np.int64),
+            )
+        return self._bucket_ids
+
+    def _batch_plan(self):
+        """Bucketed epoch plan: per-bucket DistributedSampler sharding +
+        greedy budget packing, then a global shuffle of batch ORDER across
+        buckets. Deterministic in (seed, epoch) — every process derives
+        the same plan, including every OTHER shard's packing, so all
+        processes emit the same number of batches with identical shapes at
+        every step (multi-host lockstep without communication). Cached per
+        epoch: ``len(loader)`` + iteration must not pack twice."""
+        if self._plan_cache is not None and self._plan_cache[0] == self.epoch:
+            return self._plan_cache[1]
+        rng = np.random.default_rng(self.seed + self.epoch)
+        plan = []
+        assignments = self._bucket_assignments()
+        nodes, edges, trips = self._sizes
+        for b in range(len(self.layout.layouts)):
+            lay = self.layout.layouts[b]
+            bidx = np.nonzero(assignments == b)[0]
+            n = len(bidx)
+            if n == 0:
+                continue
+            if self.shuffle:
+                bidx = bidx[rng.permutation(n)]
+            if self.num_shards > 1:
+                total = -(-n // self.num_shards) * self.num_shards
+                bidx = np.concatenate([bidx, bidx[: total - n]])
+                # every process packs ALL shards to learn the common batch
+                # count; shards short of it wrap their own first batches
+                # (sample duplication — DistributedSampler's padding rule
+                # applied at batch granularity)
+                per_shard = [
+                    _pack_indices(
+                        bidx[s :: self.num_shards], nodes, edges, trips, lay
+                    )
+                    for s in range(self.num_shards)
+                ]
+                m = max(len(p) for p in per_shard)
+                mine = list(per_shard[self.shard_id])
+                while len(mine) < m:
+                    mine.append(mine[len(mine) % len(per_shard[self.shard_id])])
+                plan.extend((b, chunk) for chunk in mine)
+            else:
+                plan.extend(
+                    (b, chunk)
+                    for chunk in _pack_indices(bidx, nodes, edges, trips, lay)
+                )
+        if self.shuffle and plan:
+            if self.contiguous_buckets:
+                # permute within each bucket segment + the segment order,
+                # preserving same-shape adjacency for multi-step stacking
+                segments = {}
+                for item in plan:
+                    segments.setdefault(item[0], []).append(item)
+                keys = list(segments)
+                plan = []
+                for k in rng.permutation(len(keys)):
+                    seg = segments[keys[k]]
+                    plan.extend(seg[i] for i in rng.permutation(len(seg)))
+            else:
+                order = rng.permutation(len(plan))
+                plan = [plan[i] for i in order]
+        self._plan_cache = (self.epoch, plan)
+        return plan
+
     def __len__(self):
+        if isinstance(self.layout, BucketedLayout):
+            return len(self._batch_plan())
         n = len(self._indices())
         return -(-n // self.batch_size)
 
     def _batches(self):
+        if isinstance(self.layout, BucketedLayout):
+            for b, chunk in self._batch_plan():
+                samples = [self.dataset[i] for i in chunk]
+                yield _collate_with_extras(samples, self.layout.layouts[b])
+            return
         idx = self._indices()
         for start in range(0, len(idx), self.batch_size):
             chunk = [self.dataset[i] for i in idx[start : start + self.batch_size]]
@@ -336,12 +656,22 @@ def create_dataloaders(
     batch_size: int,
     need_triplets: bool = False,
     need_neighbors: bool = False,
+    num_buckets: Optional[int] = None,
 ):
+    """``num_buckets`` (the config's ``Training.batch_buckets``):
+    size-bucketed layouts — <= num_buckets compiled programs per split,
+    padding sized per bucket instead of at the dataset max. Default 1
+    (single layout). ``HYDRAGNN_BATCH_BUCKETS`` overrides whatever the
+    caller passes — the ONE place the env/config precedence lives."""
+    num_buckets = int(
+        os.getenv("HYDRAGNN_BATCH_BUCKETS", str(num_buckets or 1))
+    )
     layout = compute_layout(
         [trainset, valset, testset],
         batch_size,
         need_triplets,
         need_neighbors=need_neighbors,
+        num_buckets=num_buckets,
     )
     return (
         GraphLoader(trainset, batch_size, layout, shuffle=True),
@@ -376,13 +706,15 @@ def dataset_loading_and_splitting(config: dict):
     arch = config["NeuralNetwork"]["Architecture"]
     need_triplets = arch.get("model_type") == "DimeNet"
     need_neighbors = needs_dense_neighbors(arch)
+    training = config["NeuralNetwork"]["Training"]
     return create_dataloaders(
         datasets["train"],
         datasets["validate"],
         datasets["test"],
-        batch_size=config["NeuralNetwork"]["Training"]["batch_size"],
+        batch_size=training["batch_size"],
         need_triplets=need_triplets,
         need_neighbors=need_neighbors,
+        num_buckets=training.get("batch_buckets"),
     )
 
 

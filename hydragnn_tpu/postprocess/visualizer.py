@@ -54,7 +54,11 @@ class Visualizer:
     def create_scatter_plots(
         self, true_values, predicted_values, output_names=None, iepoch=None
     ):
-        """Per-head parity scatter (``visualizer.py`` scatter catalog)."""
+        """Per-head parity scatter, then the reference's per-head dispatch
+        (``visualizer.py:693-727``): vector heads get the per-component
+        parity grid, scalar heads get the parity+error-histogram panel AND
+        the per-node error histograms — so the deep-analysis catalog is
+        produced wherever the epoch driver plots, not only on demand."""
         suffix = f"_epoch{iepoch}" if iepoch is not None else ""
         for ihead in range(len(true_values)):
             t = np.asarray(true_values[ihead]).reshape(-1)
@@ -72,6 +76,19 @@ class Visualizer:
             ax.set_xlabel(f"true {name}")
             ax.set_ylabel(f"predicted {name}")
             self._save(fig, f"scatter_{name}{suffix}.png")
+            d = self.head_dims[ihead] if ihead < len(self.head_dims) else 1
+            if d > 1:
+                self.create_parity_plot_vector(
+                    true_values, predicted_values, ihead, name, dim=d,
+                    iepoch=iepoch,
+                )
+            else:
+                self.create_parity_plot_and_error_histogram_scalar(
+                    true_values, predicted_values, ihead, name, iepoch=iepoch
+                )
+                self.create_error_histogram_per_node(
+                    true_values, predicted_values, ihead, name, iepoch=iepoch
+                )
 
     def create_error_histograms(
         self, true_values, predicted_values, output_names=None
@@ -92,7 +109,10 @@ class Visualizer:
     def create_plot_global(
         self, true_values, predicted_values, output_names=None
     ):
-        """Combined parity panel across all heads."""
+        """Combined parity panel across all heads, plus the reference's
+        per-head global analysis (scatter+contour / conditional-mean /
+        error-PDF; ``visualizer.py:729-740`` routes every head through
+        ``create_plot_global_analysis``)."""
         n = len(true_values)
         fig, axes = plt.subplots(1, n, figsize=(5 * n, 5), squeeze=False)
         for ihead in range(n):
@@ -110,16 +130,83 @@ class Visualizer:
             )
             ax.set_title(name)
         self._save(fig, "parity_all_heads.png")
+        self.create_plot_global_analysis(
+            true_values, predicted_values, output_names
+        )
 
-    def plot_history(self, total_loss_train, total_loss_val, total_loss_test):
-        fig, ax = plt.subplots(figsize=(6, 4))
+    def plot_history(
+        self,
+        total_loss_train,
+        total_loss_val,
+        total_loss_test,
+        task_loss_train=None,
+        task_loss_val=None,
+        task_loss_test=None,
+        task_weights=None,
+        task_names=None,
+    ):
+        """Loss history: total losses, optional per-task panels, and the
+        raw series pickled next to the figure (``visualizer.py:629-690``)."""
+        import pickle
+
+        with open(os.path.join(self.out_dir, "history_loss.pckl"), "wb") as f:
+            pickle.dump(
+                [
+                    np.asarray(total_loss_train),
+                    np.asarray(total_loss_val),
+                    np.asarray(total_loss_test),
+                    None if task_loss_train is None else np.asarray(task_loss_train),
+                    None if task_loss_val is None else np.asarray(task_loss_val),
+                    None if task_loss_test is None else np.asarray(task_loss_test),
+                    task_weights,
+                    task_names,
+                ],
+                f,
+            )
+        num_tasks = (
+            np.asarray(task_loss_train).shape[1]
+            if task_loss_train is not None and np.asarray(task_loss_train).size
+            else 0
+        )
+        ncol = max(num_tasks, 1)
+        nrow = 2 if num_tasks else 1
+        fig, axs = plt.subplots(
+            nrow, ncol, figsize=(5 * ncol, 4 * nrow), squeeze=False
+        )
+        ax = axs[0][0]
         ax.plot(total_loss_train, label="train")
-        ax.plot(total_loss_val, label="val")
-        ax.plot(total_loss_test, label="test")
+        ax.plot(total_loss_val, ":", label="val")
+        ax.plot(total_loss_test, "--", label="test")
+        ax.set_title("total loss")
         ax.set_xlabel("epoch")
-        ax.set_ylabel("loss")
         ax.set_yscale("log")
         ax.legend()
+        for c in range(1, ncol):
+            axs[0][c].axis("off")
+        for ivar in range(num_tasks):
+            ax = axs[1][ivar]
+            tt = np.asarray(task_loss_train)
+            ax.plot(tt[:, ivar], label="train")
+            if task_loss_val is not None:
+                ax.plot(np.asarray(task_loss_val)[:, ivar], ":", label="val")
+            if task_loss_test is not None:
+                ax.plot(np.asarray(task_loss_test)[:, ivar], "--", label="test")
+            name = (
+                task_names[ivar]
+                if task_names and ivar < len(task_names)
+                else f"task{ivar}"
+            )
+            w = (
+                f", w={task_weights[ivar]:.3g}"
+                if task_weights is not None and ivar < len(task_weights)
+                else ""
+            )
+            ax.set_title(f"{name}{w}")
+            ax.set_xlabel("epoch")
+            ax.set_yscale("log")
+            if ivar == 0:
+                ax.legend()
+        fig.tight_layout()
         self._save(fig, "history_loss.png")
 
     # ---- analysis helpers (visualizer.py:83-105) -------------------------
@@ -249,10 +336,12 @@ class Visualizer:
         self._save(fig, "global_analysis.png")
 
     def create_parity_plot_vector(
-        self, true_values, predicted_values, ihead=0, output_name=None, dim=None
+        self, true_values, predicted_values, ihead=0, output_name=None,
+        dim=None, iepoch=None,
     ):
         """Vector-output parity: one panel per component
         (``visualizer.py:467-517``)."""
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
         t = np.asarray(true_values[ihead])
         p = np.asarray(predicted_values[ihead])
         d = dim or self.head_dims[ihead]
@@ -265,13 +354,15 @@ class Visualizer:
             ax.scatter(t[:, c], p[:, c], s=4, alpha=0.5)
             self.add_identity(ax, "r--", linewidth=1)
             ax.set_title(f"{name}[{c}]")
-        self._save(fig, f"parity_vector_{name}.png")
+        self._save(fig, f"parity_vector_{name}{suffix}.png")
 
     def create_parity_plot_and_error_histogram_scalar(
-        self, true_values, predicted_values, ihead=0, output_name=None
+        self, true_values, predicted_values, ihead=0, output_name=None,
+        iepoch=None,
     ):
         """Scalar-head combined panel: parity scatter beside its error
         histogram (``visualizer.py:281-385``)."""
+        suffix = f"_epoch{iepoch}" if iepoch is not None else ""
         t = np.asarray(true_values[ihead]).reshape(-1)
         p = np.asarray(predicted_values[ihead]).reshape(-1)
         name = output_name or f"head{ihead}"
@@ -285,14 +376,16 @@ class Visualizer:
         ax = axes[0][1]
         ax.hist(p - t, bins=40)
         ax.set_xlabel(f"error {name}")
-        self._save(fig, f"parity_and_hist_{name}.png")
+        self._save(fig, f"parity_and_hist_{name}{suffix}.png")
 
     def create_parity_plot_per_node_vector(
-        self, true_values, predicted_values, ihead=0, output_name=None, dim=None
+        self, true_values, predicted_values, ihead=0, output_name=None,
+        dim=None, iepoch=None,
     ):
         """Vector node-head parity grouped by node position within the
         graph: one row per node, one column per component (fixed-size
         graphs; ``visualizer.py:519-612``)."""
+        del iepoch  # accepted for dispatch-signature symmetry
         if not self.num_nodes_list or len(set(self.num_nodes_list)) != 1:
             return  # variable graph size: per-node grouping undefined
         num_nodes = int(self.num_nodes_list[0])
@@ -316,10 +409,12 @@ class Visualizer:
         self._save(fig, f"parity_per_node_vector_{name}.png")
 
     def create_error_histogram_per_node(
-        self, true_values, predicted_values, ihead=0, output_name=None
+        self, true_values, predicted_values, ihead=0, output_name=None,
+        iepoch=None,
     ):
         """Node-head error histogram grouped by node position within the
         graph (fixed-size graphs; ``visualizer.py:387-465``)."""
+        del iepoch  # accepted for dispatch-signature symmetry
         if not self.num_nodes_list or len(set(self.num_nodes_list)) != 1:
             return  # variable graph size: per-node grouping undefined
         num_nodes = int(self.num_nodes_list[0])

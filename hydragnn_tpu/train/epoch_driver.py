@@ -87,13 +87,27 @@ def train_validate_test(
     total_loss_train = np.zeros(num_epoch)
     total_loss_val = np.zeros(num_epoch)
     total_loss_test = np.zeros(num_epoch)
+    num_tasks = trainer.model.num_heads
+    task_loss_train = np.zeros((num_epoch, num_tasks))
+    task_weights = list(getattr(trainer.model, "loss_weights", []) or [])
+    task_names = config_nn["Variables_of_interest"].get("output_names")
     skip_valtest = int(os.getenv("HYDRAGNN_VALTEST", "1")) == 0
 
     # device-resident mode: stage the (collated) training set in HBM once;
     # every epoch is then a single scan dispatch with no H2D traffic
     staged = None
     if _env_flag("HYDRAGNN_DEVICE_RESIDENT", training, "device_resident_dataset"):
-        staged = trainer.stage_batches(list(train_loader))
+        try:
+            staged = trainer.stage_batches(list(train_loader))
+        except ValueError:
+            # bucketed layouts emit mixed batch shapes, which cannot stack
+            # into one HBM-resident scan — train on the streaming path
+            print_distributed(
+                verbosity,
+                "device_resident_dataset: batches are not shape-uniform "
+                "(bucketed layout?) — falling back to streaming",
+            )
+            staged = None
 
     # whole-training dispatch: fit_chunk_epochs > 0 runs training in chunks
     # of N epochs, each chunk ONE XLA program (on-device plateau LR, early
@@ -109,6 +123,8 @@ def train_validate_test(
         total_loss_train[ep] = train_loss
         total_loss_val[ep] = val_loss
         total_loss_test[ep] = test_loss
+        tt = np.atleast_1d(np.asarray(train_tasks))
+        task_loss_train[ep, : min(len(tt), num_tasks)] = tt[:num_tasks]
         print_distributed(
             verbosity,
             f"Epoch: {ep:04d}, Train Loss: {train_loss:.8f}, "
@@ -291,6 +307,9 @@ def train_validate_test(
             total_loss_train,
             total_loss_val,
             total_loss_test,
+            task_loss_train=task_loss_train,
+            task_weights=task_weights,
+            task_names=task_names,
         )
         visualizer.create_plot_global(
             true_values,

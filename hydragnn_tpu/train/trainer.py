@@ -470,6 +470,14 @@ class Trainer(PredictMixin):
         tr.start("train")
 
         def _flush(state, rng, acc, group):
+            # only FULL K-groups take the multi-step scan: a partial group
+            # would compile a fresh scan program per novel length (bucketed
+            # layouts hit this at every segment boundary) — stream partial
+            # groups through the single-step program instead
+            if 1 < len(group) < K:
+                for b in group:
+                    state, rng, acc = _flush(state, rng, acc, [b])
+                return state, rng, acc
             if len(group) > 1:
                 from hydragnn_tpu.graph.batch import stack_batches
 
@@ -491,12 +499,25 @@ class Trainer(PredictMixin):
             tr.stop("train_step")
             return state, rng, self._acc_add(acc, metrics, multi=False)
 
+        def _shape_key(b):
+            # ALL leaf shapes (incl. extras: triplet tables, neighbor
+            # lists) — two buckets can share node/edge/graph pads while
+            # their t_pad or k widths differ, and those must not stack
+            return tuple(
+                tuple(a.shape) for a in jax.tree_util.tree_leaves(b)
+            )
+
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
                 break
             if K == 1:
                 state, rng, acc = _flush(state, rng, acc, [batch])
                 continue
+            # bucketed layouts interleave batch shapes; a stack group must
+            # be shape-uniform, so a shape change flushes the open group
+            if pending and _shape_key(batch) != _shape_key(pending[0]):
+                state, rng, acc = _flush(state, rng, acc, pending)
+                pending = []
             pending.append(batch)
             if len(pending) == K:
                 state, rng, acc = _flush(state, rng, acc, pending)

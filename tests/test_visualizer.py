@@ -46,6 +46,15 @@ def pytest_visualizer_catalog(tmp_path):
         viz.plot_history(
             np.geomspace(1, 0.1, 5), np.geomspace(1, 0.12, 5), np.geomspace(1, 0.13, 5)
         )
+        # per-task panels + pickled series (reference visualizer.py:629-690)
+        viz.plot_history(
+            np.geomspace(1, 0.1, 5),
+            np.geomspace(1, 0.12, 5),
+            np.geomspace(1, 0.13, 5),
+            task_loss_train=np.abs(rng.standard_normal((5, 2))) + 0.01,
+            task_weights=[0.5, 0.5],
+            task_names=["energy", "forces"],
+        )
 
         out = os.path.join("logs", "vis_test")
         expected = [
@@ -60,6 +69,14 @@ def pytest_visualizer_catalog(tmp_path):
             "parity_and_hist_energy.png",
             "parity_per_node_vector_forces.png",
             "history_loss.png",
+            "history_loss.pckl",
+            # create_scatter_plots dispatch (reference :693-727): vector
+            # head -> component parity; scalar head -> parity+hist panel
+            "parity_vector_forces.png",
+            "parity_and_hist_energy.png",
+            # create_plot_global runs the per-head deep analysis too
+            "energy_scatter_condm_err.png",
+            "forces_scatter_condm_err.png",
         ]
         for f in expected:
             assert os.path.isfile(os.path.join(out, f)), f
