@@ -21,6 +21,44 @@ from hydragnn_tpu.graph import segment_sum
 torch_weight_init = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
 
 
+def torch_bias_init(fan_in: int):
+    """torch.nn.Linear's bias init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)).
+    One shared factory so TorchLinear and SplitLinear stay init-identical
+    by construction (SplitLinear's checkpoint/seed parity depends on it)."""
+    bound = 1.0 / jnp.sqrt(fan_in)
+    return lambda key, shape: jax.random.uniform(
+        key, shape, minval=-bound, maxval=bound
+    )
+
+
+class SplitLinear(nn.Module):
+    """Parameter-compatible with ``TorchLinear(features)`` applied to a
+    concatenated ``[..., fan_in]`` input, but exposing kernel SLICES so a
+    caller can exploit linearity: ``concat([a, b]) @ W == a @ W[:da] +
+    b @ W[da:]``. Same param names ("kernel"/"bias"), shapes and init as
+    TorchLinear — checkpoints and seeded-init trajectories are unchanged;
+    only the order of floating-point contractions differs."""
+
+    features: int
+    fan_in: int
+
+    def setup(self):
+        self.kernel = self.param(
+            "kernel", torch_weight_init, (self.fan_in, self.features)
+        )
+        self.bias = self.param(
+            "bias", torch_bias_init(self.fan_in), (self.features,)
+        )
+
+    def piece(self, x, start: int):
+        """``x @ kernel[start : start + x.shape[-1]]`` — one concat
+        segment's contribution (no bias; add :attr:`bias` once)."""
+        return x @ self.kernel[start : start + x.shape[-1]]
+
+    def __call__(self, x):
+        return x @ self.kernel + self.bias
+
+
 class TorchLinear(nn.Module):
     """Dense layer with torch.nn.Linear's default initialization."""
 
@@ -33,13 +71,8 @@ class TorchLinear(nn.Module):
         kernel = self.param("kernel", torch_weight_init, (fan_in, self.features))
         y = x @ kernel
         if self.use_bias:
-            bound = 1.0 / jnp.sqrt(fan_in)
             bias = self.param(
-                "bias",
-                lambda key, shape: jax.random.uniform(
-                    key, shape, minval=-bound, maxval=bound
-                ),
-                (self.features,),
+                "bias", torch_bias_init(fan_in), (self.features,)
             )
             y = y + bias
         return y
