@@ -10,7 +10,10 @@ Mirrors ``examples/open_catalyst_2020/train.py`` in the reference:
   ``--preload``   copy shards into RAM (slow filesystems);
   ``--ddstore``   wrap the shards in the distributed in-memory sample store
                   so each process holds one partition and fetches remote
-                  samples on demand (``train.py:308-347``).
+                  samples on demand (``train.py:308-347``);
+  ``--ddstore_width=W``  replicate the dataset across blocks of W ranks so
+                  every fetch resolves inside the caller's block
+                  (``hydragnn/utils/distdataset.py:43-46`` analog).
 
 Ingestion goes through the REAL OC20 format: structures are read from
 ``.extxyz`` files (``--data_dir`` to point at a directory of real OC20
@@ -126,17 +129,26 @@ def preonly(config, modelname, num_samples):
     print(f"rank {rank}: wrote {len(trainset)}/{len(valset)}/{len(testset)}")
 
 
-def load_split(modelname, name, preload=False, ddstore=False):
+def load_split(modelname, name, preload=False, ddstore=False, width=None):
     base = ShardDataset(f"dataset/{modelname}_{name}", preload=preload)
     if ddstore:
-        from hydragnn_tpu.data.distdataset import DistDataset
+        from hydragnn_tpu.data.distdataset import (
+            DistDataset,
+            subgroup_local_indices,
+        )
 
         # each process serves ITS contiguous partition; get() on any other
-        # index fetches from the owning process over the store's transport
+        # index fetches from the owning process over the store's transport.
+        # With --ddstore_width the partition is per-SUBGROUP (blocks of
+        # `width` ranks each holding a full replica) so fetches stay
+        # node-local, matching the reference's ddstore_width
+        # (hydragnn/utils/distdataset.py:43-46).
         world, rank = get_comm_size_and_rank()
-        mine = list(nsplit(range(len(base)), world))[rank]
+        mine = subgroup_local_indices(len(base), rank, world, width)
         local = [base[i] for i in mine]
-        return DistDataset(local, rank=rank, world=world)
+        return DistDataset(
+            local, rank=rank, world=world, subgroup_width=width
+        )
     return base
 
 
@@ -152,9 +164,13 @@ def main():
 
     preload = bool(example_arg("preload"))
     ddstore = bool(example_arg("ddstore"))
-    trainset = load_split(modelname, "trainset", preload, ddstore)
-    valset = load_split(modelname, "valset", preload, ddstore)
-    testset = load_split(modelname, "testset", preload, ddstore)
+    width = example_arg("ddstore_width")
+    if width is True:  # bare flag: refuse to guess a block width
+        raise SystemExit("--ddstore_width needs a value, e.g. --ddstore_width=4")
+    width = int(width) if width else None
+    trainset = load_split(modelname, "trainset", preload, ddstore, width)
+    valset = load_split(modelname, "valset", preload, ddstore, width)
+    testset = load_split(modelname, "testset", preload, ddstore, width)
     if ddstore:
         for ds in (trainset, valset, testset):
             ds.epoch_begin()

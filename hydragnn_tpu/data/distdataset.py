@@ -69,8 +69,42 @@ def _load():
 _PORT_BLOCKS = iter(range(10_000))
 
 
+def subgroup_of(rank: int, world: int, width: Optional[int]):
+    """(group, group_rank, group_size, group_start) for a ``ddstore_width``
+    style split: consecutive blocks of ``width`` ranks form replication
+    subgroups (reference: ``hydragnn/utils/distdataset.py:43-46`` splits the
+    MPI world by ``rank // ddstore_width``). The trailing group may be
+    smaller when ``world % width != 0``."""
+    if width is None or width <= 0 or width >= world:
+        return 0, rank, world, 0
+    group = rank // width
+    start = group * width
+    return group, rank - start, min(width, world - start), start
+
+
+def subgroup_local_indices(
+    n_total: int, rank: int, world: int, width: Optional[int] = None
+) -> range:
+    """Global sample indices THIS rank loads so every subgroup of ``width``
+    ranks collectively holds the FULL dataset (samples replicate across
+    subgroups; each subgroup partitions them contiguously). With no width
+    this is the plain contiguous world partition."""
+    _, grank, gsize, _ = subgroup_of(rank, world, width)
+    base, rem = divmod(n_total, gsize)
+    start = grank * base + min(grank, rem)
+    return range(start, start + base + (1 if grank < rem else 0))
+
+
 class DistSampleStore:
-    """Low-level variable-oriented store (pyddstore.PyDDStore parity)."""
+    """Low-level variable-oriented store (pyddstore.PyDDStore parity).
+
+    ``subgroup_width`` is the ``ddstore_width`` analog: the world splits
+    into consecutive blocks of that many ranks, each block serving a full
+    replica of the dataset partitioned among its members, so every get()
+    resolves within the caller's block (node-local at pod scale). The C++
+    core is simply instantiated with the subgroup as its world — ranks
+    outside the block are not even in its address list, making
+    cross-subgroup traffic impossible by construction."""
 
     def __init__(
         self,
@@ -78,12 +112,25 @@ class DistSampleStore:
         world: int,
         addresses: Optional[List[str]] = None,
         base_port: Optional[int] = None,
+        subgroup_width: Optional[int] = None,
     ):
         self._lib = _load()
+        self.global_rank = rank
+        self.global_world = world
         if base_port is None:
             base_port = 23450 + next(_PORT_BLOCKS) * world
         if addresses is None:
             addresses = [f"127.0.0.1:{base_port + r}" for r in range(world)]
+        if len(addresses) != world:
+            raise ValueError(
+                f"need {world} addresses (one per GLOBAL rank), got "
+                f"{len(addresses)}"
+            )
+        group, grank, gsize, gstart = subgroup_of(rank, world, subgroup_width)
+        self.group_index = group
+        self.group_start = gstart
+        addresses = addresses[gstart : gstart + gsize]
+        rank, world = grank, gsize
         self.rank = rank
         self.world = world
         self._h = self._lib.dds_create(
@@ -274,10 +321,25 @@ class DistDataset:
         samples_per_rank: Optional[List[int]] = None,
         base_port: Optional[int] = None,
         max_counts: Optional[Dict[str, int]] = None,
+        subgroup_width: Optional[int] = None,
     ):
-        self.store = DistSampleStore(rank, world, addresses, base_port)
+        """``subgroup_width``: replicate the dataset across blocks of that
+        many ranks (``ddstore_width`` analog) — pass ``local_samples``
+        sharded by :func:`subgroup_local_indices` so each block holds a
+        full replica; ``samples_per_rank`` / the gathered partition then
+        describe the caller's OWN subgroup."""
+        self.store = DistSampleStore(
+            rank, world, addresses, base_port, subgroup_width=subgroup_width
+        )
         if samples_per_rank is None:
-            samples_per_rank = _gather_partition(len(local_samples), world)
+            per_global_rank = _gather_partition(len(local_samples), world)
+            g0 = self.store.group_start
+            samples_per_rank = per_global_rank[g0 : g0 + self.store.world]
+        elif len(samples_per_rank) != self.store.world:
+            raise ValueError(
+                f"samples_per_rank must cover the subgroup "
+                f"({self.store.world} ranks), got {len(samples_per_rank)}"
+            )
         self.store.set_partition(samples_per_rank)
         ss = local_samples
         n = len(ss)

@@ -169,6 +169,75 @@ def pytest_diststore_remote_fetch():
         ds1.close()
 
 
+def pytest_diststore_subgroup_replication():
+    """ddstore_width analog: with subgroup_width the world splits into
+    blocks that each hold a FULL replica, and every get() resolves inside
+    the caller's block. Out-of-block ranks get dead addresses here, so any
+    cross-subgroup fetch would error — the sweep passing proves locality."""
+    from hydragnn_tpu.data.distdataset import (
+        DistDataset,
+        subgroup_local_indices,
+        subgroup_of,
+    )
+
+    # split arithmetic incl. the smaller trailing group
+    assert subgroup_of(0, 4, 2) == (0, 0, 2, 0)
+    assert subgroup_of(3, 4, 2) == (1, 1, 2, 2)
+    assert subgroup_of(3, 4, 3) == (1, 0, 1, 3)  # trailing group of one
+    assert subgroup_of(2, 4, None) == (0, 2, 4, 0)
+    assert list(subgroup_local_indices(5, 3, 4, 3)) == [0, 1, 2, 3, 4]
+    cover = [list(subgroup_local_indices(7, r, 4, 2)) for r in range(4)]
+    assert cover[0] + cover[1] == list(range(7))  # group 0 = full replica
+    assert cover[2] + cover[3] == list(range(7))  # group 1 = full replica
+
+    rng = np.random.default_rng(7)
+    all_samples = [_mk(rng, int(rng.integers(3, 9))) for _ in range(30)]
+    mc = {"nodes": 8, "edges": 16}
+    dead = "127.0.0.1:9"  # nothing listens there — contact would fail
+
+    def shard(rank):
+        return [all_samples[i] for i in subgroup_local_indices(30, rank, 4, 2)]
+
+    def spr(rank):
+        return [
+            len(subgroup_local_indices(30, r, 4, 2))
+            for r in range(*{0: (0, 2), 1: (2, 4)}[rank // 2])
+        ]
+
+    # group 0 (ranks 0,1) with ranks 2,3 unreachable
+    addrs0 = ["127.0.0.1:23870", "127.0.0.1:23871", dead, dead]
+    ds0 = DistDataset(shard(0), rank=0, world=4, addresses=addrs0,
+                      samples_per_rank=spr(0), max_counts=mc,
+                      subgroup_width=2)
+    ds1 = DistDataset(shard(1), rank=1, world=4, addresses=addrs0,
+                      samples_per_rank=spr(1), max_counts=mc,
+                      subgroup_width=2)
+    # group 1 (ranks 2,3) with ranks 0,1 unreachable — independent replica
+    addrs1 = [dead, dead, "127.0.0.1:23872", "127.0.0.1:23873"]
+    ds2 = DistDataset(shard(2), rank=2, world=4, addresses=addrs1,
+                      samples_per_rank=spr(2), max_counts=mc,
+                      subgroup_width=2)
+    ds3 = DistDataset(shard(3), rank=3, world=4, addresses=addrs1,
+                      samples_per_rank=spr(3), max_counts=mc,
+                      subgroup_width=2)
+    try:
+        assert ds0.store.group_index == 0 and ds3.store.group_index == 1
+        assert ds0.store.world == 2  # the subgroup IS the store's world
+        for ds in (ds0, ds1, ds2, ds3):
+            assert len(ds) == 30  # global index space in every block
+            ds.epoch_begin()
+        for idx in range(30):  # full sweep: local + intra-block remote
+            _assert_same(all_samples[idx], ds0.get(idx))
+            _assert_same(all_samples[idx], ds3.get(idx))
+        _assert_same(all_samples[0], ds1.get(0))
+        _assert_same(all_samples[29], ds2.get(29))
+        for ds in (ds0, ds1, ds2, ds3):
+            ds.epoch_end()
+    finally:
+        for ds in (ds0, ds1, ds2, ds3):
+            ds.close()
+
+
 def pytest_region_timer_calltree():
     from hydragnn_tpu.native.regiontimer import NativeRegionTimer
 
