@@ -168,6 +168,8 @@ def main():
     if width is True:  # bare flag: refuse to guess a block width
         raise SystemExit("--ddstore_width needs a value, e.g. --ddstore_width=4")
     width = int(width) if width else None
+    if width and not ddstore:
+        raise SystemExit("--ddstore_width requires --ddstore")
     trainset = load_split(modelname, "trainset", preload, ddstore, width)
     valset = load_split(modelname, "valset", preload, ddstore, width)
     testset = load_split(modelname, "testset", preload, ddstore, width)

@@ -594,59 +594,72 @@ class GraphLoader:
         if self.prefetch <= 0:
             yield from self._batches()
             return
-        import queue
-        import threading
+        yield from prefetch_iter(
+            self._batches(), self.prefetch, name="graphloader-prefetch"
+        )
 
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        sentinel = object()
-        stop = threading.Event()
-        err = []
 
-        def worker():
+def prefetch_iter(source, depth: int, fn=None, name: str = "prefetch"):
+    """Bounded background-thread pipeline stage: applies ``fn`` (identity
+    if None) to each item of ``source`` on a worker thread, up to ``depth``
+    results queued ahead of the consumer, yielded in order.
+
+    Shared by the loader's collation prefetch and the trainer's
+    double-buffered device transfers. The shutdown protocol matters: puts
+    are stop-aware timed puts, so an abandoned consumer (early ``break``
+    on HYDRAGNN_MAX_NUM_BATCH, or an exception while something retains the
+    frame chain) cannot leak a thread pinning collated or device-resident
+    batches; worker errors surface on the consumer side."""
+    import queue
+    import threading
+
+    if fn is None:
+        fn = lambda x: x  # noqa: E731
+    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    sentinel = object()
+    stop = threading.Event()
+    err = []
+
+    def _put_stop_aware(item) -> bool:
+        while not stop.is_set():
             try:
-                for b in self._batches():
-                    # bounded put that notices consumer abandonment, so an
-                    # early `break` in the epoch loop (HYDRAGNN_MAX_NUM_BATCH
-                    # cap) cannot leak a thread pinning collated batches
-                    while not stop.is_set():
-                        try:
-                            q.put(b, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:  # surface collate errors on the consumer
-                err.append(e)
-            finally:
-                # stop-aware sentinel delivery: on abandonment nobody reads
-                # it and a blocking put could wedge on a full queue
-                while not stop.is_set():
-                    try:
-                        q.put(sentinel, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
-        t = threading.Thread(target=worker, daemon=True, name="graphloader-prefetch")
-        t.start()
+    def worker():
+        try:
+            for b in source:
+                if not _put_stop_aware(fn(b)):
+                    return
+        except BaseException as e:  # surface on the consumer side
+            err.append(e)
+        finally:
+            # stop-aware sentinel delivery: on abandonment nobody reads it
+            # and a blocking put could wedge on a full queue
+            _put_stop_aware(sentinel)
+
+    t = threading.Thread(target=worker, daemon=True, name=name)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+    finally:
+        stop.set()
+        # unblock a worker stuck on a full queue, then reap it
         try:
             while True:
-                item = q.get()
-                if item is sentinel:
-                    break
-                yield item
-        finally:
-            stop.set()
-            # unblock a worker stuck on a full queue, then reap it
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join()
-        if err:
-            raise err[0]
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join()
+    if err:
+        raise err[0]
 
 
 def create_dataloaders(

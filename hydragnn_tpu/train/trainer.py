@@ -71,6 +71,22 @@ class Trainer(PredictMixin):
                 str(training_config.get("steps_per_dispatch", 1)),
             )
         )
+        # streaming double-buffering: keep this many batches' H2D transfers
+        # in flight AHEAD of the step consuming them, issued from a
+        # background thread (the role of the reference's DDStore
+        # double-buffered loader, train_validate_test.py:459-536). Costs
+        # `depth` extra batches of HBM. Default OFF: measured A/B on the
+        # tunneled dev chip (benchmarks/streaming_bench.py, BASELINE.md)
+        # shows the extra in-flight RPCs CONTEND with dispatch there
+        # (0.64x); jax's async dispatch already overlaps transfer and
+        # compute when the host link is not the bottleneck. Enable on
+        # production TPU-VM hosts via config or HYDRAGNN_DEVICE_PREFETCH.
+        self.device_prefetch = int(
+            os.getenv(
+                "HYDRAGNN_DEVICE_PREFETCH",
+                str(training_config.get("device_prefetch", 0)),
+            )
+        )
 
     # compiled-program accessors: tests and the partitioned trainer reach
     # these by their historical names
@@ -462,42 +478,52 @@ class Trainer(PredictMixin):
         n = max(a[1], 1.0)
         return a[0] / n, a[2:] / n
 
-    def train_epoch(self, state, loader, rng):
-        acc = None
-        nbatch = _nbatch(loader)
-        K = max(1, self.steps_per_dispatch)
-        pending = []
-        tr.start("train")
+    def _prefetch_put(self, loader, nbatch, depth, put=None):
+        """Yield device-resident batches with up to ``depth`` transfers in
+        flight ahead of the consumer. The transfers are issued from a
+        background thread (shared :func:`prefetch_iter` machinery): both
+        halves of a put's cost — the host-side compaction/assembly (numpy,
+        releases the GIL) and the H2D copy (async RPC on the tunneled
+        link) — overlap the steps already dispatched on earlier batches.
+        ``depth <= 0`` degrades to the strict transfer/step alternation."""
+        put = put or self.put_batch
 
-        def _flush(state, rng, acc, group):
-            # only FULL K-groups take the multi-step scan: a partial group
-            # would compile a fresh scan program per novel length (bucketed
-            # layouts hit this at every segment boundary) — stream partial
-            # groups through the single-step program instead
-            if 1 < len(group) < K:
-                for b in group:
-                    state, rng, acc = _flush(state, rng, acc, [b])
-                return state, rng, acc
-            if len(group) > 1:
-                from hydragnn_tpu.graph.batch import stack_batches
+        def limited():
+            for ibatch, batch in enumerate(loader):
+                if ibatch >= nbatch:
+                    break
+                yield batch
 
+        if depth <= 0:
+            for batch in limited():
                 tr.start("dataload")
-                stacked = self.put_batch_stacked(stack_batches(group))
+                dev = put(batch)
                 tr.stop("dataload")
-                subs = jax.random.split(rng, len(group) + 1)
-                rng = subs[0]
-                tr.start("train_step")
-                state, metrics = self._train_multi(state, stacked, subs[1:])
-                tr.stop("train_step")
-                return state, rng, self._acc_add(acc, metrics, multi=True)
-            tr.start("dataload")
-            batch = self.put_batch(group[0])
+                yield dev
+            return
+        from hydragnn_tpu.data.loaders import prefetch_iter
+
+        it = prefetch_iter(
+            limited(), depth, fn=put, name="hydragnn-device-prefetch"
+        )
+        while True:
+            tr.start("dataload")  # time spent WAITING on the transfer stage
+            try:
+                item = next(it)
+            except StopIteration:
+                tr.stop("dataload")
+                return
             tr.stop("dataload")
-            rng, sub = jax.random.split(rng)
-            tr.start("train_step")
-            state, metrics = self._train_step(state, batch, sub)
-            tr.stop("train_step")
-            return state, rng, self._acc_add(acc, metrics, multi=False)
+            yield item
+
+    @staticmethod
+    def _group_plan(loader, nbatch, K):
+        """Host-side dispatch plan: yield ``K``-long shape-uniform groups
+        (the multi-step scan path) and single batches (everything else).
+        Only FULL K-groups take the scan — a partial group would compile a
+        fresh scan program per novel length (bucketed layouts hit this at
+        every segment boundary) — so partial groups stream through the
+        single-step program."""
 
         def _shape_key(b):
             # ALL leaf shapes (incl. extras: triplet tables, neighbor
@@ -507,25 +533,58 @@ class Trainer(PredictMixin):
                 tuple(a.shape) for a in jax.tree_util.tree_leaves(b)
             )
 
+        pending = []
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
                 break
             if K == 1:
-                state, rng, acc = _flush(state, rng, acc, [batch])
+                yield [batch]
                 continue
             # bucketed layouts interleave batch shapes; a stack group must
             # be shape-uniform, so a shape change flushes the open group
             if pending and _shape_key(batch) != _shape_key(pending[0]):
-                state, rng, acc = _flush(state, rng, acc, pending)
+                for b in pending:
+                    yield [b]
                 pending = []
             pending.append(batch)
             if len(pending) == K:
-                state, rng, acc = _flush(state, rng, acc, pending)
+                yield pending
                 pending = []
-        # trailing partial group: single-step path (a short stack would be a
-        # fresh scan-length compile)
-        for batch in pending:
-            state, rng, acc = _flush(state, rng, acc, [batch])
+        for b in pending:  # trailing partial group: single-step path
+            yield [b]
+
+    def _put_group(self, group):
+        """Transfer stage: a group becomes (device_payload, count). Runs on
+        the prefetch thread when ``device_prefetch > 0`` — so stacked
+        multi-step transfers double-buffer exactly like single batches."""
+        if len(group) > 1:
+            from hydragnn_tpu.graph.batch import stack_batches
+
+            return self.put_batch_stacked(stack_batches(group)), len(group)
+        return self.put_batch(group[0]), 1
+
+    def train_epoch(self, state, loader, rng):
+        acc = None
+        nbatch = _nbatch(loader)
+        K = max(1, self.steps_per_dispatch)
+        tr.start("train")
+        plan = self._group_plan(loader, nbatch, K)
+        for dev, count in self._prefetch_put(
+            plan, float("inf"), self.device_prefetch, put=self._put_group
+        ):
+            if count > 1:
+                subs = jax.random.split(rng, count + 1)
+                rng = subs[0]
+                tr.start("train_step")
+                state, metrics = self._train_multi(state, dev, subs[1:])
+                tr.stop("train_step")
+                acc = self._acc_add(acc, metrics, multi=True)
+            else:
+                rng, sub = jax.random.split(rng)
+                tr.start("train_step")
+                state, metrics = self._train_step(state, dev, sub)
+                tr.stop("train_step")
+                acc = self._acc_add(acc, metrics, multi=False)
         loss, tasks = self._acc_read(acc)  # the epoch's one readback
         tr.stop("train")
         return state, rng, loss, tasks
@@ -533,10 +592,8 @@ class Trainer(PredictMixin):
     def evaluate(self, state, loader, desc="validate"):
         acc = None
         nbatch = _nbatch(loader)
-        for ibatch, batch in enumerate(loader):
-            if ibatch >= nbatch:
-                break
-            batch = self.put_batch(batch)
-            metrics = self._eval_step(state.params, state.batch_stats, batch)
+        depth = self.device_prefetch
+        for dev in self._prefetch_put(loader, nbatch, depth):
+            metrics = self._eval_step(state.params, state.batch_stats, dev)
             acc = self._acc_add(acc, metrics, multi=False)
         return self._acc_read(acc)

@@ -111,6 +111,41 @@ def pytest_multistep_matches_single_step():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def pytest_device_prefetch_matches_sync():
+    """The double-buffered device-prefetch streaming path (transfers
+    issued ahead from a background thread) must reproduce the strict
+    alternate-transfer-and-step trajectory exactly."""
+    batches = _batches(5)
+
+    def run(depth):
+        model = create_model_config(_arch())
+        trainer = Trainer(
+            model,
+            training_config={
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+                "device_prefetch": depth,
+            },
+        )
+        state = trainer.init_state(batches[0])
+        state, _rng, loss, tasks = trainer.train_epoch(
+            state, ListLoader(batches), jax.random.PRNGKey(0)
+        )
+        loss_v, tasks_v = trainer.evaluate(state, ListLoader(batches))
+        return state, loss, tasks, loss_v, tasks_v
+
+    s1, loss1, tasks1, lv1, tv1 = run(0)
+    s2, loss2, tasks2, lv2, tv2 = run(3)
+    assert np.isclose(loss1, loss2, rtol=1e-6), (loss1, loss2)
+    assert np.isclose(lv1, lv2, rtol=1e-6), (lv1, lv2)
+    np.testing.assert_allclose(tasks1, tasks2, rtol=1e-6)
+    np.testing.assert_allclose(tv1, tv2, rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params),
+        jax.tree_util.tree_leaves(s2.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def pytest_multistep_sharded_mesh():
     mesh = make_mesh(8)
     batches = _batches(4)
