@@ -179,6 +179,23 @@ class ShardDataset:
     def num_samples_total(self) -> int:
         return int(self._cum[-1]) if len(self._cum) else 0
 
+    def graph_sizes(self) -> np.ndarray:
+        """Per-sample node counts from the shard count indexes alone — no
+        sample payloads are read, so dataset-wide size scans (layout
+        maxima, ``max_graph_nodes``) stay cheap at millions of samples."""
+        sizes = np.concatenate(
+            [
+                np.array(
+                    [r.sample_rows("x", i) for i in range(r.num_samples)],
+                    dtype=np.int64,
+                )
+                for r in self.readers
+            ]
+        ) if self.readers else np.zeros(0, np.int64)
+        if self.subset is not None:
+            sizes = sizes[np.asarray(self.subset, np.int64)]
+        return sizes
+
     def __len__(self) -> int:
         if self.subset is not None:
             return len(self.subset)

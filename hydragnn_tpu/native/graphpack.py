@@ -186,6 +186,19 @@ class PackReader:
         # single copy out of the mmap, already writeable for downstream use
         return view.view(_NP_DTYPES[dt]).reshape(shape).copy()
 
+    def sample_rows(self, name: str, sample: int) -> int:
+        """Row count of one sample WITHOUT copying its payload (index-only
+        lookup) — lets size scans over huge stores skip the data reads."""
+        vi, _dt, _dims = self.vars[name]
+        rows = ctypes.c_int64()
+        nbytes = ctypes.c_uint64()
+        ptr = self._lib.gpk_sample_ptr(
+            self._h, vi, sample, ctypes.byref(rows), ctypes.byref(nbytes)
+        )
+        if not ptr:
+            raise IndexError(f"{name}[{sample}]")
+        return int(rows.value)
+
     def read_all(self, name: str) -> np.ndarray:
         """The whole concatenated blob, zero-copy view into the mmap."""
         vi, dt, dims = self.vars[name]

@@ -1,0 +1,553 @@
+"""Banded gather/scatter Pallas kernels for dense neighbor aggregation.
+
+The dense path's cost is not FLOPs but the row gather ``table[idx]``
+(``[N, K]`` indices into ``[N, D]``): XLA's TPU gather walks rows at
+~12 GB/s effective (measured, ``benchmarks/agg_profile.py``), and its
+backward is a scatter-add. But packed batches give the indices *banded*
+structure for free: ``collate_graphs`` lays each graph's nodes out
+contiguously and neighbors never leave their graph, so
+``|idx[n, k] - n| < max_graph_nodes``. These kernels exploit that: the
+gather becomes, per 128-row block, a short loop over the ±halo
+neighboring table blocks accumulating ``onehot(local_idx) @ table_block``
+— pure MXU work on VMEM-resident tiles, no random access, messages read
+from HBM exactly once.
+
+``window_gather`` and ``window_scatter_add`` are mutual duals; each is
+the other's VJP, so the backward pass needs no reverse neighbor lists.
+
+Band contract: every valid row index must satisfy
+``|idx[r] - anchor(r)| <= halo_blocks * 128`` where ``anchor(r)`` is the
+first table row of r's block (anchor ratio maps index-blocks to table
+blocks for tables with a different row density, e.g. edge tables).
+Out-of-band indices are silently dropped (forward contributes zero,
+backward drops the gradient) — callers must derive ``halo_blocks`` from
+a static bound (max graph size) that makes violations impossible.
+
+Reference analog: the torch_scatter gather/scatter pair underneath PyG
+message passing (SURVEY.md §2.4); there is no banded trick there because
+CUDA's native gathers are fast — this is TPU-first design, not a port.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 128  # table-row block (MXU-native tile edge)
+_MAX_VMEM_TILE = 8 * 1024 * 1024  # value-tile budget (bytes, f32)
+
+
+def window_enabled(
+    halo_blocks: Optional[int],
+    rows_per_anchor: int,
+    dim: int,
+    env_default: str = "0",
+) -> bool:
+    """Static enablement: ``HYDRAGNN_WINDOW=1`` opts in where legal (halo
+    known, >=64 features, VMEM budget); default OFF. Measured 2026-07-31
+    (v5e, OC20-scale PNA dense bf16): the standalone banded gather is
+    ~1.1-1.3x XLA's in isolation but NEUTRAL end-to-end (XLA fuses its
+    gather with the surrounding mask/stats work — the same
+    fusion-forfeit economics as ops/pallas_segment.py), and the fused
+    stats kernel's K-unrolled body compiles for minutes at K~22. Kept
+    opt-in: parity-proven machinery (the interpreter runs it on CPU),
+    and the banded-scatter VJP needs no reverse lists."""
+    import os
+
+    flag = os.getenv("HYDRAGNN_WINDOW", env_default)
+    if flag != "1" or halo_blocks is None or dim < 64:
+        # below ~64 features the onehot matmuls are degenerate and the
+        # [BR, 1] index/mask operands lane-pad 128x in VMEM — XLA wins
+        return False
+    br = _BLOCK * rows_per_anchor
+    span = 2 * halo_blocks + 1
+    budget = (
+        br * dim * 4  # gathered accumulator
+        + 2 * br * 128 * 4  # idx+mask blocks ([BR, 1] lane-pads to 128)
+        + br * _BLOCK * 4  # onehot tile
+        + span * _BLOCK * dim * 4 * 2  # double-buffered table tiles
+    )
+    return budget <= _MAX_VMEM_TILE
+
+
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _pad_rows(a, mult, fill=0):
+    pad = (-a.shape[0]) % mult
+    if pad:
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        a = jnp.pad(a, widths, constant_values=fill)
+    return a, pad
+
+
+def _spans(halo, ratio):
+    """Window geometry. Gather: idx block i reads table blocks
+    ``(i*num)//den + j - halo`` for j in [0, 2*halo + ceil(num/den));
+    the ceil term covers the blocks an anchor block's scaled image spans.
+    Scatter (the dual): out block i reads value blocks
+    ``(i*den)//num + j - off`` with loose-but-sound bounds (extra visits
+    only cost compute; matching is exact)."""
+    num, den = ratio
+    cg = -(-num // den)
+    g_span = 2 * halo + cg
+    s_off = ((halo + cg - 1) * den + num - 1) // num
+    s_span = ((2 * halo + cg - 1) * den + num - 1) // num + 1
+    return g_span, s_off, s_span
+
+
+
+def _table_map(j, halo, tblocks, ratio):
+    """Index map for the j-th window table input: idx block i reads table
+    block ``clip((i*num)//den + j - halo)``; the kernel masks the clipped
+    (out-of-range) visits."""
+
+    def f(i, *, _j=j, _h=halo, _t=tblocks, _r=ratio):
+        return (jnp.clip((i * _r[0]) // _r[1] + _j - _h, 0, _t - 1), 0)
+
+    return f
+
+
+def _accumulate_gather(idx_col, tables, i, halo, tblocks, ratio):
+    """Shared banded-gather body: f32 [BR, D] accumulation of
+    ``onehot(local idx) @ table_block`` over the unrolled ±halo window.
+    The validity test (band bounds + local-index equality) lives ONLY
+    here so forward and backward kernels cannot diverge."""
+    cols = jax.lax.broadcasted_iota(jnp.int32, (idx_col.shape[0], _BLOCK), 1)
+    acc = jnp.zeros((idx_col.shape[0], tables[0].shape[1]), jnp.float32)
+    for j, tref in enumerate(tables):
+        tb = (i * ratio[0]) // ratio[1] + j - halo
+        valid = jnp.logical_and(tb >= 0, tb < tblocks)
+        onehot = jnp.where(
+            jnp.logical_and(idx_col - tb * _BLOCK == cols, valid), 1.0, 0.0
+        ).astype(tref.dtype)
+        acc += jax.lax.dot_general(
+            onehot,
+            tref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return acc
+
+
+def _gather_kernel(*refs, halo, tblocks, ratio, span):
+    from jax.experimental import pallas as pl
+
+    idx_ref = refs[0]
+    tables = refs[1 : 1 + span]
+    out_ref = refs[1 + span]
+    out_ref[:] = _accumulate_gather(
+        idx_ref[:], tables, pl.program_id(0), halo, tblocks, ratio
+    )
+
+
+def _scatter_kernel(idx_ref, values_ref, out_ref, *, off, vblocks, ratio):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)  # output table block
+    j = pl.program_id(1)
+    vb = (i * ratio[1]) // ratio[0] + j - off  # contributing value block
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(jnp.logical_and(vb >= 0, vb < vblocks))
+    def _():
+        local = idx_ref[:] - i * _BLOCK  # [BR, 1] targets within this block
+        rows = jax.lax.broadcasted_iota(
+            jnp.int32, (_BLOCK, local.shape[0]), 0
+        )
+        onehot_t = (rows == local.reshape(1, -1)).astype(values_ref.dtype)
+        out_ref[:] += jax.lax.dot_general(
+            onehot_t,
+            values_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def _gather_impl(table, idx, halo_blocks, rows_per_anchor, ratio):
+    from jax.experimental import pallas as pl
+
+    r = idx.shape[0]
+    br = _BLOCK * rows_per_anchor
+    table, _ = _pad_rows(table, _BLOCK)
+    idx, _ = _pad_rows(idx.astype(jnp.int32), br, fill=-1)
+    tblocks = table.shape[0] // _BLOCK
+    iblocks = idx.shape[0] // br
+    dim = table.shape[1]
+    g_span, _, _ = _spans(halo_blocks, ratio)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _gather_kernel,
+            halo=halo_blocks,
+            tblocks=tblocks,
+            ratio=ratio,
+            span=g_span,
+        ),
+        out_shape=jax.ShapeDtypeStruct((idx.shape[0], dim), jnp.float32),
+        grid=(iblocks,),
+        in_specs=[pl.BlockSpec((br, 1), lambda i: (i, 0))]
+        + [
+            pl.BlockSpec((_BLOCK, dim), _table_map(j, halo_blocks, tblocks, ratio)) for j in range(g_span)
+        ],
+        out_specs=pl.BlockSpec((br, dim), lambda i: (i, 0)),
+        interpret=_interpret(),
+    )(idx.reshape(-1, 1), *([table] * g_span))
+    return out[:r]
+
+
+def _scatter_impl(values, idx, num_rows, halo_blocks, rows_per_anchor, ratio):
+    from jax.experimental import pallas as pl
+
+    br = _BLOCK * rows_per_anchor
+    values, _ = _pad_rows(values, br)
+    idx, _ = _pad_rows(idx.astype(jnp.int32), br, fill=-1)
+    out_rows = num_rows + ((-num_rows) % _BLOCK)
+    vblocks = values.shape[0] // br
+    oblocks = out_rows // _BLOCK
+    dim = values.shape[1]
+    _, s_off, s_span = _spans(halo_blocks, ratio)
+
+    def _vmap(i, j, *, _o=s_off, _v=vblocks, _r=ratio):
+        return (jnp.clip((i * _r[1]) // _r[0] + j - _o, 0, _v - 1), 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _scatter_kernel, off=s_off, vblocks=vblocks, ratio=ratio
+        ),
+        out_shape=jax.ShapeDtypeStruct((out_rows, dim), jnp.float32),
+        grid=(oblocks, s_span),
+        in_specs=[
+            pl.BlockSpec((br, 1), _vmap),
+            pl.BlockSpec((br, dim), _vmap),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK, dim), lambda i, j: (i, 0)),
+        interpret=_interpret(),
+    )(idx.reshape(-1, 1), values)
+    return out[:num_rows]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def window_gather(
+    table,
+    idx,
+    halo_blocks: int,
+    rows_per_anchor: int = 1,
+    ratio: Tuple[int, int] = (1, 1),
+):
+    """``table[idx]`` for banded ``idx`` — [R] flat indices into [N, D].
+
+    ``rows_per_anchor``: idx rows per table-anchor row (K for flattened
+    [N, K] neighbor lists). ``ratio=(num, den)``: anchor mapping for
+    tables with different row density (idx block i targets table block
+    ``(i*num)//den``); (1, 1) for node-table gathers. Out-of-band or
+    negative indices yield zero rows. Returns f32 [R, D]."""
+    return _gather_impl(table, idx, halo_blocks, rows_per_anchor, ratio)
+
+
+def _wg_fwd(table, idx, halo_blocks, rows_per_anchor, ratio):
+    out = _gather_impl(table, idx, halo_blocks, rows_per_anchor, ratio)
+    return out, (idx, table.shape[0], jnp.zeros((), table.dtype))
+
+
+def _wg_bwd(halo_blocks, rows_per_anchor, ratio, res, g):
+    idx, n, proto = res
+    gt = _scatter_impl(g, idx, n, halo_blocks, rows_per_anchor, ratio)
+    return gt.astype(proto.dtype), None
+
+
+window_gather.defvjp(_wg_fwd, _wg_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def window_scatter_add(
+    values,
+    idx,
+    num_rows: int,
+    halo_blocks: int,
+    rows_per_anchor: int = 1,
+    ratio: Tuple[int, int] = (1, 1),
+):
+    """Scatter-add banded rows: ``out[idx[r]] += values[r]`` -> [num_rows, D].
+
+    Dual of :func:`window_gather` (same band contract); negative indices
+    are dropped. Returns f32."""
+    return _scatter_impl(
+        values, idx, num_rows, halo_blocks, rows_per_anchor, ratio
+    )
+
+
+def _ws_fwd(values, idx, num_rows, halo_blocks, rows_per_anchor, ratio):
+    out = _scatter_impl(
+        values, idx, num_rows, halo_blocks, rows_per_anchor, ratio
+    )
+    return out, (idx, jnp.zeros((), values.dtype))
+
+
+def _ws_bwd(num_rows, halo_blocks, rows_per_anchor, ratio, res, g):
+    idx, proto = res
+    gv = _gather_impl(g, idx, halo_blocks, rows_per_anchor, ratio)
+    return gv.astype(proto.dtype), None
+
+
+window_scatter_add.defvjp(_ws_fwd, _ws_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused banded gather + PNA statistics: the [N, K, D] gathered tensor never
+# exists in HBM. Forward gathers each node block's neighbor messages into
+# VMEM (onehot @ table-block dots) and reduces mean/std/min/max/count over
+# K in-register; backward RECOMPUTES the gathered tile (cheaper than saving
+# 2*K*D floats per node) to form the per-slot gradient, which the dual
+# banded scatter routes back to the message table. Semantics exactly match
+# dense_moments + dense_minmax (incl. the equal-split min/max tie gradient
+# and the relu'd variance clamp).
+# ---------------------------------------------------------------------------
+
+_STD_EPS = 1e-5
+_BIG = 1e30
+
+
+def _gstats_fwd_kernel(*refs, halo, tblocks, ratio, span, k):
+    from jax.experimental import pallas as pl
+
+    idx_ref, mask_ref = refs[0], refs[1]
+    tables = refs[2 : 2 + span]
+    mean_ref, std_ref, mn_ref, mx_ref, cnt_ref = refs[2 + span :]
+    i = pl.program_id(0)
+    acc = _accumulate_gather(idx_ref[:], tables, i, halo, tblocks, ratio)
+    b = acc.shape[0] // k
+    d = acc.shape[1]
+    a3 = acc.reshape(b, k, d)
+    m2 = mask_ref[:].reshape(b, k).astype(jnp.float32)
+    # slot-wise accumulation: only [b, d]-sized temporaries stay live (a
+    # vectorized K-axis reduce would hold ~6 [BR, D] temps and blow the
+    # 16MB VMEM scope at k*dim >= ~4k)
+    s = jnp.zeros((b, d), jnp.float32)
+    sq = jnp.zeros((b, d), jnp.float32)
+    mn = jnp.full((b, d), _BIG, jnp.float32)
+    mx = jnp.full((b, d), -_BIG, jnp.float32)
+    cnt = jnp.zeros((b, 1), jnp.float32)
+    for kk in range(k):
+        hk = a3[:, kk, :]
+        mk = m2[:, kk][:, None]
+        hm = hk * mk
+        s += hm
+        sq += hm * hk
+        mn = jnp.minimum(mn, jnp.where(mk > 0, hk, _BIG))
+        mx = jnp.maximum(mx, jnp.where(mk > 0, hk, -_BIG))
+        cnt += mk
+    deg = jnp.maximum(cnt, 1.0)
+    mean = s / deg
+    std = jnp.sqrt(jnp.maximum(sq / deg - mean * mean, 0.0) + _STD_EPS)
+    has = cnt > 0
+    mean_ref[:] = mean
+    std_ref[:] = std
+    mn_ref[:] = jnp.where(has, mn, 0.0)
+    mx_ref[:] = jnp.where(has, mx, 0.0)
+    cnt_ref[:] = cnt
+
+
+def _gstats_bwd_kernel(*refs, halo, tblocks, ratio, span, k):
+    from jax.experimental import pallas as pl
+
+    idx_ref, mask_ref, gmean_ref, gstd_ref, gmn_ref, gmx_ref = refs[:6]
+    tables = refs[6 : 6 + span]
+    gslot_ref = refs[6 + span]
+    i = pl.program_id(0)
+    acc = _accumulate_gather(idx_ref[:], tables, i, halo, tblocks, ratio)
+    b = acc.shape[0] // k
+    d = acc.shape[1]
+    a3 = acc.reshape(b, k, d)
+    m2 = mask_ref[:].reshape(b, k).astype(jnp.float32)
+    # pass 1: recompute the statistics slot-wise (same arithmetic as fwd)
+    s = jnp.zeros((b, d), jnp.float32)
+    sq = jnp.zeros((b, d), jnp.float32)
+    mn = jnp.full((b, d), _BIG, jnp.float32)
+    mx = jnp.full((b, d), -_BIG, jnp.float32)
+    cnt = jnp.zeros((b, 1), jnp.float32)
+    for kk in range(k):
+        hk = a3[:, kk, :]
+        mk = m2[:, kk][:, None]
+        hm = hk * mk
+        s += hm
+        sq += hm * hk
+        mn = jnp.minimum(mn, jnp.where(mk > 0, hk, _BIG))
+        mx = jnp.maximum(mx, jnp.where(mk > 0, hk, -_BIG))
+        cnt += mk
+    deg = jnp.maximum(cnt, 1.0)
+    mean = s / deg
+    var_pre = sq / deg - mean * mean
+    std = jnp.sqrt(jnp.maximum(var_pre, 0.0) + _STD_EPS)
+    n_mn = jnp.zeros((b, d), jnp.float32)
+    n_mx = jnp.zeros((b, d), jnp.float32)
+    for kk in range(k):
+        hk = a3[:, kk, :]
+        mk = m2[:, kk][:, None]
+        n_mn += jnp.where((hk == mn) & (mk > 0), 1.0, 0.0)
+        n_mx += jnp.where((hk == mx) & (mk > 0), 1.0, 0.0)
+    n_mn = jnp.maximum(n_mn, 1.0)
+    n_mx = jnp.maximum(n_mx, 1.0)
+    clamp = (var_pre > 0.0).astype(jnp.float32)  # relu'd variance gate
+    dstd = gstd_ref[:] * clamp / (deg * std)
+    gmean_t = gmean_ref[:] / deg
+    gmn_t = gmn_ref[:] / n_mn
+    gmx_t = gmx_ref[:] / n_mx
+    # pass 2: per-slot gradient, written slot-wise (equal tie split,
+    # matching lax reduce min/max VJP)
+    for kk in range(k):
+        hk = a3[:, kk, :]
+        mk = m2[:, kk][:, None]
+        gs = (
+            gmean_t
+            + dstd * (hk - mean)
+            + gmn_t * jnp.where((hk == mn) & (mk > 0), 1.0, 0.0)
+            + gmx_t * jnp.where((hk == mx) & (mk > 0), 1.0, 0.0)
+        )
+        gslot_ref[kk::k, :] = gs * mk  # slot-strided rows of [b*k, d]
+
+
+def _gstats_impl(table, idx, mask, halo_blocks, k, ratio):
+    from jax.experimental import pallas as pl
+
+    r = idx.shape[0]
+    br = _BLOCK * k
+    table, _ = _pad_rows(table, _BLOCK)
+    idx, _ = _pad_rows(idx.astype(jnp.int32), br, fill=-1)
+    mask, _ = _pad_rows(mask.astype(jnp.int32), br, fill=0)
+    tblocks = table.shape[0] // _BLOCK
+    iblocks = idx.shape[0] // br
+    dim = table.shape[1]
+    n_anchor = idx.shape[0] // k
+    g_span, _, _ = _spans(halo_blocks, ratio)
+
+    outs = pl.pallas_call(
+        functools.partial(
+            _gstats_fwd_kernel,
+            halo=halo_blocks,
+            tblocks=tblocks,
+            ratio=ratio,
+            span=g_span,
+            k=k,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_anchor, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_anchor, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_anchor, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_anchor, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_anchor, 1), jnp.float32),
+        ),
+        grid=(iblocks,),
+        in_specs=[
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ]
+        + [pl.BlockSpec((_BLOCK, dim), _table_map(j, halo_blocks, tblocks, ratio)) for j in range(g_span)],
+        out_specs=(
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, 1), lambda i: (i, 0)),
+        ),
+        interpret=_interpret(),
+    )(idx.reshape(-1, 1), mask.reshape(-1, 1), *([table] * g_span))
+    n_real = r // k
+    return tuple(o[:n_real] for o in outs)
+
+
+def _gstats_bwd_impl(table, idx, mask, gmean, gstd, gmn, gmx, halo_blocks,
+                     k, ratio):
+    from jax.experimental import pallas as pl
+
+    r = idx.shape[0]
+    br = _BLOCK * k
+    table_p, _ = _pad_rows(table, _BLOCK)
+    idx_p, _ = _pad_rows(idx.astype(jnp.int32), br, fill=-1)
+    mask_p, _ = _pad_rows(mask.astype(jnp.int32), br, fill=0)
+    grads = [
+        _pad_rows(g.astype(jnp.float32), _BLOCK)[0]
+        for g in (gmean, gstd, gmn, gmx)
+    ]
+    tblocks = table_p.shape[0] // _BLOCK
+    iblocks = idx_p.shape[0] // br
+    dim = table_p.shape[1]
+    g_span, _, _ = _spans(halo_blocks, ratio)
+
+    gslot = pl.pallas_call(
+        functools.partial(
+            _gstats_bwd_kernel,
+            halo=halo_blocks,
+            tblocks=tblocks,
+            ratio=ratio,
+            span=g_span,
+            k=k,
+        ),
+        out_shape=jax.ShapeDtypeStruct((idx_p.shape[0], dim), jnp.float32),
+        grid=(iblocks,),
+        in_specs=[
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK, dim), lambda i: (i, 0)),
+        ]
+        + [pl.BlockSpec((_BLOCK, dim), _table_map(j, halo_blocks, tblocks, ratio)) for j in range(g_span)],
+        out_specs=pl.BlockSpec((br, dim), lambda i: (i, 0)),
+        interpret=_interpret(),
+    )(
+        idx_p.reshape(-1, 1),
+        mask_p.reshape(-1, 1),
+        *grads,
+        *([table_p] * g_span),
+    )
+    return _scatter_impl(
+        gslot[:r], idx[:r], table.shape[0], halo_blocks, k, ratio
+    ).astype(table.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def window_gather_stats(
+    table,
+    idx,
+    mask,
+    halo_blocks: int,
+    k: int,
+    ratio: Tuple[int, int] = (1, 1),
+):
+    """(mean, std, mn, mx, cnt) over each anchor's K banded-gathered rows.
+
+    ``table [N, D]``, ``idx/mask [A*K]`` flat. One fused kernel: the
+    [A, K, D] gathered tensor lives only in VMEM; outputs are the PNA
+    aggregation statistics with dense_moments/dense_minmax semantics
+    (empty anchors -> mean/std of masked-zero rows, min/max fill 0).
+    Backward recomputes the tile and scatters the per-slot gradient with
+    the dual banded scatter -- no reverse lists, nothing saved but idx
+    and mask."""
+    return _gstats_impl(table, idx, mask, halo_blocks, k, ratio)
+
+
+def _wgs_fwd(table, idx, mask, halo_blocks, k, ratio):
+    outs = _gstats_impl(table, idx, mask, halo_blocks, k, ratio)
+    return outs, (table, idx, mask)
+
+
+def _wgs_bwd(halo_blocks, k, ratio, res, gs):
+    table, idx, mask = res
+    gmean, gstd, gmn, gmx, _gcnt = gs  # cnt is piecewise constant
+    gt = _gstats_bwd_impl(
+        table, idx, mask, gmean, gstd, gmn, gmx, halo_blocks, k, ratio
+    )
+    return gt, None, None
+
+
+window_gather_stats.defvjp(_wgs_fwd, _wgs_bwd)

@@ -38,6 +38,24 @@ def update_config(config, train_loader, val_loader, test_loader):
     )
 
     arch = config["NeuralNetwork"]["Architecture"]
+    # guaranteed dataset-wide max graph size (unlike num_nodes, which the
+    # reference contract pins to the FIRST sample): the banded-kernel halo
+    # (HydraBase.window_halo) must bound EVERY graph or out-of-band
+    # neighbors would silently drop — multi-host takes the global max
+    local_max = 0
+    for loader in (train_loader, val_loader, test_loader):
+        ds = loader.dataset
+        if hasattr(ds, "graph_sizes"):  # index-only scan (shard stores)
+            sizes = ds.graph_sizes()
+            local_max = max(local_max, int(sizes.max()) if len(sizes) else 0)
+        else:
+            for d in ds:
+                local_max = max(local_max, int(d.num_nodes))
+    from hydragnn_tpu.parallel.distributed import host_allreduce
+
+    arch["max_graph_nodes"] = int(
+        host_allreduce(np.asarray([local_max]), op="max")[0]
+    )
     if arch["model_type"] == "PNA":
         deg = gather_deg(train_loader.dataset)
         arch["pna_deg"] = deg.tolist()
