@@ -240,10 +240,18 @@ class HydraBase(nn.Module):
             p = p[:nl]
         return c, p
 
+    def _prepare_batch(self, batch: GraphBatch) -> GraphBatch:
+        """Once-per-forward hook for values every conv layer would
+        otherwise recompute identically (parameter-free functions of the
+        batch — e.g. DimeNet's triplet angles and spherical basis, shared
+        by all ``num_conv_layers`` interaction blocks). Default: no-op."""
+        return batch
+
     @nn.compact
     def __call__(self, batch: GraphBatch, train: bool = False):
         act = get_activation(self.activation)
         heads_cfg = self.config_heads or {}
+        batch = self._prepare_batch(batch)
         x = batch.x
         pos = batch.pos
 

@@ -103,46 +103,89 @@ class BesselBasisLayer(nn.Module):
         return env * jnp.sin(freq * d)
 
 
-class SphericalBasisLayer(nn.Module):
+def spherical_basis(
+    num_spherical,
+    num_radial,
+    cutoff,
+    envelope_exponent,
+    dist,
+    angle,
+    idx_kj,
+    dist_t=None,
+):
     """sbf[t, l*num_radial+n] = env(d_kj) j_l(z_ln d_kj) P-norm_l(angle_t).
 
-    Mirrors PyG's SphericalBasisLayer: radial part evaluated on the k->j edge
-    distance gathered per triplet, angular part on the triplet angle. The
-    normalization constants fold into the learned linear layers downstream.
-    """
+    Mirrors PyG's SphericalBasisLayer: radial part evaluated on the k->j
+    edge distance gathered per triplet, angular part on the triplet angle.
+    The normalization constants fold into the learned linear layers
+    downstream. Parameter-free, so it is a plain function — which lets
+    ``DIMEStack._prepare_batch`` hoist it out of the per-layer convs.
 
-    num_spherical: int
-    num_radial: int
-    cutoff: float
-    envelope_exponent: int = 5
+    ``dist_t``: optional per-TRIPLET k->j distances. The default path
+    evaluates the radial basis per edge and gathers at ``idx_kj``; in
+    graph-partition mode the (k->j) edge may live on another shard, so the
+    caller passes the triplet distances computed from halo-extended
+    positions and the gather disappears (identical numerics)."""
+    d = jnp.clip((dist if dist_t is None else dist_t) / cutoff, 1e-6, 1.0)
+    env = Envelope(envelope_exponent)(d)[:, None]
+    zeros = jnp.asarray(
+        _BESSEL_ZEROS[:num_spherical, :num_radial], dtype=jnp.float32
+    )
+    jl = _spherical_jn(num_spherical - 1, d[:, None, None] * zeros[None])
+    rbf = jnp.stack(
+        [jl[l][:, l, :] for l in range(num_spherical)], axis=1
+    )  # [E or T, S, R]
+    rbf = env[:, :, None] * rbf
+    cbf = jnp.stack(
+        _legendre(num_spherical - 1, jnp.cos(angle)), axis=1
+    )  # [T, S]
+    if dist_t is None:
+        rbf = rbf[idx_kj]  # [T, S, R]
+    out = rbf * cbf[:, :, None]
+    return out.reshape(out.shape[0], num_spherical * num_radial)
 
-    @nn.compact
-    def __call__(self, dist, angle, idx_kj, dist_t=None):
-        """``dist_t``: optional per-TRIPLET k->j distances. The default path
-        evaluates the radial basis per edge and gathers at ``idx_kj``; in
-        graph-partition mode the (k->j) edge may live on another shard, so
-        the caller passes the triplet distances computed from halo-extended
-        positions and the gather disappears (identical numerics)."""
-        d = jnp.clip(
-            (dist if dist_t is None else dist_t) / self.cutoff, 1e-6, 1.0
-        )
-        env = Envelope(self.envelope_exponent)(d)[:, None]
-        zeros = jnp.asarray(
-            _BESSEL_ZEROS[: self.num_spherical, : self.num_radial],
-            dtype=jnp.float32,
-        )
-        jl = _spherical_jn(self.num_spherical - 1, d[:, None, None] * zeros[None])
-        rbf = jnp.stack(
-            [jl[l][:, l, :] for l in range(self.num_spherical)], axis=1
-        )  # [E or T, S, R]
-        rbf = env[:, :, None] * rbf
-        cbf = jnp.stack(
-            _legendre(self.num_spherical - 1, jnp.cos(angle)), axis=1
-        )  # [T, S]
-        if dist_t is None:
-            rbf = rbf[idx_kj]  # [T, S, R]
-        out = rbf * cbf[:, :, None]
-        return out.reshape(out.shape[0], self.num_spherical * self.num_radial)
+
+def _dimenet_geometry(
+    batch, pos, num_spherical, num_radial, cutoff, envelope_exponent,
+    partition_axis,
+):
+    """(dist, sbf) for one batch — every interaction block consumes the
+    same values, so the stack computes them once per forward.
+    ``pos`` is explicit because partition mode evaluates on the per-layer
+    halo-EXTENDED node table, not ``batch.pos``."""
+    ex = batch.extras
+    i, j = batch.receivers, batch.senders
+    idx_i, idx_j, idx_k = ex["trip_i"], ex["trip_j"], ex["trip_k"]
+    trip_mask = ex["trip_mask"]
+
+    dist = jnp.sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
+    dist = jnp.where(batch.edge_mask, dist, cutoff)  # keep env finite
+
+    pos_i = pos[idx_i]
+    pos_ji = pos[idx_j] - pos_i
+    pos_ki = pos[idx_k] - pos_i
+    a = (pos_ji * pos_ki).sum(-1)
+    b = jnp.linalg.norm(jnp.cross(pos_ji, pos_ki), axis=-1)
+    angle = jnp.arctan2(b, a)
+
+    dist_t = None
+    if partition_axis is not None:
+        # per-triplet k->j distance from halo-extended positions (the
+        # (k->j) edge row itself may live on another shard)
+        dist_t = jnp.sqrt(((pos[idx_k] - pos[idx_j]) ** 2).sum(-1))
+        dist_t = jnp.where(trip_mask, dist_t, cutoff)
+    sbf = spherical_basis(
+        num_spherical,
+        num_radial,
+        cutoff,
+        envelope_exponent,
+        dist,
+        angle,
+        ex["trip_kj"],
+        dist_t=dist_t,
+    )
+    sbf = jnp.where(trip_mask[:, None], sbf, 0.0)
+    return dist, sbf
 
 
 class ResidualLayer(nn.Module):
@@ -188,39 +231,32 @@ class DimeNetConv(nn.Module):
                 "partition_graph)"
             )
         i, j = batch.receivers, batch.senders
-        idx_i, idx_j, idx_k = ex["trip_i"], ex["trip_j"], ex["trip_k"]
         idx_kj, idx_ji = ex["trip_kj"], ex["trip_ji"]
         trip_mask = ex["trip_mask"]
         n = x.shape[0]
         num_edges = i.shape[0]
 
-        dist = jnp.sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
-        dist = jnp.where(batch.edge_mask, dist, self.cutoff)  # keep env finite
-
-        pos_i = pos[idx_i]
-        pos_ji = pos[idx_j] - pos_i
-        pos_ki = pos[idx_k] - pos_i
-        a = (pos_ji * pos_ki).sum(-1)
-        b = jnp.linalg.norm(jnp.cross(pos_ji, pos_ki), axis=-1)
-        angle = jnp.arctan2(b, a)
+        if "dn_dist" in ex:
+            # hoisted by DIMEStack._prepare_batch: dist/angle/sbf are
+            # parameter-free functions of the batch, identical for every
+            # interaction block — computed ONCE per forward instead of
+            # num_conv_layers times (the spherical Bessel/Legendre chains
+            # are the transcendental-heavy part of the step)
+            dist, sbf = ex["dn_dist"], ex["dn_sbf"]
+        else:
+            dist, sbf = _dimenet_geometry(
+                batch,
+                pos,
+                self.num_spherical,
+                self.num_radial,
+                self.cutoff,
+                self.envelope_exponent,
+                self.partition_axis,
+            )
 
         rbf = BesselBasisLayer(
             self.num_radial, self.cutoff, self.envelope_exponent, name="rbf"
         )(dist)
-        dist_t = None
-        if self.partition_axis is not None:
-            # per-triplet k->j distance from halo-extended positions (the
-            # (k->j) edge row itself may live on another shard)
-            dist_t = jnp.sqrt(((pos[idx_k] - pos[idx_j]) ** 2).sum(-1))
-            dist_t = jnp.where(trip_mask, dist_t, self.cutoff)
-        sbf = SphericalBasisLayer(
-            self.num_spherical,
-            self.num_radial,
-            self.cutoff,
-            self.envelope_exponent,
-            name="sbf",
-        )(dist, angle, idx_kj, dist_t=dist_t)
-        sbf = jnp.where(trip_mask[:, None], sbf, 0.0)
 
         # lin + embedding block (edge-level states)
         h = TorchLinear(self.hidden_dim, name="lin")(x)
@@ -301,6 +337,36 @@ class DIMEStack(HydraBase):
     num_spherical: int = 7
     radius: float = 2.0
     conv_use_batchnorm: bool = False  # Identity feature layers (DIMEStack.py:73)
+
+    def _prepare_batch(self, batch):
+        """Hoist dist/angle/sbf: parameter-free functions of the batch that
+        every interaction block consumes identically — one evaluation of
+        the spherical Bessel/Legendre chains per forward instead of
+        ``num_conv_layers`` (the reference recomputes per block,
+        ``DIMEStack.py:79-116``; on TPU the transcendental chain is VPU
+        time that scaled with depth for no reason)."""
+        ex = batch.extras
+        if (
+            ex is None
+            or "trip_i" not in ex
+            or "dn_dist" in ex
+            or self.partition_axis is not None
+            # partition mode: geometry must be evaluated on the PER-LAYER
+            # halo-extended node table inside _apply_conv, not here
+        ):
+            return batch
+        dist, sbf = _dimenet_geometry(
+            batch,
+            batch.pos,
+            self.num_spherical,
+            self.num_radial,
+            self.radius,
+            self.envelope_exponent,
+            self.partition_axis,
+        )
+        merged = dict(ex)
+        merged.update({"dn_dist": dist, "dn_sbf": sbf})
+        return batch.replace(extras=merged)
 
     def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):
         # hidden = out if in==1 else in (DIMEStack.py:80)

@@ -316,21 +316,13 @@ _STD_EPS = 1e-5
 _BIG = 1e30
 
 
-def _gstats_fwd_kernel(*refs, halo, tblocks, ratio, span, k):
-    from jax.experimental import pallas as pl
-
-    idx_ref, mask_ref = refs[0], refs[1]
-    tables = refs[2 : 2 + span]
-    mean_ref, std_ref, mn_ref, mx_ref, cnt_ref = refs[2 + span :]
-    i = pl.program_id(0)
-    acc = _accumulate_gather(idx_ref[:], tables, i, halo, tblocks, ratio)
-    b = acc.shape[0] // k
-    d = acc.shape[1]
-    a3 = acc.reshape(b, k, d)
-    m2 = mask_ref[:].reshape(b, k).astype(jnp.float32)
-    # slot-wise accumulation: only [b, d]-sized temporaries stay live (a
-    # vectorized K-axis reduce would hold ~6 [BR, D] temps and blow the
-    # 16MB VMEM scope at k*dim >= ~4k)
+def _slot_stats(a3, m2, k):
+    """Slot-wise masked statistics over the K axis of ``a3 [b, k, d]`` with
+    mask ``m2 [b, k]``: (sum, sum-of-squares, min, max, count), only
+    [b, d]-sized temporaries live. ONE implementation shared by the fused
+    forward and backward kernels so their recomputed statistics cannot
+    diverge (the gradient-vs-function mismatch class)."""
+    b, _, d = a3.shape
     s = jnp.zeros((b, d), jnp.float32)
     sq = jnp.zeros((b, d), jnp.float32)
     mn = jnp.full((b, d), _BIG, jnp.float32)
@@ -345,6 +337,24 @@ def _gstats_fwd_kernel(*refs, halo, tblocks, ratio, span, k):
         mn = jnp.minimum(mn, jnp.where(mk > 0, hk, _BIG))
         mx = jnp.maximum(mx, jnp.where(mk > 0, hk, -_BIG))
         cnt += mk
+    return s, sq, mn, mx, cnt
+
+
+def _gstats_fwd_kernel(*refs, halo, tblocks, ratio, span, k):
+    from jax.experimental import pallas as pl
+
+    idx_ref, mask_ref = refs[0], refs[1]
+    tables = refs[2 : 2 + span]
+    mean_ref, std_ref, mn_ref, mx_ref, cnt_ref = refs[2 + span :]
+    i = pl.program_id(0)
+    acc = _accumulate_gather(idx_ref[:], tables, i, halo, tblocks, ratio)
+    b = acc.shape[0] // k
+    d = acc.shape[1]
+    a3 = acc.reshape(b, k, d)
+    m2 = mask_ref[:].reshape(b, k).astype(jnp.float32)
+    # slot-wise accumulation: a vectorized K-axis reduce would hold ~6
+    # [BR, D] temporaries and blow the 16MB VMEM scope at k*dim >= ~4k
+    s, sq, mn, mx, cnt = _slot_stats(a3, m2, k)
     deg = jnp.maximum(cnt, 1.0)
     mean = s / deg
     std = jnp.sqrt(jnp.maximum(sq / deg - mean * mean, 0.0) + _STD_EPS)
@@ -368,21 +378,8 @@ def _gstats_bwd_kernel(*refs, halo, tblocks, ratio, span, k):
     d = acc.shape[1]
     a3 = acc.reshape(b, k, d)
     m2 = mask_ref[:].reshape(b, k).astype(jnp.float32)
-    # pass 1: recompute the statistics slot-wise (same arithmetic as fwd)
-    s = jnp.zeros((b, d), jnp.float32)
-    sq = jnp.zeros((b, d), jnp.float32)
-    mn = jnp.full((b, d), _BIG, jnp.float32)
-    mx = jnp.full((b, d), -_BIG, jnp.float32)
-    cnt = jnp.zeros((b, 1), jnp.float32)
-    for kk in range(k):
-        hk = a3[:, kk, :]
-        mk = m2[:, kk][:, None]
-        hm = hk * mk
-        s += hm
-        sq += hm * hk
-        mn = jnp.minimum(mn, jnp.where(mk > 0, hk, _BIG))
-        mx = jnp.maximum(mx, jnp.where(mk > 0, hk, -_BIG))
-        cnt += mk
+    # pass 1: recompute the statistics (shared body = same arithmetic)
+    s, sq, mn, mx, cnt = _slot_stats(a3, m2, k)
     deg = jnp.maximum(cnt, 1.0)
     mean = s / deg
     var_pre = sq / deg - mean * mean

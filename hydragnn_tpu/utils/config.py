@@ -42,20 +42,32 @@ def update_config(config, train_loader, val_loader, test_loader):
     # reference contract pins to the FIRST sample): the banded-kernel halo
     # (HydraBase.window_halo) must bound EVERY graph or out-of-band
     # neighbors would silently drop — multi-host takes the global max
-    local_max = 0
-    for loader in (train_loader, val_loader, test_loader):
-        ds = loader.dataset
-        if hasattr(ds, "graph_sizes"):  # index-only scan (shard stores)
-            sizes = ds.graph_sizes()
-            local_max = max(local_max, int(sizes.max()) if len(sizes) else 0)
-        else:
-            for d in ds:
-                local_max = max(local_max, int(d.num_nodes))
-    from hydragnn_tpu.parallel.distributed import host_allreduce
+    # the bound must be dataset-wide or absent: computed when every split
+    # offers the index-only scan (free), or when the only consumer — the
+    # HYDRAGNN_WINDOW=1 banded kernels — is actually opted in (then a full
+    # sample walk is justified); otherwise None keeps startup O(1) and the
+    # kernels stay off rather than running with an unsound band
+    loaders = (train_loader, val_loader, test_loader)
+    fast = all(hasattr(ld.dataset, "graph_sizes") for ld in loaders)
+    if fast or os.getenv("HYDRAGNN_WINDOW", "0") == "1":
+        local_max = 0
+        for loader in loaders:
+            ds = loader.dataset
+            if hasattr(ds, "graph_sizes"):  # index-only (shard stores)
+                sizes = ds.graph_sizes()
+                local_max = max(
+                    local_max, int(sizes.max()) if len(sizes) else 0
+                )
+            else:
+                for d in ds:
+                    local_max = max(local_max, int(d.num_nodes))
+        from hydragnn_tpu.parallel.distributed import host_allreduce
 
-    arch["max_graph_nodes"] = int(
-        host_allreduce(np.asarray([local_max]), op="max")[0]
-    )
+        arch["max_graph_nodes"] = int(
+            host_allreduce(np.asarray([local_max]), op="max")[0]
+        )
+    else:
+        arch["max_graph_nodes"] = None
     if arch["model_type"] == "PNA":
         deg = gather_deg(train_loader.dataset)
         arch["pna_deg"] = deg.tolist()

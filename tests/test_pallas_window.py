@@ -14,11 +14,10 @@ from hydragnn_tpu.ops.pallas_window import (
 )
 
 
-def _banded_idx(rng, n, k, band, rows_per_anchor):
-    """[R] indices with |idx[r] - anchor(r)| < band; ~10% marked invalid
-    (-1)."""
-    r = n * rows_per_anchor // rows_per_anchor * rows_per_anchor
-    anchors = np.repeat(np.arange(n), rows_per_anchor)[: r]
+def _banded_idx(rng, n, band, rows_per_anchor):
+    """[n*rows_per_anchor] indices with |idx[r] - anchor(r)| < band; ~10%
+    marked invalid (-1)."""
+    anchors = np.repeat(np.arange(n), rows_per_anchor)
     lo = np.maximum(anchors - band + 1, 0)
     hi = np.minimum(anchors + band, n)
     idx = rng.integers(lo, hi).astype(np.int32)
@@ -31,7 +30,7 @@ def pytest_window_gather_matches_xla(n, k, band, halo):
     rng = np.random.default_rng(0)
     d = 24
     table = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
-    idx = _banded_idx(rng, n, k, band, k)
+    idx = _banded_idx(rng, n, band, k)
     valid = idx >= 0
     ref = np.where(valid[:, None], np.asarray(table)[np.maximum(idx, 0)], 0.0)
     out = jax.jit(
@@ -54,7 +53,7 @@ def pytest_window_gather_matches_xla(n, k, band, halo):
 def pytest_window_scatter_matches_xla():
     rng = np.random.default_rng(1)
     n, k, d, band, halo = 260, 5, 16, 120, 1
-    idx = _banded_idx(rng, n, k, band, k)
+    idx = _banded_idx(rng, n, band, k)
     valid = idx >= 0
     vals = jnp.asarray(rng.standard_normal((idx.shape[0], d)), jnp.float32)
     out = jax.jit(
@@ -148,9 +147,7 @@ def pytest_window_gather_stats_matches_dense_ops():
     rng = np.random.default_rng(5)
     n, k, d, band, halo = 300, 6, 16, 90, 1
     table = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
-    idx2 = _banded_idx(rng, n, 1, band, 1)
-    idx = np.stack([idx2] * 1).reshape(-1)  # reuse banded helper per slot
-    idx = _banded_idx(rng, n, k, band, k).reshape(n, k)
+    idx = _banded_idx(rng, n, band, k).reshape(n, k)
     mask = idx >= 0
     # duplicate some entries to force min/max ties
     idx[:, 1] = np.where(mask[:, 0], idx[:, 0], idx[:, 1])
@@ -189,6 +186,9 @@ def pytest_window_gather_stats_matches_dense_ops():
 
     g_ref = jax.jit(jax.grad(lambda t: loss(ref, t)))(table)
     g_fus = jax.jit(jax.grad(lambda t: loss(fused, t)))(table)
+    # rtol 5e-4: the slot-loop vs vectorized reduce order differs by ulps
+    # in the f32 mean, which the std gradient amplifies near the variance
+    # clamp (observed max 2e-4 relative on a single element)
     np.testing.assert_allclose(
-        np.asarray(g_fus), np.asarray(g_ref), rtol=1e-4, atol=1e-5
+        np.asarray(g_fus), np.asarray(g_ref), rtol=5e-4, atol=1e-5
     )
