@@ -47,9 +47,17 @@ def update_config(config, train_loader, val_loader, test_loader):
     # HYDRAGNN_WINDOW=1 banded kernels — is actually opted in (then a full
     # sample walk is justified); otherwise None keeps startup O(1) and the
     # kernels stay off rather than running with an unsound band
+    from hydragnn_tpu.parallel.distributed import host_allreduce
+
     loaders = (train_loader, val_loader, test_loader)
     fast = all(hasattr(ld.dataset, "graph_sizes") for ld in loaders)
-    if fast or os.getenv("HYDRAGNN_WINDOW", "0") == "1":
+    # the scan-or-not decision itself must be collective-consistent: an env
+    # var (or dataset wrapper) differing per host would otherwise strand
+    # some hosts in the allreduce below — so every host always joins ONE
+    # cheap reduce of the decision first
+    want = os.getenv("HYDRAGNN_WINDOW", "0") == "1"
+    want = bool(host_allreduce(np.asarray([int(fast or want)]), op="max")[0])
+    if want:
         local_max = 0
         for loader in loaders:
             ds = loader.dataset
@@ -61,8 +69,6 @@ def update_config(config, train_loader, val_loader, test_loader):
             else:
                 for d in ds:
                     local_max = max(local_max, int(d.num_nodes))
-        from hydragnn_tpu.parallel.distributed import host_allreduce
-
         arch["max_graph_nodes"] = int(
             host_allreduce(np.asarray([local_max]), op="max")[0]
         )

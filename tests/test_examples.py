@@ -133,6 +133,15 @@ def pytest_example_shard_pipeline(tmp_path):
             "--num_epoch=1", "--ddstore", cwd=str(tmp_path),
         )
         assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+        # subgroup replication (ddstore_width): width 1 = every rank its
+        # own block holding a full replica — the degenerate-but-real
+        # subgroup path end-to-end through the example surface
+        res = _run_example(
+            "examples/open_catalyst_2020/train.py",
+            "--num_epoch=1", "--ddstore", "--ddstore_width=1",
+            cwd=str(tmp_path),
+        )
+        assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
 
 
 def pytest_example_hpo(tmp_path):
