@@ -332,8 +332,19 @@ def main():
             with open(out) as f:
                 for row in json.load(f).get("rows", []):
                     merged[_key(row)] = row
-        except Exception:
+        except FileNotFoundError:
             pass
+        except Exception as e:
+            # a truncated/corrupt file must not silently eat history
+            print(
+                f"existing {out} unreadable ({e}); previous rows lost, "
+                f"original kept at {out}.bak",
+                file=sys.stderr,
+            )
+            try:
+                os.replace(out, out + ".bak")
+            except OSError:
+                pass
         for key in list(merged):
             merged[key]["carried_over"] = True  # stale unless re-measured
         for row in extra:

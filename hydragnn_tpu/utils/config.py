@@ -53,11 +53,15 @@ def update_config(config, train_loader, val_loader, test_loader):
     fast = all(hasattr(ld.dataset, "graph_sizes") for ld in loaders)
     # the scan-or-not decision itself must be collective-consistent: an env
     # var (or dataset wrapper) differing per host would otherwise strand
-    # some hosts in the allreduce below — so every host always joins ONE
-    # cheap reduce of the decision first
-    want = os.getenv("HYDRAGNN_WINDOW", "0") == "1"
-    want = bool(host_allreduce(np.asarray([int(fast or want)]), op="max")[0])
-    if want:
+    # some hosts in the allreduce below — so every host always joins the
+    # same two cheap decision reduces first. Scan iff EVERY host has the
+    # free index-only path (min) or ANY host opted into the kernels (max):
+    # one slow host must not drag fast hosts into an O(dataset) walk
+    # unless the walk was actually requested.
+    env_want = os.getenv("HYDRAGNN_WINDOW", "0") == "1"
+    all_fast = bool(host_allreduce(np.asarray([int(fast)]), op="min")[0])
+    any_want = bool(host_allreduce(np.asarray([int(env_want)]), op="max")[0])
+    if all_fast or any_want:
         local_max = 0
         for loader in loaders:
             ds = loader.dataset

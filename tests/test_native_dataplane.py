@@ -9,6 +9,7 @@ import tempfile
 import time
 
 import numpy as np
+import pytest
 
 from hydragnn_tpu.data.dataobj import GraphData
 
@@ -236,6 +237,94 @@ def pytest_diststore_subgroup_replication():
     finally:
         for ds in (ds0, ds1, ds2, ds3):
             ds.close()
+
+
+def _subgroup_worker(rank, base_port, results, barrier):
+    """One REAL process of a 4-rank world with subgroup_width=2: builds its
+    subgroup shard, serves it, sweeps the full global index space, and
+    reports per-index node counts for cross-process verification. Ranks
+    outside the block get dead addresses, so any cross-subgroup fetch
+    would error instead of silently succeeding."""
+    try:
+        import numpy as _np
+
+        from hydragnn_tpu.data.distdataset import (
+            DistDataset,
+            subgroup_local_indices,
+        )
+
+        rng = _np.random.default_rng(11)
+        all_samples = [_mk(rng, int(rng.integers(3, 9))) for _ in range(20)]
+        dead = "127.0.0.1:9"
+        group = rank // 2
+        addrs = [
+            f"127.0.0.1:{base_port + r}" if r // 2 == group else dead
+            for r in range(4)
+        ]
+        mine = subgroup_local_indices(20, rank, 4, 2)
+        ds = DistDataset(
+            [all_samples[i] for i in mine],
+            rank=rank,
+            world=4,
+            addresses=addrs,
+            samples_per_rank=[
+                len(subgroup_local_indices(20, group * 2 + p, 4, 2))
+                for p in range(2)
+            ],
+            max_counts={"nodes": 8, "edges": 16},
+            subgroup_width=2,
+        )
+        try:
+            ds.epoch_begin()
+            counts = [ds.get(i).num_nodes for i in range(20)]
+            # every fetch resolved inside the subgroup; verify content
+            expected = [s.num_nodes for s in all_samples]
+            assert counts == expected, (rank, counts, expected)
+            # barrier: no rank tears its server down while a subgroup
+            # peer may still be mid-sweep (a sleep would be skew-flaky)
+            barrier.wait(timeout=90)
+            ds.epoch_end()
+        finally:
+            ds.close()
+        # "ok" only after teardown so epoch_end/close failures surface
+        results.put((rank, "ok"))
+    except Exception as e:  # surface on the parent
+        results.put((rank, f"{type(e).__name__}: {e}"))
+
+
+@pytest.mark.skipif(
+    int(os.getenv("HYDRAGNN_FAST_TEST", "0")) == 1,
+    reason="spawns 4 real processes: default tier",
+)
+def pytest_diststore_subgroup_multiprocess():
+    """4 REAL processes, subgroup_width=2: both blocks independently serve
+    a full replica and every get() resolves within the caller's block
+    (out-of-block ranks are unreachable by construction)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    results = ctx.Queue()
+    barrier = ctx.Barrier(4)
+    base_port = 23960
+    procs = [
+        ctx.Process(
+            target=_subgroup_worker, args=(r, base_port, results, barrier)
+        )
+        for r in range(4)
+    ]
+    for p in procs:
+        p.start()
+    outcomes = {}
+    try:
+        for _ in range(4):
+            rank, status = results.get(timeout=120)
+            outcomes[rank] = status
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    assert outcomes == {r: "ok" for r in range(4)}, outcomes
 
 
 def pytest_region_timer_calltree():
