@@ -14,6 +14,7 @@ reference cannot run on TPU. Prints ONE JSON line.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -255,8 +256,6 @@ def bench_extra_rows():
     BENCH_EXTRA.json (NOT the headline stdout line — round-2's headline was
     lost to driver tail-truncation of one oversized line). Skippable via
     HYDRAGNN_BENCH_EXTRAS=0."""
-    import os
-
     if os.getenv("HYDRAGNN_BENCH_EXTRAS", "1") == "0":
         return []
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -312,51 +311,63 @@ def bench_extra_rows():
     return rows
 
 
+def merge_extra_rows(path, extra):
+    """Merge freshly measured rows into ``path`` by config identity:
+    configs not re-measured this run keep their previous rows, explicitly
+    marked ``carried_over``; an unreadable existing file is backed up to
+    ``.bak`` and reported instead of silently eating history. Returns the
+    merged row list (also written to ``path``, atomically)."""
+    key_fields = ("model", "hidden", "graphs_per_batch", "nodes_per_graph",
+                  "avg_degree", "layers", "precision", "aggregation")
+
+    def _key(row):
+        return tuple(row.get(f) for f in key_fields)
+
+    merged = {}
+    try:
+        with open(path) as f:
+            for row in json.load(f).get("rows", []):
+                merged[_key(row)] = row
+    except FileNotFoundError:
+        pass
+    except Exception as e:
+        # a truncated/corrupt file must not silently eat history; report
+        # what actually happened to it, not what we hoped would
+        try:
+            os.replace(path, path + ".bak")
+            kept = f"original kept at {path}.bak"
+        except OSError as be:
+            kept = f"backup to .bak ALSO failed ({be})"
+        print(
+            f"existing {path} unreadable ({e}); previous rows lost, {kept}",
+            file=sys.stderr,
+        )
+    for key in list(merged):
+        merged[key]["carried_over"] = True  # stale unless re-measured
+    for row in extra:
+        row.pop("carried_over", None)
+        merged[_key(row)] = row
+    rows = list(merged.values())
+    # atomic replace: a driver-side kill mid-write must not leave the
+    # history file truncated (the failure mode this merge exists to survive)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"rows": rows}, f, indent=1)
+    os.replace(tmp, path)
+    return rows
+
+
 def main():
     ours = bench_ours()
     extra = bench_extra_rows()
     # persist the expensive TPU rows BEFORE the torch baseline: a non-
     # exception death there (OOM kill) must not discard them
     if extra:
-        import os
-
         out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_EXTRA.json")
-        # merge by config identity so budget-skipped configs keep their
-        # previously measured rows instead of vanishing
-        key_fields = ("model", "hidden", "graphs_per_batch", "nodes_per_graph",
-                      "avg_degree", "layers", "precision", "aggregation")
-
-        def _key(row):
-            return tuple(row.get(f) for f in key_fields)
-
-        merged = {}
-        try:
-            with open(out) as f:
-                for row in json.load(f).get("rows", []):
-                    merged[_key(row)] = row
-        except FileNotFoundError:
-            pass
-        except Exception as e:
-            # a truncated/corrupt file must not silently eat history
-            print(
-                f"existing {out} unreadable ({e}); previous rows lost, "
-                f"original kept at {out}.bak",
-                file=sys.stderr,
-            )
-            try:
-                os.replace(out, out + ".bak")
-            except OSError:
-                pass
-        for key in list(merged):
-            merged[key]["carried_over"] = True  # stale unless re-measured
-        for row in extra:
-            row.pop("carried_over", None)
-            merged[_key(row)] = row
-        with open(out, "w") as f:
-            json.dump({"rows": list(merged.values())}, f, indent=1)
+        rows = merge_extra_rows(out, extra)
         print(
-            f"wrote {len(extra)} fresh / {len(merged)} total extra rows "
+            f"wrote {len(extra)} fresh / {len(rows)} total extra rows "
             f"to {out}",
             file=sys.stderr,
         )

@@ -1,0 +1,81 @@
+"""The round-recording contract of bench.py: the BENCH_EXTRA merge must
+never lose measured history (round 2's headline was lost to exactly this
+class of bug), and the headline line must stay small, last, and parseable."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _row(model, precision="f32", aggregation="segment", ms=1.0):
+    return {
+        "model": model,
+        "hidden": 256,
+        "graphs_per_batch": 64,
+        "nodes_per_graph": 90,
+        "avg_degree": 12,
+        "layers": 3,
+        "precision": precision,
+        "aggregation": aggregation,
+        "ms_per_step": ms,
+    }
+
+
+def pytest_merge_keeps_skipped_configs(tmp_path):
+    out = str(tmp_path / "extra.json")
+    # round 1: two configs measured
+    bench.merge_extra_rows(out, [_row("PNA"), _row("GIN")])
+    # round 2: only PNA re-measured (budget skipped GIN)
+    rows = bench.merge_extra_rows(out, [_row("PNA", ms=2.0)])
+    by_model = {r["model"]: r for r in rows}
+    assert by_model["PNA"]["ms_per_step"] == 2.0
+    assert "carried_over" not in by_model["PNA"]  # fresh
+    assert by_model["GIN"]["ms_per_step"] == 1.0  # history preserved
+    assert by_model["GIN"]["carried_over"] is True  # and marked stale
+    # round 3: GIN re-measured again -> marker cleared
+    rows = bench.merge_extra_rows(out, [_row("GIN", ms=3.0)])
+    by_model = {r["model"]: r for r in rows}
+    assert by_model["GIN"]["ms_per_step"] == 3.0
+    assert "carried_over" not in by_model["GIN"]
+    assert by_model["PNA"]["carried_over"] is True
+
+
+def pytest_merge_distinguishes_configs_not_models(tmp_path):
+    out = str(tmp_path / "extra.json")
+    rows = bench.merge_extra_rows(
+        out,
+        [_row("PNA", "f32", "segment"), _row("PNA", "bf16", "dense", ms=0.5)],
+    )
+    assert len(rows) == 2  # same model, different config identity
+
+
+def pytest_merge_backs_up_corrupt_file(tmp_path, capsys):
+    out = str(tmp_path / "extra.json")
+    with open(out, "w") as f:
+        f.write('{"rows": [{"model": "PN')  # truncated mid-dump
+    rows = bench.merge_extra_rows(out, [_row("GIN")])
+    assert [r["model"] for r in rows] == ["GIN"]
+    assert os.path.exists(out + ".bak")  # history preserved for forensics
+    assert "unreadable" in capsys.readouterr().err
+    # the rewritten file parses cleanly
+    assert json.load(open(out))["rows"][0]["model"] == "GIN"
+
+
+def pytest_headline_shape():
+    """The driver json-parses the LAST stdout line: keep it one compact
+    object with the contracted keys."""
+    line = json.dumps(
+        {
+            "metric": "pna_multihead_train_graphs_per_sec",
+            "value": 1.0,
+            "unit": "graphs/sec",
+            "vs_baseline": 1.0,
+        }
+    )
+    parsed = json.loads(line)
+    assert set(parsed) == {"metric", "value", "unit", "vs_baseline"}
+    assert len(line) < 200  # tail-capture safe
