@@ -428,6 +428,17 @@ class DistDataset:
                     max_nodes,
                 )
 
+        self._local_graph_sizes = nodes
+
+    def graph_sizes(self) -> np.ndarray:
+        """LOCAL per-sample node counts, index-only (no store traffic).
+
+        Lets config derivation compute ``max_graph_nodes`` as a local max
+        + host allreduce instead of walking every GLOBAL index through the
+        store transport (O(world x dataset) traffic, and it would require
+        an open epoch window)."""
+        return self._local_graph_sizes
+
     def epoch_begin(self):
         self.store.epoch_begin()
 

@@ -45,14 +45,20 @@ def window_enabled(
     env_default: str = "0",
 ) -> bool:
     """Static enablement: ``HYDRAGNN_WINDOW=1`` opts in where legal (halo
-    known, >=64 features, VMEM budget); default OFF. Measured 2026-07-31
-    (v5e, OC20-scale PNA dense bf16): the standalone banded gather is
-    ~1.1-1.3x XLA's in isolation but NEUTRAL end-to-end (XLA fuses its
-    gather with the surrounding mask/stats work — the same
-    fusion-forfeit economics as ops/pallas_segment.py), and the fused
-    stats kernel's K-unrolled body compiles for minutes at K~22. Kept
-    opt-in: parity-proven machinery (the interpreter runs it on CPU),
-    and the banded-scatter VJP needs no reverse lists."""
+    known, >=64 features, VMEM budget); default OFF.
+
+    TRACE-TIME CAPTURE: the env var is read when the surrounding conv is
+    traced, and the chosen path is baked into the compiled program —
+    toggling ``HYDRAGNN_WINDOW`` mid-process keeps serving the previously
+    compiled path until ``jax.clear_caches()`` is called. Set it before
+    the first forward (tests that toggle it clear caches explicitly).
+
+    Measured 2026-07-31 (v5e, OC20-scale PNA dense bf16): the standalone
+    banded gather is ~1.1-1.3x XLA's in isolation but NEUTRAL end-to-end
+    (XLA fuses its gather with the surrounding mask/stats work — the same
+    fusion-forfeit economics as ops/pallas_segment.py). Kept opt-in:
+    parity-proven machinery (the interpreter runs it on CPU), and the
+    banded-scatter VJP needs no reverse lists."""
     import os
 
     flag = os.getenv("HYDRAGNN_WINDOW", env_default)
@@ -235,7 +241,6 @@ def _scatter_impl(values, idx, num_rows, halo_blocks, rows_per_anchor, ratio):
     return out[:num_rows]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def window_gather(
     table,
     idx,
@@ -250,21 +255,33 @@ def window_gather(
     tables with different row density (idx block i targets table block
     ``(i*num)//den``); (1, 1) for node-table gathers. Out-of-band or
     negative indices yield zero rows. Returns f32 [R, D]."""
+    # table.shape[0] rides as a static nondiff argument (the file's
+    # pattern for shape state) rather than a residual — residuals hold
+    # arrays only
+    return _window_gather_n(
+        table, idx, table.shape[0], halo_blocks, rows_per_anchor, ratio
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _window_gather_n(
+    table, idx, num_rows, halo_blocks, rows_per_anchor, ratio
+):
     return _gather_impl(table, idx, halo_blocks, rows_per_anchor, ratio)
 
 
-def _wg_fwd(table, idx, halo_blocks, rows_per_anchor, ratio):
+def _wg_fwd(table, idx, num_rows, halo_blocks, rows_per_anchor, ratio):
     out = _gather_impl(table, idx, halo_blocks, rows_per_anchor, ratio)
-    return out, (idx, table.shape[0], jnp.zeros((), table.dtype))
+    return out, (idx, jnp.zeros((), table.dtype))
 
 
-def _wg_bwd(halo_blocks, rows_per_anchor, ratio, res, g):
-    idx, n, proto = res
-    gt = _scatter_impl(g, idx, n, halo_blocks, rows_per_anchor, ratio)
+def _wg_bwd(num_rows, halo_blocks, rows_per_anchor, ratio, res, g):
+    idx, proto = res
+    gt = _scatter_impl(g, idx, num_rows, halo_blocks, rows_per_anchor, ratio)
     return gt.astype(proto.dtype), None
 
 
-window_gather.defvjp(_wg_fwd, _wg_bwd)
+_window_gather_n.defvjp(_wg_fwd, _wg_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))

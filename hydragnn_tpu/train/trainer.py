@@ -509,11 +509,14 @@ class Trainer(PredictMixin):
         while True:
             tr.start("dataload")  # time spent WAITING on the transfer stage
             try:
-                item = next(it)
-            except StopIteration:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            finally:
+                # a worker-side error re-raised by next(it) must not leave
+                # the dataload timer running for the rest of the process
                 tr.stop("dataload")
-                return
-            tr.stop("dataload")
             yield item
 
     @staticmethod

@@ -65,7 +65,7 @@ def update_config(config, train_loader, val_loader, test_loader):
         local_max = 0
         for loader in loaders:
             ds = loader.dataset
-            if hasattr(ds, "graph_sizes"):  # index-only (shard stores)
+            if hasattr(ds, "graph_sizes"):  # index-only (shard/dist stores)
                 sizes = ds.graph_sizes()
                 local_max = max(
                     local_max, int(sizes.max()) if len(sizes) else 0
