@@ -33,8 +33,14 @@ class BatchLayout:
     need_neighbors: bool = False
     k_in: int = 0
     k_out: int = 0
-    # per-edge incoming-triplet list width (DimeNet dense path)
-    kt: int = 0
+
+    @property
+    def packs_triplets(self) -> bool:
+        """Whether collation materializes T-axis triplet tables. Dense
+        layouts never do: the bmm-triplet path (models/dimenet.py) derives
+        every triplet from the neighbor lists, so host-side
+        ``compute_triplets`` is skipped entirely."""
+        return self.need_triplets and not self.need_neighbors
 
 
 @dataclass
@@ -76,6 +82,10 @@ class BucketedLayout:
     def need_neighbors(self):
         return self.layouts[0].need_neighbors
 
+    @property
+    def packs_triplets(self):
+        return self.layouts[0].packs_triplets
+
 
 def _sample_triplets(data: GraphData):
     if "triplets" not in data.extras:
@@ -103,27 +113,26 @@ def needs_dense_neighbors(arch_config: dict) -> bool:
 
 def _sample_stats(datasets, need_triplets, need_neighbors):
     """One pass over all samples -> per-sample size arrays (nodes, edges,
-    triplets, neighbor-list widths) + the head schema from the first."""
-    nodes, edges, trips_n, kts, kis, kos = [], [], [], [], [], []
+    triplets, neighbor-list widths) + the head schema from the first.
+    Triplet counting is skipped when dense lists are requested — the bmm
+    path never packs a T axis, so running ``compute_triplets`` over the
+    whole dataset would be pure startup waste."""
+    nodes, edges, trips_n, kis, kos = [], [], [], [], []
     first = None
     for ds in datasets:
         for d in ds:
             first = first or d
             nodes.append(d.num_nodes)
             edges.append(d.num_edges)
-            t = kt = ki = ko = 0
-            if need_triplets:
+            t = ki = ko = 0
+            if need_triplets and not need_neighbors:
                 trips = _sample_triplets(d)
                 t = trips[0].shape[0]
-                if need_neighbors and trips[4].size:
-                    # widest per-edge incoming-triplet group in the sample
-                    kt = int(np.bincount(trips[4]).max())
             if need_neighbors and d.num_edges:
                 from hydragnn_tpu.ops.dense_agg import max_degree
 
                 ki, ko = max_degree(d.edge_index[0], d.edge_index[1])
             trips_n.append(t)
-            kts.append(kt)
             kis.append(ki)
             kos.append(ko)
     head_types = tuple(first.target_types)
@@ -134,7 +143,6 @@ def _sample_stats(datasets, need_triplets, need_neighbors):
         np.asarray(nodes),
         np.asarray(edges),
         np.asarray(trips_n),
-        np.asarray(kts),
         np.asarray(kis),
         np.asarray(kos),
         head_types,
@@ -176,7 +184,7 @@ def _partition_node_bounds(nodes: np.ndarray, num_buckets: int) -> List[int]:
 
 
 def _layout_from_maxima(
-    max_nodes, max_edges, max_trip, kt, k_in, k_out,
+    max_nodes, max_edges, max_trip, k_in, k_out,
     batch_size, mult, device_multiple, head_types, head_dims,
     need_triplets, need_neighbors,
 ) -> BatchLayout:
@@ -189,7 +197,7 @@ def _layout_from_maxima(
         graph_multiple=max(device_multiple, 1),
     )
     t_pad = 0
-    if need_triplets:
+    if need_triplets and not need_neighbors:
         t_pad = int(-(-(batch_size * max(max_trip, 1)) // mult) * mult)
     return BatchLayout(
         n_pad=n_pad,
@@ -202,7 +210,6 @@ def _layout_from_maxima(
         need_neighbors=need_neighbors,
         k_in=max(int(k_in), 1),
         k_out=max(int(k_out), 1),
-        kt=max(int(kt), 1),
     )
 
 
@@ -231,7 +238,7 @@ def compute_layout(
         except Exception:
             device_multiple = 1
     mult = _lcm(8, max(device_multiple, 1))
-    nodes, edges, trips_n, kts, kis, kos, head_types, head_dims = (
+    nodes, edges, trips_n, kis, kos, head_types, head_dims = (
         _sample_stats(datasets, need_triplets, need_neighbors)
     )
 
@@ -240,7 +247,6 @@ def compute_layout(
             max(int(nodes[mask].max()), 1),
             max(int(edges[mask].max()), 1),
             int(trips_n[mask].max()) if need_triplets else 0,
-            kts[mask].max() if len(kts) else 1,
             kis[mask].max() if len(kis) else 1,
             kos[mask].max() if len(kos) else 1,
             batch_size, mult, device_multiple, head_types, head_dims,
@@ -263,7 +269,7 @@ def compute_layout(
         g_cap = max(batch_size, n_pad // max(int(mn.min()), 1))
         g_pad = _round_up(g_cap + 1, max(device_multiple, 1))
         t_pad = 0
-        if need_triplets:
+        if need_triplets and not need_neighbors:
             t_budget = int(max(batch_size * float(mt.mean()), mt.max(), 1))
             t_pad = _round_up(t_budget, mult)
         return BatchLayout(
@@ -277,7 +283,6 @@ def compute_layout(
             need_neighbors=need_neighbors,
             k_in=max(int(kis[mask].max()) if len(kis) else 1, 1),
             k_out=max(int(kos[mask].max()) if len(kos) else 1, 1),
-            kt=max(int(kts[mask].max()) if len(kts) else 1, 1),
         )
 
     everything = np.ones(len(nodes), bool)
@@ -311,7 +316,7 @@ def _pack_indices(
         if cur and (
             n + ni > layout.n_pad - 1
             or e + ei > layout.e_pad
-            or (layout.need_triplets and t + ti > layout.t_pad)
+            or (layout.packs_triplets and t + ti > layout.t_pad)
             or len(cur) >= cap
         ):
             batches.append(np.asarray(cur, np.int64))
@@ -352,7 +357,7 @@ def _collate_with_extras(samples, layout: BatchLayout):
         head_types=layout.head_types,
         head_dims=layout.head_dims,
     )
-    if layout.need_triplets:
+    if layout.packs_triplets:
         from hydragnn_tpu.graph.batch import pack_triplets
 
         trips = [
@@ -362,10 +367,7 @@ def _collate_with_extras(samples, layout: BatchLayout):
             extras=pack_triplets(trips, layout.n_pad, layout.t_pad)
         )
     if layout.need_neighbors:
-        from hydragnn_tpu.ops.dense_agg import (
-            build_group_lists,
-            build_neighbor_lists,
-        )
+        from hydragnn_tpu.ops.dense_agg import build_neighbor_lists
 
         nbr = build_neighbor_lists(
             batch.senders,
@@ -374,20 +376,10 @@ def _collate_with_extras(samples, layout: BatchLayout):
             layout.n_pad,
             layout.k_in,
             layout.k_out,
+            with_slot_tables=layout.need_triplets,
         )
         merged = dict(batch.extras or {})
         merged.update(nbr)
-        if layout.need_triplets:
-            # DimeNet dense path: per-edge incoming-triplet member lists
-            tl, tm = build_group_lists(
-                merged["trip_ji"],
-                merged["trip_mask"],
-                layout.e_pad,
-                layout.kt,
-                label="kt",
-            )
-            merged["tripnbr_idx"] = tl
-            merged["tripnbr_mask"] = tm
         batch = batch.replace(extras=merged)
     return batch
 
@@ -499,7 +491,7 @@ class GraphLoader:
                 edges.append(d.num_edges)
                 trips.append(
                     _sample_triplets(d)[0].shape[0]
-                    if self.layout.need_triplets
+                    if self.layout.packs_triplets
                     else 0
                 )
             self._bucket_ids = np.asarray(ids, np.int64)

@@ -103,6 +103,23 @@ class BesselBasisLayer(nn.Module):
         return env * jnp.sin(freq * d)
 
 
+def _radial_sbf(dist, num_spherical, num_radial, cutoff, envelope_exponent):
+    """``env(d) * j_l(z_ln * d)`` -> [..., S, R] — the radial half of the
+    spherical basis. ONE implementation shared by the T-axis
+    (:func:`spherical_basis`) and bmm (:func:`_dimenet_geometry_dense`)
+    paths so their numerics cannot diverge."""
+    d = jnp.clip(dist / cutoff, 1e-6, 1.0)
+    env = Envelope(envelope_exponent)(d)
+    zeros = jnp.asarray(
+        _BESSEL_ZEROS[:num_spherical, :num_radial], dtype=jnp.float32
+    )
+    jl = _spherical_jn(num_spherical - 1, d[..., None, None] * zeros)
+    rad = jnp.stack(
+        [jl[l][..., l, :] for l in range(num_spherical)], axis=-2
+    )  # [..., S, R]
+    return env[..., None, None] * rad
+
+
 def spherical_basis(
     num_spherical,
     num_radial,
@@ -126,16 +143,13 @@ def spherical_basis(
     graph-partition mode the (k->j) edge may live on another shard, so the
     caller passes the triplet distances computed from halo-extended
     positions and the gather disappears (identical numerics)."""
-    d = jnp.clip((dist if dist_t is None else dist_t) / cutoff, 1e-6, 1.0)
-    env = Envelope(envelope_exponent)(d)[:, None]
-    zeros = jnp.asarray(
-        _BESSEL_ZEROS[:num_spherical, :num_radial], dtype=jnp.float32
-    )
-    jl = _spherical_jn(num_spherical - 1, d[:, None, None] * zeros[None])
-    rbf = jnp.stack(
-        [jl[l][:, l, :] for l in range(num_spherical)], axis=1
+    rbf = _radial_sbf(
+        dist if dist_t is None else dist_t,
+        num_spherical,
+        num_radial,
+        cutoff,
+        envelope_exponent,
     )  # [E or T, S, R]
-    rbf = env[:, :, None] * rbf
     cbf = jnp.stack(
         _legendre(num_spherical - 1, jnp.cos(angle)), axis=1
     )  # [T, S]
@@ -143,6 +157,72 @@ def spherical_basis(
         rbf = rbf[idx_kj]  # [T, S, R]
     out = rbf * cbf[:, :, None]
     return out.reshape(out.shape[0], num_spherical * num_radial)
+
+
+def _dimenet_geometry_dense(
+    batch, pos, num_spherical, num_radial, cutoff, envelope_exponent
+):
+    """(dist, rad, cbf) for the bmm-triplet path — no triplet axis.
+
+    The T~deg*E triplet dimension is the reference design's scaling axis
+    (``DIMEStack.py:158-182`` materializes per-triplet tensors); on TPU it
+    is pure HBM pain: [T, D] gathers walk rows at ~1/10 of matmul-feed
+    bandwidth and the segment-sum back to edges is a scatter. This path
+    regroups every triplet (k->j->i) under its CENTRAL node j: the in-edge
+    slots (k->j, width Ki) and out-edge slots (j->i, width Ko) of j
+    enumerate all its triplets as a Ko x Ki grid, so the per-layer
+    aggregation becomes a batched matmul over the fused (in-slot x
+    spherical-component) axis — MXU work on [N, *] tensors (see
+    ``DimeNetConv``). Geometry here is parameter-free and hoisted once per
+    forward:
+
+      ``dist [E]``          edge lengths (the learned per-layer rbf input)
+      ``rad  [N, Ki, S, R]`` radial sbf part per in-edge slot
+      ``cbf  [N, Ko, Ki, S]`` Legendre angular part per (out, in) slot
+                             pair, with ALL validity masking folded in
+                             (out/in slot masks + the k != i backtrack
+                             exclusion), so downstream contractions need
+                             no masks of their own.
+    """
+    ex = batch.extras
+    i, j = batch.receivers, batch.senders
+    nbr_edge, nbr_mask = ex["nbr_edge"], ex["nbr_mask"]
+    # the out-slot grouping is the reverse-list grouping: rev_mask IS the
+    # out-slot validity mask
+    out_edge, out_mask = ex["out_edge"], ex["rev_mask"]
+
+    dist = jnp.sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
+    dist = jnp.where(batch.edge_mask, dist, cutoff)  # keep env finite
+
+    # radial part on the in-edge slots (shared _radial_sbf arithmetic)
+    d_g = jnp.where(nbr_mask, dist[nbr_edge], cutoff)
+    rad = _radial_sbf(
+        d_g, num_spherical, num_radial, cutoff, envelope_exponent
+    )  # [N, Ki, S, R]
+
+    # angular part on the (out-slot, in-slot) grid: angle at vertex i
+    # between (j - i) and (k - i), matching _dimenet_geometry exactly
+    k_id = ex["nbr_idx"]  # [N, Ki] sender of each in-edge (k)
+    i_id = jnp.where(out_mask, batch.receivers[out_edge], 0)  # [N, Ko]
+    pos_i = pos[i_id]  # [N, Ko, 3]
+    pos_k = pos[k_id]  # [N, Ki, 3]
+    pos_ji = pos[:, None, :] - pos_i  # [N, Ko, 3]
+    pos_ki = pos_k[:, None, :, :] - pos_i[:, :, None, :]  # [N, Ko, Ki, 3]
+    a = (pos_ji[:, :, None, :] * pos_ki).sum(-1)
+    b = jnp.linalg.norm(
+        jnp.cross(pos_ji[:, :, None, :], pos_ki), axis=-1
+    )
+    angle = jnp.arctan2(b, a)  # [N, Ko, Ki]
+    cbf = jnp.stack(
+        _legendre(num_spherical - 1, jnp.cos(angle)), axis=-1
+    )  # [N, Ko, Ki, S]
+    valid = (
+        out_mask[:, :, None]
+        & nbr_mask[:, None, :]
+        & (k_id[:, None, :] != i_id[:, :, None])
+    )
+    cbf = jnp.where(valid[..., None], cbf, 0.0)
+    return dist, rad, cbf
 
 
 def _dimenet_geometry(
@@ -188,6 +268,58 @@ def _dimenet_geometry(
     return dist, sbf
 
 
+def _bmm_triplet_aggregate(
+    x_down, rad, cbf, lin_sbf1, lin_sbf2, batch, num_spherical, num_radial
+):
+    """Triplet aggregation as per-central-node batched matmul (no T axis).
+
+    Computes, for every edge j->i, ``sum_k sbf_b[(k,j,i)] * x_down[k->j]``
+    — the InteractionPPBlock's directional message sum — by contracting
+    over the fused (in-slot, spherical-component) axis at each central
+    node j:
+
+      ``out[j, ko, d] = sum_{ki, s} cbf[j, ko, ki, s]
+                          * (rad[j, ki, s, :] @ Wf[s, :, d]) * xg[j, ki, d]``
+
+    where ``Wf`` is the composed sbf projection. One MXU batched matmul
+    replaces the reference path's [T, D] gather + multiply + segment-sum
+    (T ~ deg * E rows); the gathers that remain move [N, K, D] blocks of
+    full rows through single-owner permutations (scatter-free VJPs).
+    Masking (slot validity + backtrack) is pre-folded into ``cbf`` by
+    ``_dimenet_geometry_dense``."""
+    from hydragnn_tpu.ops.dense_agg import (
+        gather_rows_to_slots,
+        slots_to_rows,
+    )
+
+    ex = batch.extras
+    dt = x_down.dtype
+    sr = num_spherical * num_radial
+    # the two sbf projections are bias-free linears applied back-to-back:
+    # their composition is one [S*R, int_emb] matrix, obtained by feeding
+    # the identity through the SAME modules (param names/shapes stay
+    # checkpoint-compatible with the segment path)
+    wf = lin_sbf2(lin_sbf1(jnp.eye(sr, dtype=dt)))
+    wf = wf.reshape(num_spherical, num_radial, -1)
+    radw = jnp.einsum("nksr,srd->nksd", rad.astype(dt), wf)  # [N,Ki,S,D]
+    xg = gather_rows_to_slots(
+        x_down, ex["nbr_edge"], ex["nbr_mask"], ex["edge_slot"],
+        batch.edge_mask,
+    )  # [N, Ki, D]
+    m = radw * xg[:, :, None, :]  # [N, Ki, S, D]
+    n, ki, s, d = m.shape
+    ko = cbf.shape[1]
+    out = jax.lax.dot_general(
+        cbf.astype(dt).reshape(n, ko, ki * s),
+        m.reshape(n, ki * s, d),
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).astype(dt)  # [N, Ko, D]
+    return slots_to_rows(
+        out, ex["out_slot"], batch.edge_mask, ex["out_edge"], ex["rev_mask"]
+    )
+
+
 class ResidualLayer(nn.Module):
     dim: int
 
@@ -224,19 +356,32 @@ class DimeNetConv(nn.Module):
     def __call__(self, x, pos, batch, train: bool = False):
         act = jax.nn.silu
         ex = batch.extras
-        if ex is None or "trip_i" not in ex:
+        bmm_mode = (
+            ex is not None
+            and ("dn2_rad" in ex or "out_edge" in ex)
+            and self.partition_axis is None
+        )
+        if ex is None or not (bmm_mode or "trip_i" in ex):
             raise ValueError(
-                "DimeNet needs triplet index tables in batch.extras; build "
-                "batches with need_triplets=True (create_dataloaders / "
-                "partition_graph)"
+                "DimeNet needs triplet index tables or dense neighbor "
+                "lists in batch.extras; build batches with "
+                "need_triplets=True (create_dataloaders / partition_graph)"
             )
         i, j = batch.receivers, batch.senders
-        idx_kj, idx_ji = ex["trip_kj"], ex["trip_ji"]
-        trip_mask = ex["trip_mask"]
         n = x.shape[0]
         num_edges = i.shape[0]
 
-        if "dn_dist" in ex:
+        if bmm_mode:
+            if "dn2_rad" in ex:
+                # hoisted by DIMEStack._prepare_batch (parameter-free,
+                # shared by every interaction block)
+                dist, rad, cbf = ex["dn2_dist"], ex["dn2_rad"], ex["dn2_cbf"]
+            else:  # direct conv invocation without the stack's hoist
+                dist, rad, cbf = _dimenet_geometry_dense(
+                    batch, pos, self.num_spherical, self.num_radial,
+                    self.cutoff, self.envelope_exponent,
+                )
+        elif "dn_dist" in ex:
             # hoisted by DIMEStack._prepare_batch: dist/angle/sbf are
             # parameter-free functions of the batch, identical for every
             # interaction block — computed ONCE per forward instead of
@@ -270,33 +415,34 @@ class DimeNetConv(nn.Module):
         # InteractionPPBlock
         rbf_b = TorchLinear(self.basis_emb_size, use_bias=False, name="int_rbf1")(rbf)
         rbf_b = TorchLinear(self.hidden_dim, use_bias=False, name="int_rbf2")(rbf_b)
-        sbf_b = TorchLinear(self.basis_emb_size, use_bias=False, name="int_sbf1")(sbf)
-        sbf_b = TorchLinear(self.int_emb_size, use_bias=False, name="int_sbf2")(sbf_b)
+        lin_sbf1 = TorchLinear(
+            self.basis_emb_size, use_bias=False, name="int_sbf1"
+        )
+        lin_sbf2 = TorchLinear(
+            self.int_emb_size, use_bias=False, name="int_sbf2"
+        )
         x_ji = act(TorchLinear(self.hidden_dim, name="int_lin_ji")(e))
         x_kj = act(TorchLinear(self.hidden_dim, name="int_lin_kj")(e))
         x_kj = x_kj * rbf_b
         x_kj = act(TorchLinear(self.int_emb_size, use_bias=False, name="int_down")(x_kj))
-        if self.partition_axis is not None:
-            from hydragnn_tpu.parallel.graph_partition import halo_extend
-
-            # extend the edge-state table with fresh (k->j) states from
-            # their owner shards; idx_kj already references this layout
-            x_kj = halo_extend(
-                x_kj, ex["halo_send_edges"], self.partition_axis
-            )
-        x_kj = jnp.where(trip_mask[:, None], x_kj[idx_kj] * sbf_b, 0.0)
-        if "tripnbr_idx" in ex and self.partition_axis is None:
-            # dense scatter-free triplet aggregation: precomputed per-edge
-            # member lists; backward is a pure gather by idx_ji
-            # (ops/dense_agg.group_sum). Not under partition: per-shard
-            # trip_ji rows are shard-local, the flattened lists would
-            # collide across shards.
-            from hydragnn_tpu.ops.dense_agg import group_sum
-
-            x_kj = group_sum(
-                x_kj, ex["tripnbr_idx"], ex["tripnbr_mask"], idx_ji, trip_mask
+        if bmm_mode:
+            x_kj = _bmm_triplet_aggregate(
+                x_kj, rad, cbf, lin_sbf1, lin_sbf2, batch,
+                self.num_spherical, self.num_radial,
             )
         else:
+            idx_kj, idx_ji = ex["trip_kj"], ex["trip_ji"]
+            trip_mask = ex["trip_mask"]
+            sbf_b = lin_sbf2(lin_sbf1(sbf))
+            if self.partition_axis is not None:
+                from hydragnn_tpu.parallel.graph_partition import halo_extend
+
+                # extend the edge-state table with fresh (k->j) states from
+                # their owner shards; idx_kj already references this layout
+                x_kj = halo_extend(
+                    x_kj, ex["halo_send_edges"], self.partition_axis
+                )
+            x_kj = jnp.where(trip_mask[:, None], x_kj[idx_kj] * sbf_b, 0.0)
             x_kj = segment_sum(x_kj, idx_ji, num_edges)
         x_kj = act(TorchLinear(self.hidden_dim, use_bias=False, name="int_up")(x_kj))
         hh = x_ji + x_kj
@@ -339,33 +485,50 @@ class DIMEStack(HydraBase):
     conv_use_batchnorm: bool = False  # Identity feature layers (DIMEStack.py:73)
 
     def _prepare_batch(self, batch):
-        """Hoist dist/angle/sbf: parameter-free functions of the batch that
-        every interaction block consumes identically — one evaluation of
-        the spherical Bessel/Legendre chains per forward instead of
-        ``num_conv_layers`` (the reference recomputes per block,
-        ``DIMEStack.py:79-116``; on TPU the transcendental chain is VPU
-        time that scaled with depth for no reason)."""
+        """Hoist the parameter-free geometry that every interaction block
+        consumes identically — one evaluation of the spherical Bessel /
+        Legendre chains per forward instead of ``num_conv_layers`` (the
+        reference recomputes per block, ``DIMEStack.py:79-116``; on TPU
+        the transcendental chain is VPU time that scaled with depth for
+        no reason). Dense-list batches get the bmm-path geometry
+        (dist/rad/cbf on the per-node slot grids); triplet-table batches
+        get dist/sbf on the T axis."""
         ex = batch.extras
         if (
             ex is None
-            or "trip_i" not in ex
             or "dn_dist" in ex
+            or "dn2_rad" in ex
             or self.partition_axis is not None
             # partition mode: geometry must be evaluated on the PER-LAYER
             # halo-extended node table inside _apply_conv, not here
         ):
             return batch
-        dist, sbf = _dimenet_geometry(
-            batch,
-            batch.pos,
-            self.num_spherical,
-            self.num_radial,
-            self.radius,
-            self.envelope_exponent,
-            self.partition_axis,
-        )
         merged = dict(ex)
-        merged.update({"dn_dist": dist, "dn_sbf": sbf})
+        if "out_edge" in ex:
+            dist, rad, cbf = _dimenet_geometry_dense(
+                batch,
+                batch.pos,
+                self.num_spherical,
+                self.num_radial,
+                self.radius,
+                self.envelope_exponent,
+            )
+            merged.update(
+                {"dn2_dist": dist, "dn2_rad": rad, "dn2_cbf": cbf}
+            )
+        elif "trip_i" in ex:
+            dist, sbf = _dimenet_geometry(
+                batch,
+                batch.pos,
+                self.num_spherical,
+                self.num_radial,
+                self.radius,
+                self.envelope_exponent,
+                self.partition_axis,
+            )
+            merged.update({"dn_dist": dist, "dn_sbf": sbf})
+        else:
+            return batch
         return batch.replace(extras=merged)
 
     def get_conv(self, in_dim, out_dim, last_layer=False, name=None, **kw):

@@ -53,6 +53,7 @@ def build_neighbor_lists(
     num_nodes: int,
     k_in: int,
     k_out: int,
+    with_slot_tables: bool = False,
 ):
     """Host-side (numpy) conversion of an edge list into dense lists.
 
@@ -63,6 +64,12 @@ def build_neighbor_lists(
       ``rev_idx   [N, K_out]`` flat (receiver*K_in + slot) position of each
                                outgoing edge — the backward-gather index
       ``rev_mask  [N, K_out]``
+    ``with_slot_tables`` (DimeNet's bmm-triplet path only — they are wire
+    overhead for every other model) adds:
+      ``out_edge  [N, K_out]`` edge-list row of each outgoing-edge slot
+      ``edge_slot [E]``        flat (receiver*K_in + slot) of each edge
+      ``out_slot  [E]``        flat (sender*K_out + slot) of each edge
+    (the out-slot validity mask is ``rev_mask`` — same grouping).
     Real edges only (``edge_mask`` False rows are padding and excluded).
     Built on :func:`build_group_lists` (one slot-assignment implementation
     for every single-owner grouping).
@@ -82,13 +89,25 @@ def build_neighbor_lists(
         senders, edge_mask, num_nodes, k_out, label="k_out"
     )
     rev_idx = np.where(rev_mask, flat_of_edge[out_edge], 0).astype(np.int32)
-    return {
+    out = {
         "nbr_idx": nbr_idx,
         "nbr_edge": nbr_edge,
         "nbr_mask": nbr_mask,
         "rev_idx": rev_idx,
         "rev_mask": rev_mask,
     }
+    if with_slot_tables:
+        # inverse permutation of out_edge — the bmm-triplet path routes
+        # per-(sender, out-slot) results back onto the edge table with it
+        slot_out_of_edge = np.zeros(senders.shape[0], np.int64)
+        rr, ss = np.nonzero(rev_mask)
+        slot_out_of_edge[out_edge[rr, ss]] = rr * k_out + ss
+        out.update(
+            out_edge=out_edge,
+            edge_slot=flat_of_edge.astype(np.int32),
+            out_slot=slot_out_of_edge.astype(np.int32),
+        )
+    return out
 
 
 @jax.custom_vjp
@@ -146,6 +165,58 @@ def _group_sum_bwd(res, g):
 
 
 group_sum.defvjp(_group_sum_fwd, _group_sum_bwd)
+
+
+@jax.custom_vjp
+def gather_rows_to_slots(table, lists, lists_mask, slot_of_row, row_valid):
+    """``table[lists]`` ([R, D] -> [G, K, D]) for a SINGLE-OWNER grouping
+    (every valid table row appears in exactly one list slot). Backward is
+    the inverse permutation ``g.reshape(G*K, D)[slot_of_row]`` — a pure
+    gather, no scatter-add in either direction."""
+    return jnp.where(lists_mask[..., None], table[lists], 0.0)
+
+
+def _grs_fwd(table, lists, lists_mask, slot_of_row, row_valid):
+    return (
+        gather_rows_to_slots(table, lists, lists_mask, slot_of_row, row_valid),
+        (table.shape, lists.shape, slot_of_row, row_valid),
+    )
+
+
+def _grs_bwd(res, g):
+    (r, d), (grp, k), slot_of_row, row_valid = res
+    gt = g.reshape(grp * k, d)[slot_of_row]
+    return jnp.where(row_valid[:, None], gt, 0.0), None, None, None, None
+
+
+gather_rows_to_slots.defvjp(_grs_fwd, _grs_bwd)
+
+
+@jax.custom_vjp
+def slots_to_rows(slots, slot_of_row, row_valid, lists, lists_mask):
+    """Inverse of :func:`gather_rows_to_slots`: route per-slot values
+    ``slots [G, K, D]`` back onto their owning rows -> ``[R, D]``.
+    Backward gathers the row cotangent through ``lists`` — the exact dual,
+    scatter-free both directions."""
+    g, k, d = slots.shape
+    out = slots.reshape(g * k, d)[slot_of_row]
+    return jnp.where(row_valid[:, None], out, 0.0)
+
+
+def _str_fwd(slots, slot_of_row, row_valid, lists, lists_mask):
+    return (
+        slots_to_rows(slots, slot_of_row, row_valid, lists, lists_mask),
+        (lists, lists_mask),
+    )
+
+
+def _str_bwd(res, g):
+    lists, lists_mask = res
+    gs = jnp.where(lists_mask[..., None], g[lists], 0.0)
+    return gs, None, None, None, None
+
+
+slots_to_rows.defvjp(_str_fwd, _str_bwd)
 
 
 def build_group_lists(
@@ -250,19 +321,10 @@ def attach_neighbor_lists(batch):
         int(batch.x.shape[-2]),
         k_in,
         k_out,
+        # DimeNet batches (triplet extras present) get the bmm-path slot
+        # tables; other models never read them
+        with_slot_tables="trip_ji" in (batch.extras or {}),
     )
     merged = dict(batch.extras or {})
     merged.update({k: jnp.asarray(v) for k, v in extras.items()})
-    if "trip_ji" in merged:
-        # DimeNet batches: per-edge incoming-triplet member lists too
-        tji = np.asarray(merged["trip_ji"])
-        tmask = np.asarray(merged["trip_mask"])
-        kt = (
-            int(np.bincount(tji[tmask]).max()) if tmask.any() else 1
-        )
-        tl, tm = build_group_lists(
-            tji, tmask, int(batch.senders.shape[-1]), kt, label="kt"
-        )
-        merged["tripnbr_idx"] = jnp.asarray(tl)
-        merged["tripnbr_mask"] = jnp.asarray(tm)
     return batch.replace(extras=merged)

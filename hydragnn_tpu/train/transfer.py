@@ -36,23 +36,22 @@ def _offset_local_shard(batch: GraphBatch, rank: int) -> GraphBatch:
                 ex[key] = (np.asarray(ex[key], np.int64) + n_off).astype(
                     np.int32
                 )
-        for key in ("trip_kj", "trip_ji", "nbr_edge"):
+        for key in ("trip_kj", "trip_ji", "nbr_edge", "out_edge"):
             if key in ex:
                 ex[key] = (np.asarray(ex[key], np.int64) + e_off).astype(
                     np.int32
                 )
-        if "rev_idx" in ex:
-            # flat (row * k_in + slot): global row offset scales by k_in
-            k_in = ex["nbr_idx"].shape[-1]
-            ex["rev_idx"] = (
-                np.asarray(ex["rev_idx"], np.int64) + n_off * k_in
-            ).astype(np.int32)
-        if "tripnbr_idx" in ex:
-            # member lists reference triplet-table rows
-            t_off = rank * ex["trip_mask"].shape[-1]
-            ex["tripnbr_idx"] = (
-                np.asarray(ex["tripnbr_idx"], np.int64) + t_off
-            ).astype(np.int32)
+        for key, k_key in (
+            ("rev_idx", "nbr_idx"),  # flat (receiver * k_in + slot)
+            ("edge_slot", "nbr_idx"),
+            ("out_slot", "out_edge"),  # flat (sender * k_out + slot)
+        ):
+            if key in ex:
+                # flat (row * K + slot): global row offset scales by K
+                k = ex[k_key].shape[-1]
+                ex[key] = (
+                    np.asarray(ex[key], np.int64) + n_off * k
+                ).astype(np.int32)
         rep["extras"] = ex
     return batch.replace(**rep)
 

@@ -186,12 +186,13 @@ def pytest_train_model_multistep_dispatch(model_type):
     )
 
 
-@pytest.mark.parametrize("model_type", ["PNA"])
+@pytest.mark.parametrize("model_type", ["PNA", "DimeNet"])
 def pytest_train_model_dense_aggregation(model_type):
     """Scatter-free dense neighbor-list aggregation (dense_aggregation:
     true) through the public API must hit the same accuracy ceilings as
     the segment path — it is the performance mode for MXU-scale configs
-    (ops/dense_agg.py)."""
+    (ops/dense_agg.py). DimeNet's dense mode is the bmm-triplet path
+    (models/dimenet.py): no T axis, no host-side compute_triplets."""
     unittest_train_model(
         model_type,
         "ci.json",
