@@ -101,14 +101,46 @@ def _lcm(a, b):
     return a * b // math.gcd(a, b)
 
 
+# Measured dense/segment crossovers (BASELINE.md rounds 2-4, v5e,
+# same-session A/Bs at deg ~12): minimum hidden_dim at which the dense
+# scatter-free path beats segment reductions for each model. Scatter-heavy
+# models (PNA's 4 aggregators, GAT's edge softmax, MFC's degree banks,
+# DimeNet's triplet axis) cross early; GIN/SAGE/CGCNN only win mildly at
+# MXU widths; SchNet and EGNN never do (one already-fused scatter per
+# layer — the dense frame's extra gathers cost more than it removes).
+_DENSE_AUTO_MIN_HIDDEN = {
+    "PNA": 96,
+    "GAT": 96,
+    "MFC": 96,
+    "DimeNet": 96,
+    "GIN": 192,
+    "SAGE": 192,
+    # CGCNN deliberately absent: its convs run at input_dim width
+    # (constant-width CGConv, create.py), so hidden_dim says nothing
+    # about where it sits relative to the crossover — explicit flag only.
+}
+
+
+def auto_dense_aggregation(arch_config: dict) -> bool:
+    """The measured-crossover policy: dense iff the (model type, width)
+    point sits on the dense-winning side of the table above."""
+    th = _DENSE_AUTO_MIN_HIDDEN.get(arch_config.get("model_type"))
+    return th is not None and int(arch_config.get("hidden_dim") or 0) >= th
+
+
 def needs_dense_neighbors(arch_config: dict) -> bool:
-    """Single opt-in rule for dense scatter-free aggregation in the
-    BATCH-collate path: the config flag, except under graph partitioning —
-    there the partitioner builds per-shard lists itself
+    """Single rule for dense scatter-free aggregation in the BATCH-collate
+    path. ``dense_aggregation`` absent/None = AUTO (the measured-crossover
+    policy picks the winning path per model x width); an explicit
+    true/false always wins. Off under graph partitioning — there the
+    partitioner builds per-shard lists itself
     (``partition_graph(need_neighbors=True)``, wired by the driver)."""
-    return bool(arch_config.get("dense_aggregation")) and not arch_config.get(
-        "partition_axis"
-    )
+    if arch_config.get("partition_axis"):
+        return False
+    flag = arch_config.get("dense_aggregation")
+    if flag is None:
+        return auto_dense_aggregation(arch_config)
+    return bool(flag)
 
 
 def _sample_stats(datasets, need_triplets, need_neighbors):

@@ -90,7 +90,11 @@ def create_model_config(config: dict, verbosity: int = 0) -> HydraBase:
         assert (
             config.get("max_neighbours") is not None
         ), "MFC requires max_neighbours input."
-        return MFCStack(max_degree=config["max_neighbours"], **common)
+        return MFCStack(
+            max_degree=config["max_neighbours"],
+            degree_bound=config.get("mfc_degree_bound"),
+            **common,
+        )
     if model_type == "CGCNN":
         # constant width: hidden == input (CGCNNStack.py:30-40); conv node
         # heads unsupported (CGCNNStack.py:66-89)

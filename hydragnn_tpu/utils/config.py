@@ -84,6 +84,40 @@ def update_config(config, train_loader, val_loader, test_loader):
         arch["max_neighbours"] = len(deg) - 1
     else:
         arch["pna_deg"] = None
+    if "dense_aggregation" not in arch and not arch.get("partition_axis"):
+        # record the AUTO aggregation-path decision (measured-crossover
+        # policy, data/loaders.py) so the saved config and downstream
+        # consumers see the resolved value; an explicit true/false in the
+        # input config always wins, and partition mode keeps its own
+        # explicit opt-in (per-shard lists change the memory equation)
+        from hydragnn_tpu.data.loaders import auto_dense_aggregation
+
+        arch["dense_aggregation"] = auto_dense_aggregation(arch)
+    if arch["model_type"] == "MFC":
+        # dataset-wide max in-degree: a STATIC bound that lets the conv
+        # slice dead banks out of its one-hot degree matmul (the reference
+        # allocates and applies all max_neighbours+1 banks regardless —
+        # MFCStack.py:22-51; parameter shapes here stay identical, only
+        # the compute shrinks). Derived only when every split iterates
+        # locally (a DistDataset walk would pull the whole dataset over
+        # the store transport); None just skips the slicing. Re-derived on
+        # every run and MAXed with any existing value, so a bound saved
+        # from a smaller dataset can never clamp a higher-degree node to
+        # the wrong bank on reload.
+        local = all(
+            not hasattr(ld.dataset, "epoch_begin")
+            for ld in (train_loader, val_loader, test_loader)
+        )
+        if local:
+            derived = max_in_degree(
+                ld.dataset for ld in (train_loader, val_loader, test_loader)
+            )
+            prior = arch.get("mfc_degree_bound")
+            arch["mfc_degree_bound"] = (
+                derived if prior is None else max(int(prior), derived)
+            )
+        else:
+            arch.setdefault("mfc_degree_bound", None)
 
     for key in (
         "radius",
@@ -249,6 +283,24 @@ def update_config_minmax(dataset_path, var_config):
     return var_config
 
 
+def _in_degree_counts(d) -> np.ndarray:
+    """Per-node in-degree of one sample (shared by the PNA histogram and
+    the MFC bound so the two derivations cannot drift)."""
+    return np.bincount(d.edge_index[1], minlength=d.num_nodes)
+
+
+def max_in_degree(datasets) -> int:
+    """Dataset-wide max in-degree (all splits), reduced across hosts."""
+    from hydragnn_tpu.parallel.distributed import host_allreduce
+
+    m = 0
+    for ds in datasets:
+        for d in ds:
+            if d.num_edges:
+                m = max(m, int(_in_degree_counts(d).max()))
+    return int(host_allreduce(np.asarray([m]), op="max")[0])
+
+
 def gather_deg(dataset) -> np.ndarray:
     """In-degree histogram over the dataset for PNA scalers
     (``preprocess/utils.py:177-234``), reduced across hosts."""
@@ -257,13 +309,11 @@ def gather_deg(dataset) -> np.ndarray:
     max_deg = 0
     for d in dataset:
         if d.num_edges:
-            counts = np.bincount(d.edge_index[1], minlength=d.num_nodes)
-            max_deg = max(max_deg, int(counts.max()))
+            max_deg = max(max_deg, int(_in_degree_counts(d).max()))
     max_deg = int(host_allreduce(np.asarray([max_deg]), op="max")[0])
     deg = np.zeros(max_deg + 1, dtype=np.int64)
     for d in dataset:
-        counts = np.bincount(d.edge_index[1], minlength=d.num_nodes)
-        deg += np.bincount(counts, minlength=max_deg + 1)
+        deg += np.bincount(_in_degree_counts(d), minlength=max_deg + 1)
     return host_allreduce(deg, op="sum")
 
 

@@ -133,6 +133,62 @@ def pytest_update_config_pna_degree_histogram():
     assert arch["max_neighbours"] == 2
 
 
+def pytest_auto_dense_aggregation_policy():
+    """The measured-crossover policy (BASELINE.md): scatter-heavy models
+    pick the dense path at MXU widths with NO config flag; SchNet/EGNN
+    never do; an explicit flag and partition mode always win."""
+    from hydragnn_tpu.data.loaders import needs_dense_neighbors
+
+    for m in ("PNA", "GAT", "MFC", "DimeNet"):
+        assert needs_dense_neighbors({"model_type": m, "hidden_dim": 256})
+        assert needs_dense_neighbors({"model_type": m, "hidden_dim": 96})
+        assert not needs_dense_neighbors({"model_type": m, "hidden_dim": 64})
+    for m in ("GIN", "SAGE"):
+        assert needs_dense_neighbors({"model_type": m, "hidden_dim": 256})
+        assert not needs_dense_neighbors({"model_type": m, "hidden_dim": 128})
+    # SchNet/EGNN: one fused scatter/layer — dense never wins. CGCNN runs
+    # at input_dim width, so hidden_dim is not a crossover signal.
+    for m in ("SchNet", "EGNN", "CGCNN"):
+        assert not needs_dense_neighbors({"model_type": m, "hidden_dim": 512})
+    # explicit override beats the policy in both directions
+    assert not needs_dense_neighbors(
+        {"model_type": "PNA", "hidden_dim": 256, "dense_aggregation": False}
+    )
+    assert needs_dense_neighbors(
+        {"model_type": "EGNN", "hidden_dim": 64, "dense_aggregation": True}
+    )
+    # partition mode always builds its own per-shard lists
+    assert not needs_dense_neighbors(
+        {"model_type": "PNA", "hidden_dim": 256, "partition_axis": "data"}
+    )
+
+
+def pytest_update_config_records_auto_dense():
+    """update_config writes the resolved AUTO decision into the arch so
+    saved configs show which path ran."""
+    cfg = {"NeuralNetwork": _nn_config()}
+    cfg["NeuralNetwork"]["Architecture"]["model_type"] = "PNA"
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 256
+    loaders = [_Loader([_Sample(4)])] * 3
+    config = update_config(copy.deepcopy(cfg), *loaders)
+    assert config["NeuralNetwork"]["Architecture"]["dense_aggregation"] is True
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 8
+    config = update_config(copy.deepcopy(cfg), *loaders)
+    assert config["NeuralNetwork"]["Architecture"]["dense_aggregation"] is False
+
+
+def pytest_update_config_mfc_degree_bound():
+    """MFC configs derive a dataset-wide static in-degree bound so the
+    conv can slice dead banks from its one-hot degree matmul."""
+    cfg = {"NeuralNetwork": _nn_config()}
+    cfg["NeuralNetwork"]["Architecture"]["model_type"] = "MFC"
+    cfg["NeuralNetwork"]["Architecture"]["max_neighbours"] = 50
+    loaders = [_Loader([_Sample(4)])] * 3
+    config = update_config(copy.deepcopy(cfg), *loaders)
+    # ring graph: every node has in-degree exactly 2
+    assert config["NeuralNetwork"]["Architecture"]["mfc_degree_bound"] == 2
+
+
 def pytest_update_config_rejects_mlp_per_node_variable_size():
     """``mlp_per_node`` + variable graph size must raise
     (``config_utils.py:156-192`` analog)."""
