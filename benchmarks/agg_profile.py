@@ -101,29 +101,3 @@ print("windowed == gather parity:", ok)
 print("windowed fwd (+mix) ms/iter:", round(timeloop(body_wfwd, z0), 3))
 print("windowed fwd+bwd ms/iter:", round(timeloop(body_wbwd, z0), 3))
 
-# ---- banded Pallas kernel (ops/pallas_window.py): same aggregation via
-# window_gather, whose VJP is the dual banded scatter.
-from hydragnn_tpu.ops.pallas_window import window_gather
-
-HALO = 1  # 90-row graphs => band < 128
-
-def kernel_agg(z):
-    h = window_gather(z, nbr_idx.reshape(-1), HALO, K).reshape(N, K, D)
-    h = h.astype(dtype)
-    h = jnp.where(nbr_mask[..., None], h, 0.0)
-    mean, std, degv, has = dense_moments(h, nbr_mask)
-    mn, mx = dense_minmax(h, nbr_mask, has)
-    return jnp.concatenate([mean, std, mn, mx], axis=-1).astype(dtype)
-
-def body_kfwd(i, z):
-    return 0.5 * z + 0.5 * (kernel_agg(z) @ wmix)
-
-def body_kbwd(i, z):
-    g = jax.grad(lambda zz: (kernel_agg(zz).astype(jnp.float32) ** 2).sum())(z)
-    return 0.5 * z + 0.5 * g.astype(dtype)
-
-okk = np.allclose(np.asarray(jax.jit(kernel_agg)(z0), np.float32),
-                  np.asarray(jax.jit(agg)(z0), np.float32), atol=2e-2)
-print("banded kernel == gather parity:", okk)
-print("banded fwd (+mix) ms/iter:", round(timeloop(body_kfwd, z0), 3))
-print("banded fwd+bwd ms/iter:", round(timeloop(body_kbwd, z0), 3))

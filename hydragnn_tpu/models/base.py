@@ -108,9 +108,6 @@ class HydraBase(nn.Module):
     loss_nll: bool = False
     num_conv_layers: int = 2
     num_nodes: Optional[int] = None
-    # guaranteed max nodes of ANY graph across all splits (update_config);
-    # sizes the banded-kernel halo — num_nodes cannot (first-sample pin)
-    max_graph_nodes: Optional[int] = None
     edge_dim: Optional[int] = None
     conv_checkpointing: bool = False
     initial_bias: Optional[float] = None
@@ -131,20 +128,6 @@ class HydraBase(nn.Module):
     @property
     def use_edge_attr(self) -> bool:
         return self.edge_dim is not None and self.edge_dim > 0
-
-    def window_halo(self) -> Optional[int]:
-        """Static banded-gather halo (in 128-row blocks) for the dense
-        aggregation path: packed batches keep each graph's node rows
-        contiguous and neighbors never leave their graph, so
-        ``|nbr_idx - n| < max_graph_nodes``. Only ``max_graph_nodes``
-        qualifies as the bound (a guaranteed dataset-wide max over ALL
-        splits, derived by ``update_config``) — ``num_nodes`` is pinned
-        to the FIRST sample by the reference contract and would
-        under-size the band on variable-size datasets, silently dropping
-        out-of-band neighbors. None disables the windowed kernels."""
-        if self.max_graph_nodes and self.partition_axis is None:
-            return max(1, -(-int(self.max_graph_nodes) // 128))
-        return None
 
     @property
     def num_heads(self) -> int:

@@ -65,7 +65,6 @@ def create_model_config(config: dict, verbosity: int = 0) -> HydraBase:
         loss_weights=_normalize_weights(config.get("task_weights"), num_heads),
         num_conv_layers=config["num_conv_layers"],
         num_nodes=config.get("num_nodes"),
-        max_graph_nodes=config.get("max_graph_nodes"),
         conv_checkpointing=config.get("conv_checkpointing", False),
         initial_bias=config.get("initial_bias"),
         # uncertainty-weighted NLL multi-task loss — the mode the reference

@@ -42,8 +42,6 @@ class PNAConv(nn.Module):
     avg_deg_log: float
     avg_deg_lin: float
     edge_dim: Optional[int] = None
-    # static banded-gather halo (HydraBase.window_halo); None = XLA gather
-    window_halo: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, pos, batch, train: bool = False):
@@ -77,7 +75,11 @@ class PNAConv(nn.Module):
 
         if dense:
             # scatter-free path: fixed-width neighbor lists, aggregations
-            # as masked K-axis reductions, backward via the reverse list
+            # as masked K-axis reductions, backward via the reverse list.
+            # (A fused banded Pallas variant of this gather+stats pass was
+            # built and measured in rounds 3-4 — it lost to XLA's own
+            # fusion at every scale and was deleted; closing A/B in
+            # BASELINE.md round 4.)
             from hydragnn_tpu.ops.dense_agg import (
                 dense_minmax,
                 dense_moments,
@@ -86,44 +88,14 @@ class PNAConv(nn.Module):
 
             nbr_mask = extras["nbr_mask"]
             nbr_idx = extras["nbr_idx"]
-            from hydragnn_tpu.ops.pallas_window import (
-                window_enabled,
-                window_gather_stats,
-            )
-
-            k_in = nbr_idx.shape[1]
-            if ze is None and window_enabled(
-                self.window_halo, k_in, self.in_dim
-            ):
-                # fused banded kernel: gather (onehot@block matmuls over
-                # the ±halo table blocks) AND all four aggregation
-                # statistics in one VMEM-resident pass — the [N, K, D]
-                # message tensor never exists in HBM; the backward
-                # recomputes the tile and scatters through the dual
-                # banded kernel (no reverse lists). Edge-feature convs
-                # fall through to the unfused path (the per-edge ze term
-                # needs a second, differently-banded table).
-                mean_z, std, mn_z, mx_z, cnt = window_gather_stats(
-                    yj,
-                    nbr_idx.reshape(-1),
-                    nbr_mask.reshape(-1),
-                    self.window_halo,
-                    k_in,
-                )
-                dt = yj.dtype
-                mean_z, std = mean_z.astype(dt), std.astype(dt)
-                mn_z, mx_z = mn_z.astype(dt), mx_z.astype(dt)
-                has = cnt > 0
-                deg = jnp.maximum(cnt, 1.0).astype(dt)
-            else:
-                z = gather_neighbors(
-                    yj, nbr_idx, extras["rev_idx"], extras["rev_mask"]
-                )  # [N, K, D]
-                if ze is not None:
-                    z = z + ze[extras["nbr_edge"]]
-                z = jnp.where(nbr_mask[..., None], z, 0.0)
-                mean_z, std, deg, has = dense_moments(z, nbr_mask)
-                mn_z, mx_z = dense_minmax(z, nbr_mask, has)
+            z = gather_neighbors(
+                yj, nbr_idx, extras["rev_idx"], extras["rev_mask"]
+            )  # [N, K, D]
+            if ze is not None:
+                z = z + ze[extras["nbr_edge"]]
+            z = jnp.where(nbr_mask[..., None], z, 0.0)
+            mean_z, std, deg, has = dense_moments(z, nbr_mask)
+            mn_z, mx_z = dense_minmax(z, nbr_mask, has)
         else:
             z = yj[batch.senders]  # [E, D]
             if ze is not None:
@@ -194,5 +166,4 @@ class PNAStack(HydraBase):
             avg_deg_log=avg_log,
             avg_deg_lin=avg_lin,
             edge_dim=self.edge_dim if self.use_edge_attr else None,
-            window_halo=self.window_halo(),
         )

@@ -39,40 +39,21 @@ def update_config(config, train_loader, val_loader, test_loader):
 
     arch = config["NeuralNetwork"]["Architecture"]
     # guaranteed dataset-wide max graph size (unlike num_nodes, which the
-    # reference contract pins to the FIRST sample): the banded-kernel halo
-    # (HydraBase.window_halo) must bound EVERY graph or out-of-band
-    # neighbors would silently drop — multi-host takes the global max
-    # the bound must be dataset-wide or absent: computed when every split
-    # offers the index-only scan (free), or when the only consumer — the
-    # HYDRAGNN_WINDOW=1 banded kernels — is actually opted in (then a full
-    # sample walk is justified); otherwise None keeps startup O(1) and the
-    # kernels stay off rather than running with an unsound band
+    # reference contract pins to the FIRST sample) — derived metadata,
+    # computed only when every split offers the index-only scan (free);
+    # otherwise None keeps startup O(1). The decision must be
+    # collective-consistent: every host joins the same cheap decision
+    # reduce first so no host is stranded in the allreduce below.
     from hydragnn_tpu.parallel.distributed import host_allreduce
 
     loaders = (train_loader, val_loader, test_loader)
     fast = all(hasattr(ld.dataset, "graph_sizes") for ld in loaders)
-    # the scan-or-not decision itself must be collective-consistent: an env
-    # var (or dataset wrapper) differing per host would otherwise strand
-    # some hosts in the allreduce below — so every host always joins the
-    # same two cheap decision reduces first. Scan iff EVERY host has the
-    # free index-only path (min) or ANY host opted into the kernels (max):
-    # one slow host must not drag fast hosts into an O(dataset) walk
-    # unless the walk was actually requested.
-    env_want = os.getenv("HYDRAGNN_WINDOW", "0") == "1"
     all_fast = bool(host_allreduce(np.asarray([int(fast)]), op="min")[0])
-    any_want = bool(host_allreduce(np.asarray([int(env_want)]), op="max")[0])
-    if all_fast or any_want:
+    if all_fast:
         local_max = 0
         for loader in loaders:
-            ds = loader.dataset
-            if hasattr(ds, "graph_sizes"):  # index-only (shard/dist stores)
-                sizes = ds.graph_sizes()
-                local_max = max(
-                    local_max, int(sizes.max()) if len(sizes) else 0
-                )
-            else:
-                for d in ds:
-                    local_max = max(local_max, int(d.num_nodes))
+            sizes = loader.dataset.graph_sizes()  # index-only
+            local_max = max(local_max, int(sizes.max()) if len(sizes) else 0)
         arch["max_graph_nodes"] = int(
             host_allreduce(np.asarray([local_max]), op="max")[0]
         )
@@ -98,26 +79,28 @@ def update_config(config, train_loader, val_loader, test_loader):
         # slice dead banks out of its one-hot degree matmul (the reference
         # allocates and applies all max_neighbours+1 banks regardless —
         # MFCStack.py:22-51; parameter shapes here stay identical, only
-        # the compute shrinks). Derived only when every split iterates
-        # locally (a DistDataset walk would pull the whole dataset over
-        # the store transport); None just skips the slicing. Re-derived on
-        # every run and MAXed with any existing value, so a bound saved
-        # from a smaller dataset can never clamp a higher-degree node to
-        # the wrong bank on reload.
-        local = all(
+        # the compute shrinks). Derived ONLY from plain in-memory splits
+        # (store-backed datasets — graph_sizes/epoch_begin markers — would
+        # pay an O(dataset) edge walk at startup, or store-transport
+        # traffic for DistDataset); everywhere else the bound is cleared
+        # to None, never trusted from a loaded config: a stale bound from
+        # a smaller dataset would silently clamp higher-degree nodes to
+        # the wrong bank. The walk-or-not decision is reduced across
+        # hosts first (min) so no host is stranded in max_in_degree's
+        # allreduce if dataset wrappers differ.
+        cheap = all(
             not hasattr(ld.dataset, "epoch_begin")
+            and not hasattr(ld.dataset, "graph_sizes")
             for ld in (train_loader, val_loader, test_loader)
         )
-        if local:
-            derived = max_in_degree(
+        all_cheap = bool(host_allreduce(np.asarray([int(cheap)]), op="min")[0])
+        arch["mfc_degree_bound"] = (
+            max_in_degree(
                 ld.dataset for ld in (train_loader, val_loader, test_loader)
             )
-            prior = arch.get("mfc_degree_bound")
-            arch["mfc_degree_bound"] = (
-                derived if prior is None else max(int(prior), derived)
-            )
-        else:
-            arch.setdefault("mfc_degree_bound", None)
+            if all_cheap
+            else None
+        )
 
     for key in (
         "radius",
