@@ -248,26 +248,18 @@ def bench_torch_baseline():
     return BATCH_GRAPHS * BASELINE_STEPS / best_dt
 
 
-def bench_extra_rows():
-    """Per-model and MXU-scale rows (round-2 verdict items 2-3): every one
-    of the 9 model stacks measured at OC20 scale (hidden 256, ~90 atoms,
-    degree 12) on the segment AND dense paths, plus the headline-scale
-    per-model rows, each with XLA-counted TFLOP/s and MFU. Written to
-    BENCH_EXTRA.json (NOT the headline stdout line — round-2's headline was
-    lost to driver tail-truncation of one oversized line). Skippable via
-    HYDRAGNN_BENCH_EXTRAS=0."""
-    if os.getenv("HYDRAGNN_BENCH_EXTRAS", "1") == "0":
-        return []
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from benchmarks.model_bench import bench_model
-
+def _extra_configs():
     oc20 = dict(num_graphs=64, nodes=90, degree=12, layers=3)
-    # most-cited rows FIRST: the budget refreshes from the front and
-    # later configs carry over their previous measurements
     configs = [
         dict(model_type="PNA", hidden=256, **oc20),
         dict(model_type="PNA", hidden=256, dense=True, bf16=True, **oc20),
         dict(model_type="PNA", hidden=512, dense=True, bf16=True, **oc20),
+        # MFU trend at MXU widths (round-3 verdict item 6)
+        dict(model_type="PNA", hidden=1024, dense=True, bf16=True, **oc20),
+        dict(model_type="PNA", hidden=2048, dense=True, bf16=True, **oc20),
+        dict(model_type="GAT", hidden=1024, dense=True, bf16=True, **oc20),
+        # GAT dense precision A/B (bf16 counterpart in the matrix below)
+        dict(model_type="GAT", hidden=256, dense=True, **oc20),
         # headline-scale per-model rows
         dict(model_type="SchNet", hidden=64, num_graphs=256, nodes=18,
              degree=4, layers=3),
@@ -281,11 +273,35 @@ def bench_extra_rows():
         configs.append(dict(model_type=m, hidden=256, **oc20))
         configs.append(dict(model_type=m, hidden=256, dense=True, bf16=True,
                             **oc20))
-    # DimeNet's triplet axis makes hidden 256 OOM-prone on a shared chip;
-    # hidden 128 matches the BASELINE.md row
+    # DimeNet at the BASELINE.md row scale (hidden 128; 256 is OOM-prone
+    # on a shared chip)
     configs.append(dict(model_type="DimeNet", hidden=128, **oc20))
     configs.append(dict(model_type="DimeNet", hidden=128, dense=True,
                         bf16=True, **oc20))
+    return configs
+
+
+def bench_extra_rows(start: int = 0):
+    """Per-model and MXU-scale rows (round-2 verdict items 2-3): every one
+    of the 9 model stacks measured at OC20 scale (hidden 256, ~90 atoms,
+    degree 12) on the segment AND dense paths, plus the headline-scale
+    per-model rows and the MFU-trend widths, each with XLA-counted TFLOP/s
+    and MFU. Written to BENCH_EXTRA.json (NOT the headline stdout line —
+    round-2's headline was lost to driver tail-truncation of one oversized
+    line). ``start`` rotates the refresh window (persisted cursor in
+    BENCH_EXTRA.json) so every config is re-measured within ~2 runs of the
+    300 s budget instead of the front rows hogging every refresh.
+    Skippable via HYDRAGNN_BENCH_EXTRAS=0. Returns (rows, measured_count).
+    """
+    if os.getenv("HYDRAGNN_BENCH_EXTRAS", "1") == "0":
+        return [], 0
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.model_bench import bench_model
+    from hydragnn_tpu.data.loaders import auto_dense_aggregation
+
+    configs = _extra_configs()
+    start = start % len(configs)
+    rotated = configs[start:] + configs[:start]
     # soft deadline: the headline JSON prints LAST, so a driver-side kill
     # mid-extras would lose the round's recorded number (exactly round 2's
     # failure). Unmeasured configs keep their previous BENCH_EXTRA.json
@@ -293,13 +309,26 @@ def bench_extra_rows():
     budget_s = float(os.getenv("HYDRAGNN_BENCH_BUDGET", "300"))
     t0 = time.monotonic()
     rows = []
+    measured = 0
     skipped = 0
-    for kw in configs:
+    for kw in rotated:
         if time.monotonic() - t0 > budget_s:
             skipped += 1
             continue
+        measured += 1
         try:
-            rows.append(bench_model(**kw, iters=12))
+            row = bench_model(**kw, iters=12)
+            # what the AUTO policy would pick for this (model, width) —
+            # lets the table show the auto choice against the measured
+            # per-path winners
+            row["auto_choice"] = (
+                "dense"
+                if auto_dense_aggregation(
+                    {"model_type": kw["model_type"], "hidden_dim": kw["hidden"]}
+                )
+                else "segment"
+            )
+            rows.append(row)
         except Exception as e:
             print(f"extra row {kw} failed: {e}", file=sys.stderr)
     if skipped:
@@ -308,15 +337,26 @@ def bench_extra_rows():
             "kept their previous rows",
             file=sys.stderr,
         )
-    return rows
+    return rows, measured
 
 
-def merge_extra_rows(path, extra):
+def read_refresh_cursor(path) -> int:
+    """Persisted rotation cursor (0 when absent/unreadable)."""
+    try:
+        with open(path) as f:
+            return int(json.load(f).get("refresh_cursor", 0))
+    except Exception:
+        return 0
+
+
+def merge_extra_rows(path, extra, cursor=0):
     """Merge freshly measured rows into ``path`` by config identity:
     configs not re-measured this run keep their previous rows, explicitly
-    marked ``carried_over``; an unreadable existing file is backed up to
-    ``.bak`` and reported instead of silently eating history. Returns the
-    merged row list (also written to ``path``, atomically)."""
+    marked ``carried_over`` with an ``age`` (number of runs since last
+    measured); an unreadable existing file is backed up to ``.bak`` and
+    reported instead of silently eating history. Persists the rotation
+    ``cursor``. Returns the merged row list (also written to ``path``,
+    atomically)."""
     key_fields = ("model", "hidden", "graphs_per_batch", "nodes_per_graph",
                   "avg_degree", "layers", "precision", "aggregation")
 
@@ -344,28 +384,44 @@ def merge_extra_rows(path, extra):
         )
     for key in list(merged):
         merged[key]["carried_over"] = True  # stale unless re-measured
+        merged[key]["age"] = int(merged[key].get("age", 0)) + 1
     for row in extra:
         row.pop("carried_over", None)
+        row["age"] = 0
         merged[_key(row)] = row
     rows = list(merged.values())
+    carried = [r for r in rows if r.get("carried_over")]
+    print(
+        f"{len(carried)} of {len(rows)} rows carried over"
+        + (
+            f" (max age {max(r['age'] for r in carried)} runs)"
+            if carried
+            else ""
+        ),
+        file=sys.stderr,
+    )
     # atomic replace: a driver-side kill mid-write must not leave the
     # history file truncated (the failure mode this merge exists to survive)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"rows": rows}, f, indent=1)
+        json.dump({"rows": rows, "refresh_cursor": int(cursor)}, f, indent=1)
     os.replace(tmp, path)
     return rows
 
 
 def main():
     ours = bench_ours()
-    extra = bench_extra_rows()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_EXTRA.json")
+    cursor = read_refresh_cursor(out)
+    extra, measured = bench_extra_rows(start=cursor)
     # persist the expensive TPU rows BEFORE the torch baseline: a non-
-    # exception death there (OOM kill) must not discard them
-    if extra:
-        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_EXTRA.json")
-        rows = merge_extra_rows(out, extra)
+    # exception death there (OOM kill) must not discard them. Merge runs
+    # whenever configs were ATTEMPTED (measured > 0) even if every attempt
+    # failed — the cursor must advance past a failing window or the
+    # rotation would re-burn its whole budget on the same config forever.
+    if extra or measured:
+        rows = merge_extra_rows(out, extra, cursor=cursor + measured)
         print(
             f"wrote {len(extra)} fresh / {len(rows)} total extra rows "
             f"to {out}",

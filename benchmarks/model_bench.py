@@ -111,7 +111,6 @@ def _arch(model_type, hidden, layers, nodes):
         "task_weights": [1.0, 1.0],
         "num_conv_layers": layers,
         "num_nodes": nodes,
-        "max_graph_nodes": nodes,  # derived-metadata analog (update_config)
         "edge_dim": None,
         "pna_deg": [0, 0, 16, 32, 64, 32],
         "equivariance": model_type == "EGNN",

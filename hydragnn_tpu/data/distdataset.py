@@ -433,10 +433,11 @@ class DistDataset:
     def graph_sizes(self) -> np.ndarray:
         """LOCAL per-sample node counts, index-only (no store traffic).
 
-        Lets config derivation compute ``max_graph_nodes`` as a local max
-        + host allreduce instead of walking every GLOBAL index through the
-        store transport (O(world x dataset) traffic, and it would require
-        an open epoch window)."""
+        Size statistics over a DistDataset must come from here — walking
+        global indices would pull the whole dataset over the store
+        transport and require an open epoch window. The method's presence
+        also marks the dataset as store-backed for config derivation's
+        cheap/expensive-scan gates (``utils/config.py``)."""
         return self._local_graph_sizes
 
     def epoch_begin(self):

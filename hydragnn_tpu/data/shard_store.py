@@ -182,7 +182,7 @@ class ShardDataset:
     def graph_sizes(self) -> np.ndarray:
         """Per-sample node counts from the shard count indexes alone — no
         sample payloads are read, so dataset-wide size scans (layout
-        maxima, ``max_graph_nodes``) stay cheap at millions of samples."""
+        maxima) stay cheap at millions of samples."""
         sizes = np.concatenate(
             [
                 np.array(

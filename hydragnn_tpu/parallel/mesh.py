@@ -39,9 +39,11 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
     return Mesh(np.asarray(devices), (axis,))
 
 
-def shard_optimizer_state(opt_state, mesh):
-    """ZeRO-1 parity: shard optimizer-state leaves over the data axis where
-    divisible, replicate the rest (``utils/optimizer.py:48-139`` analog)."""
+def shard_over_data_axis(tree, mesh):
+    """Shard tree leaves over the data axis where dim 0 divides, replicate
+    the rest. ONE placement rule for every ZeRO stage — optimizer moments
+    (stage 1/2) and parameters (stage 3) must agree on which leaves shard
+    or the update step pays avoidable reshards."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -52,4 +54,22 @@ def shard_optimizer_state(opt_state, mesh):
             return jax.device_put(leaf, NamedSharding(mesh, P("data")))
         return jax.device_put(leaf, NamedSharding(mesh, P()))
 
-    return jax.tree_util.tree_map(place, opt_state)
+    return jax.tree_util.tree_map(place, tree)
+
+
+def shard_optimizer_state(opt_state, mesh):
+    """ZeRO-1/2 parity: shard optimizer-state leaves over the data axis
+    (``utils/optimizer.py:48-139`` analog). Gradient partitioning (the
+    stage-1/2 distinction) is not a user decision here — XLA schedules
+    the gradient reduction as reduce-scatter + all-gather itself when
+    profitable."""
+    return shard_over_data_axis(opt_state, mesh)
+
+
+def shard_parameters(params, mesh):
+    """ZeRO-3 parity: shard the PARAMETERS too (DeepSpeed stage 3,
+    ``run_training.py:134-151``). XLA inserts the per-use all-gathers;
+    see docs/MIGRATION.md for the measured why-and-when (GNN parameter
+    bytes are tiny next to activations, so this is a parity/completeness
+    knob, not a memory necessity)."""
+    return shard_over_data_axis(params, mesh)

@@ -133,15 +133,17 @@ class PartitionedTrainer:
         self.tx = None
         self._train_step = None
         self._eval_step = None
-        if training_config.get("Optimizer", {}).get(
-            "use_zero_redundancy", False
-        ):
+        opt_cfg = training_config.get("Optimizer", {})
+        if opt_cfg.get("use_zero_redundancy") or int(
+            opt_cfg.get("zero_stage") or 0
+        ) >= 1:
             import warnings
 
             warnings.warn(
-                "use_zero_redundancy is not applied in graph-partition "
-                "mode: the mesh axis shards the GRAPH, not the batch, so "
-                "optimizer state stays replicated",
+                "ZeRO sharding (use_zero_redundancy / zero_stage) is not "
+                "applied in graph-partition mode: the mesh axis shards the "
+                "GRAPH, not the batch, so optimizer state (and stage-3 "
+                "parameters) stay replicated",
                 stacklevel=2,
             )
 

@@ -38,27 +38,7 @@ def update_config(config, train_loader, val_loader, test_loader):
     )
 
     arch = config["NeuralNetwork"]["Architecture"]
-    # guaranteed dataset-wide max graph size (unlike num_nodes, which the
-    # reference contract pins to the FIRST sample) — derived metadata,
-    # computed only when every split offers the index-only scan (free);
-    # otherwise None keeps startup O(1). The decision must be
-    # collective-consistent: every host joins the same cheap decision
-    # reduce first so no host is stranded in the allreduce below.
     from hydragnn_tpu.parallel.distributed import host_allreduce
-
-    loaders = (train_loader, val_loader, test_loader)
-    fast = all(hasattr(ld.dataset, "graph_sizes") for ld in loaders)
-    all_fast = bool(host_allreduce(np.asarray([int(fast)]), op="min")[0])
-    if all_fast:
-        local_max = 0
-        for loader in loaders:
-            sizes = loader.dataset.graph_sizes()  # index-only
-            local_max = max(local_max, int(sizes.max()) if len(sizes) else 0)
-        arch["max_graph_nodes"] = int(
-            host_allreduce(np.asarray([local_max]), op="max")[0]
-        )
-    else:
-        arch["max_graph_nodes"] = None
     if arch["model_type"] == "PNA":
         deg = gather_deg(train_loader.dataset)
         arch["pna_deg"] = deg.tolist()

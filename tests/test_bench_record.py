@@ -44,6 +44,38 @@ def pytest_merge_keeps_skipped_configs(tmp_path):
     assert by_model["PNA"]["carried_over"] is True
 
 
+def pytest_merge_tracks_staleness_age_and_cursor(tmp_path):
+    """Round-4 verdict item 8: carried rows accumulate an ``age`` so
+    cross-round A/Bs can see how stale they ride, and the rotation cursor
+    persists so every config refreshes within ~2 budgeted runs."""
+    out = str(tmp_path / "extra.json")
+    bench.merge_extra_rows(out, [_row("PNA"), _row("GIN")], cursor=5)
+    assert bench.read_refresh_cursor(out) == 5
+    rows = bench.merge_extra_rows(out, [_row("PNA")], cursor=7)
+    by_model = {r["model"]: r for r in rows}
+    assert by_model["GIN"]["age"] == 1
+    assert by_model["PNA"]["age"] == 0
+    rows = bench.merge_extra_rows(out, [_row("PNA")], cursor=9)
+    by_model = {r["model"]: r for r in rows}
+    assert by_model["GIN"]["age"] == 2  # two runs stale now
+    assert bench.read_refresh_cursor(out) == 9
+
+
+def pytest_rotation_covers_all_configs():
+    """The rotated window starting at the persisted cursor must enumerate
+    every config exactly once per cycle."""
+    configs = bench._extra_configs()
+    n = len(configs)
+    start = 7 % n
+    rotated = configs[start:] + configs[:start]
+    def key(c):
+        return (c["model_type"], c["hidden"], c.get("dense", False),
+                c.get("bf16", False), c["num_graphs"])
+    assert sorted(map(str, map(key, rotated))) == sorted(
+        map(str, map(key, configs))
+    )
+
+
 def pytest_merge_distinguishes_configs_not_models(tmp_path):
     out = str(tmp_path / "extra.json")
     rows = bench.merge_extra_rows(

@@ -86,6 +86,47 @@ def pytest_zero_redundancy_config_key():
     assert np.isfinite(float(metrics["loss"]))
 
 
+def pytest_zero_stage3_shards_parameters():
+    """Optimizer.zero_stage: 3 (DeepSpeed stage-3 parity) shards the
+    PARAMETERS over the data axis too; training still steps and the first
+    loss matches stage 1 (sharding is placement, not arithmetic)."""
+    from jax.sharding import PartitionSpec as P
+
+    batch = make_batch()
+    model = create_model_config(arch_config("SAGE"))
+    mesh = make_mesh()
+    rng = jax.random.PRNGKey(0)
+    losses = {}
+    for stage in (1, 3):
+        trainer = Trainer(
+            model,
+            {
+                "Optimizer": {
+                    "type": "AdamW",
+                    "learning_rate": 1e-3,
+                    "zero_stage": stage,
+                }
+            },
+            mesh=mesh,
+        )
+        state = trainer.init_state(batch)
+        specs = [
+            getattr(leaf.sharding, "spec", None)
+            for leaf in jax.tree_util.tree_leaves(state.params)
+            if hasattr(leaf, "sharding")
+        ]
+        if stage == 3:
+            assert any(s == P("data") for s in specs), specs
+        else:
+            assert all(s != P("data") for s in specs), specs
+        state, metrics = trainer._train_step(
+            state, trainer.put_batch(batch), rng
+        )
+        losses[stage] = float(metrics["loss"])
+        assert np.isfinite(losses[stage])
+    np.testing.assert_allclose(losses[1], losses[3], rtol=1e-5)
+
+
 def pytest_freeze_conv():
     """freeze_conv_layers: encoder params must not change, heads must."""
     batch = make_batch()
