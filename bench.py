@@ -257,7 +257,8 @@ def _extra_configs():
         # MFU trend at MXU widths (round-3 verdict item 6)
         dict(model_type="PNA", hidden=1024, dense=True, bf16=True, **oc20),
         dict(model_type="PNA", hidden=2048, dense=True, bf16=True, **oc20),
-        dict(model_type="GAT", hidden=1024, dense=True, bf16=True, **oc20),
+        # GAT tops out at 512 (the 6-head concat widths OOM at 1024)
+        dict(model_type="GAT", hidden=512, dense=True, bf16=True, **oc20),
         # GAT dense precision A/B (bf16 counterpart in the matrix below)
         dict(model_type="GAT", hidden=256, dense=True, **oc20),
         # headline-scale per-model rows
