@@ -149,6 +149,7 @@ def train_with_loaders(config, trainset, valset, testset, log_name, seed=0):
         trainset, valset, testset, training["batch_size"], need_triplets,
         need_neighbors=needs_dense_neighbors(arch_cfg),
         num_buckets=training.get("batch_buckets"),
+        contiguous_buckets=training.get("contiguous_buckets"),
     )
     config = update_config(config, train_loader, val_loader, test_loader)
     save_config(config, log_name)

@@ -484,12 +484,15 @@ class GraphLoader:
         # contiguous_buckets: shuffle samples within buckets and the ORDER
         # of bucket segments, but keep same-bucket batches adjacent — runs
         # of identical shapes let steps_per_dispatch stack K batches into
-        # one XLA program on dispatch-latency-bound hosts
-        if contiguous_buckets is None:
-            contiguous_buckets = bool(
-                int(os.getenv("HYDRAGNN_BUCKET_CONTIGUOUS", "0"))
+        # one XLA program on dispatch-latency-bound hosts.
+        # HYDRAGNN_BUCKET_CONTIGUOUS overrides whatever the caller passed
+        # (the ONE parse site for the env var); absent both, off.
+        env_contig = os.getenv("HYDRAGNN_BUCKET_CONTIGUOUS")
+        if env_contig is not None:
+            contiguous_buckets = env_contig.strip().lower() not in (
+                "", "0", "false", "no", "off",
             )
-        self.contiguous_buckets = contiguous_buckets
+        self.contiguous_buckets = bool(contiguous_buckets)
         # lazy: one sizes pass over the dataset (bucketed layouts only)
         self._bucket_ids = None
         self._sizes = None
@@ -694,12 +697,16 @@ def create_dataloaders(
     need_triplets: bool = False,
     need_neighbors: bool = False,
     num_buckets: Optional[int] = None,
+    contiguous_buckets: Optional[bool] = None,
 ):
     """``num_buckets`` (the config's ``Training.batch_buckets``):
     size-bucketed layouts — <= num_buckets compiled programs per split,
     padding sized per bucket instead of at the dataset max. Default 1
-    (single layout). ``HYDRAGNN_BATCH_BUCKETS`` overrides whatever the
-    caller passes — the ONE place the env/config precedence lives."""
+    (single layout). ``contiguous_buckets`` (the config's
+    ``Training.contiguous_buckets``) keeps same-shape batches adjacent so
+    ``steps_per_dispatch`` can stack them (env override parsed inside
+    ``GraphLoader``). ``HYDRAGNN_BATCH_BUCKETS`` overrides whatever the
+    caller passes — the ONE place that env var's precedence lives."""
     num_buckets = int(
         os.getenv("HYDRAGNN_BATCH_BUCKETS", str(num_buckets or 1))
     )
@@ -711,9 +718,12 @@ def create_dataloaders(
         num_buckets=num_buckets,
     )
     return (
-        GraphLoader(trainset, batch_size, layout, shuffle=True),
-        GraphLoader(valset, batch_size, layout, shuffle=True),
-        GraphLoader(testset, batch_size, layout, shuffle=True),
+        GraphLoader(trainset, batch_size, layout, shuffle=True,
+                    contiguous_buckets=contiguous_buckets),
+        GraphLoader(valset, batch_size, layout, shuffle=True,
+                    contiguous_buckets=contiguous_buckets),
+        GraphLoader(testset, batch_size, layout, shuffle=True,
+                    contiguous_buckets=contiguous_buckets),
     )
 
 
@@ -752,6 +762,7 @@ def dataset_loading_and_splitting(config: dict):
         need_triplets=need_triplets,
         need_neighbors=need_neighbors,
         num_buckets=training.get("batch_buckets"),
+        contiguous_buckets=training.get("contiguous_buckets"),
     )
 
 

@@ -119,16 +119,26 @@ def train_validate_test(
         )
     )
 
-    def _log_epoch(ep, train_loss, val_loss, test_loss, train_tasks):
+    def _log_epoch(ep, train_loss, val_loss, test_loss, train_tasks,
+                   t_train=None):
         total_loss_train[ep] = train_loss
         total_loss_val[ep] = val_loss
         total_loss_test[ep] = test_loss
         tt = np.atleast_1d(np.asarray(train_tasks))
         task_loss_train[ep, : min(len(tt), num_tasks)] = tt[:num_tasks]
+        timing = ""
+        if t_train:
+            try:
+                n = len(train_loader.dataset)
+            except TypeError:
+                n = 0
+            gps = f", {n / t_train:.0f} graphs/sec" if n else ""
+            timing = f", Train Time: {t_train:.2f}s{gps}"
         print_distributed(
             verbosity,
             f"Epoch: {ep:04d}, Train Loss: {train_loss:.8f}, "
-            f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}",
+            f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}"
+            f"{timing}",
         )
         if writer is not None:
             writer.add_scalar("train error", train_loss, ep)
@@ -227,6 +237,7 @@ def train_validate_test(
             state, rng, train_loss, train_tasks = trainer.train_epoch(
                 state, train_loader, rng
             )
+        t_train = time.time() - t0
         if skip_valtest:
             val_loss, val_tasks = train_loss, train_tasks
             test_loss, test_tasks = train_loss, train_tasks
@@ -276,7 +287,10 @@ def train_validate_test(
                 opt_state=set_learning_rate(state.opt_state, new_lr)
             )
 
-        _log_epoch(epoch, train_loss, val_loss, test_loss, train_tasks)
+        _log_epoch(
+            epoch, train_loss, val_loss, test_loss, train_tasks,
+            t_train=t_train,
+        )
 
         if visualizer is not None and visualizer.plot_hist_solution:
             _, _, tv, pv = trainer.predict(state, test_loader)
