@@ -1,0 +1,37 @@
+#!/bin/bash
+# North-star dataset-scale runs on the composed production path
+# (buckets + contiguous_buckets + steps_per_dispatch + streaming).
+# Sequential — they share the one chip. Logs under /tmp/northstar/.
+set -u
+OUT=${1:-/tmp/northstar}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "=== QM9 composed (133,885 molecules) ===" > "$OUT/status"
+( cd examples/qm9 && time python qm9.py --num_samples 133885 ) \
+  > "$OUT/qm9.log" 2>&1
+echo "qm9 rc=$?" >> "$OUT/status"
+
+echo "=== MD17 + SchNet energy+forces (100k conformations) ===" >> "$OUT/status"
+( cd examples/md17 && time python md17.py --model_type SchNet \
+    --num_samples 100000 --num_epoch 10 --log_name_suffix scale ) \
+  > "$OUT/md17.log" 2>&1
+echo "md17 rc=$?" >> "$OUT/status"
+
+echo "=== OC20 extxyz + DimeNet (20k frames, shard store) ===" >> "$OUT/status"
+( cd examples/open_catalyst_2020 && time python train.py --preonly \
+    --num_samples 20000 --modelname OC20R4 ) \
+  > "$OUT/oc20_preonly.log" 2>&1
+echo "oc20 preonly rc=$?" >> "$OUT/status"
+( cd examples/open_catalyst_2020 && time python train.py \
+    --modelname OC20R4 --model_type DimeNet --hidden_dim 128 \
+    --num_epoch 10 ) \
+  > "$OUT/oc20.log" 2>&1
+echo "oc20 rc=$?" >> "$OUT/status"
+
+echo "=== MPtrj + EGNN (20k trajectories = 120k frames) ===" >> "$OUT/status"
+( cd examples/mptrj && time python train.py --num_samples 20000 \
+    --max_frames all --num_epoch 10 --log_name_suffix scale ) \
+  > "$OUT/mptrj.log" 2>&1
+echo "mptrj rc=$?" >> "$OUT/status"
+echo "ALL DONE" >> "$OUT/status"

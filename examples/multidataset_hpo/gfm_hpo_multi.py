@@ -1,11 +1,16 @@
-"""Multi-node HPO over the multi-dataset GFM workload — one training
-SUBPROCESS per trial.
+"""Multi-node HPO over the multi-dataset GFM workload — CONCURRENT
+training subprocesses, one per trial.
 
 Mirrors ``examples/multidataset_hpo/gfm_deephyper_multi.py:22-70``: trial
 geometry is env-driven (``HPO_NNODES_PER_TRIAL`` / ``HPO_NRANKS_PER_TRIAL``,
 srun auto-detected via ``SLURM_JOB_ID``), hyperparameters travel as CLI
 flags, and the trial metric is the last ``Val Loss:`` the training script
-prints. Run ``examples/multidataset/train.py --preonly`` once first.
+prints. Like the reference's DeepHyper scheduler, up to
+``HPO_MAX_CONCURRENT`` trials run simultaneously, each pinned to its own
+node block from ``HPO_NODELIST`` (comma-separated; or derived slots), the
+TPE sampler updating as each lands. ``HPO_SERIAL=1`` falls back to the
+sequential loop. Run ``examples/multidataset/train.py --preonly`` once
+first.
 """
 
 import os
@@ -15,7 +20,7 @@ _EXAMPLES = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _EXAMPLES)
 sys.path.insert(0, os.path.dirname(_EXAMPLES))  # repo root
 
-from hydragnn_tpu.hpo import TrialLauncher, create_study
+from hydragnn_tpu.hpo import TrialLauncher, create_study, optimize_concurrent
 
 TRAIN_SCRIPT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -31,7 +36,7 @@ def main():
     )
     study = create_study(direction="minimize", sampler="tpe", n_startup=3)
 
-    def objective(trial):
+    def suggest(trial):
         trial.suggest_categorical("model_type", ["PNA", "GIN", "SAGE"])
         trial.suggest_int("hidden_dim", 32, 128)
         trial.suggest_int("num_conv_layers", 2, 5)
@@ -41,9 +46,15 @@ def main():
         trial.params["num_samples"] = int(
             os.environ.get("HPO_NUM_SAMPLES", "600")
         )
-        return launcher.run(trial)
 
-    study.optimize(objective, n_trials=n_trials)
+    if os.environ.get("HPO_SERIAL") == "1":
+        def objective(trial):
+            suggest(trial)
+            return launcher.run(trial)
+
+        study.optimize(objective, n_trials=n_trials)
+    else:
+        optimize_concurrent(study, launcher, suggest, n_trials=n_trials)
     print(f"best params: {study.best_params}")
     print(f"best value: {study.best_value}")
 

@@ -12,4 +12,9 @@ study can be used instead; nothing here requires it.
 """
 
 from hydragnn_tpu.hpo.search import Study, Trial, TrialPruned, create_study
-from hydragnn_tpu.hpo.launcher import TrialLauncher, parse_val_loss
+from hydragnn_tpu.hpo.launcher import (
+    NodePool,
+    TrialLauncher,
+    optimize_concurrent,
+    parse_val_loss,
+)

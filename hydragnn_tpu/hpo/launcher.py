@@ -102,3 +102,100 @@ class TrialLauncher:
             return float("inf")
         val = parse_val_loss(text)
         return float("inf") if val is None else val
+
+
+class NodePool:
+    """Per-trial node-block allocation (the reference pins each DeepHyper
+    trial to its own node block via ``--nodelist``,
+    ``gfm_deephyper_multi.py:43-70``). ``nodes=None`` (and no
+    ``HPO_NODELIST``) disables pinning — trials launch without a
+    nodelist."""
+
+    def __init__(self, nodes: Optional[List[str]] = None):
+        if nodes is None:
+            env = os.environ.get("HPO_NODELIST", "")
+            nodes = [n.strip() for n in env.split(",") if n.strip()] or None
+        self.free: Optional[List[str]] = list(nodes) if nodes else None
+
+    def slots(self, per_trial: int) -> int:
+        if self.free is None:
+            return 0
+        return len(self.free) // max(per_trial, 1)
+
+    def acquire(self, k: int) -> Optional[List[str]]:
+        if self.free is None:
+            return None
+        if len(self.free) < k:
+            raise RuntimeError(
+                f"node pool exhausted: need {k}, have {len(self.free)}"
+            )
+        block, self.free = self.free[:k], self.free[k:]
+        return block
+
+    def release(self, block: Optional[List[str]]):
+        if block:
+            self.free.extend(block)
+
+
+def optimize_concurrent(
+    study,
+    launcher: TrialLauncher,
+    suggest,
+    n_trials: int,
+    max_concurrent: Optional[int] = None,
+    nodes: Optional[List[str]] = None,
+):
+    """Concurrent ask/tell search: up to ``max_concurrent`` trial
+    subprocesses in flight, each on its own node block — the reference's
+    DeepHyper CBO scheduler shape (``gfm_deephyper_multi.py:22-70``: N
+    nodes / nodes-per-trial concurrent srun trials, asynchronous
+    completion, sampler updated as each trial lands).
+
+    ``suggest(trial)`` draws the hyperparameters (``trial.suggest_*``);
+    the launcher turns ``trial.params`` into CLI flags. Failed/timed-out
+    trials (+inf) are told as ``failed`` so the sampler never learns from
+    them. ``max_concurrent`` defaults to ``HPO_MAX_CONCURRENT``, else the
+    node pool's slot count, else 2. Study methods run only on THIS
+    thread — worker threads just babysit subprocesses — so the sampler
+    needs no locking."""
+    from concurrent.futures import (
+        FIRST_COMPLETED,
+        ThreadPoolExecutor,
+        wait,
+    )
+
+    pool = NodePool(nodes)
+    if max_concurrent is None:
+        env = os.environ.get("HPO_MAX_CONCURRENT")
+        if env:
+            max_concurrent = int(env)
+        else:
+            max_concurrent = pool.slots(launcher.nnodes) or 2
+    if pool.free is not None:
+        max_concurrent = min(max_concurrent, pool.slots(launcher.nnodes))
+    max_concurrent = max(1, max_concurrent)
+
+    with ThreadPoolExecutor(max_workers=max_concurrent) as ex:
+        inflight = {}
+        submitted = 0
+        while submitted < n_trials or inflight:
+            while submitted < n_trials and len(inflight) < max_concurrent:
+                trial = study.ask()
+                suggest(trial)
+                block = pool.acquire(launcher.nnodes) if pool.free is not None else None
+                fut = ex.submit(launcher.run, trial, block)
+                inflight[fut] = (trial, block)
+                submitted += 1
+            done, _ = wait(inflight, return_when=FIRST_COMPLETED)
+            for fut in done:
+                trial, block = inflight.pop(fut)
+                pool.release(block)
+                try:
+                    val = fut.result()
+                except Exception:
+                    val = float("inf")
+                if val == float("inf"):
+                    study.tell(trial, None, state="failed")
+                else:
+                    study.tell(trial, val)
+    return study.best_trial
