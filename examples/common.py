@@ -150,6 +150,7 @@ def train_with_loaders(config, trainset, valset, testset, log_name, seed=0):
         need_neighbors=needs_dense_neighbors(arch_cfg),
         num_buckets=training.get("batch_buckets"),
         contiguous_buckets=training.get("contiguous_buckets"),
+        bucket_graph_cap=training.get("bucket_graph_cap", "batch"),
     )
     config = update_config(config, train_loader, val_loader, test_loader)
     save_config(config, log_name)

@@ -336,11 +336,24 @@ def _pack_indices(
     edges: np.ndarray,
     trips: np.ndarray,
     layout: BatchLayout,
+    batch_size: Optional[int] = None,
 ) -> List[np.ndarray]:
     """Greedy budget packing: fill a batch until the next graph would
     overflow the bucket's node/edge/triplet budget or the graph cap.
-    Every batch fits its layout by construction."""
+    Every batch fits its layout by construction.
+
+    ``batch_size`` caps the GRAPH count per batch at the configured value
+    (reference DataLoader semantics: a step is batch_size graphs). Without
+    it the node budget alone governs and small-graph buckets pack far
+    past the nominal batch size — higher device throughput per epoch but
+    a DIFFERENT optimization trajectory (fewer, larger steps): measured
+    on QM9-at-scale round 4, budget-only packing trained to val ~6-8
+    where batch-capped packing matches the reference-semantics ~3
+    (BASELINE.md). Throughput mode stays available via
+    ``Training.bucket_graph_cap: "budget"``."""
     cap = layout.g_pad - 1  # the padding-graph slot stays reserved
+    if batch_size is not None:
+        cap = min(cap, int(batch_size))
     batches, cur = [], []
     n = e = t = 0
     for i in idx:
@@ -465,6 +478,7 @@ class GraphLoader:
         shard_id: Optional[int] = None,
         prefetch: Optional[int] = None,
         contiguous_buckets: Optional[bool] = None,
+        bucket_graph_cap: str = "batch",
     ):
         from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
@@ -493,12 +507,34 @@ class GraphLoader:
                 "", "0", "false", "no", "off",
             )
         self.contiguous_buckets = bool(contiguous_buckets)
+        # "batch" = at most batch_size graphs per packed batch (reference
+        # step semantics); "budget" = fill to the node/edge budget (pure
+        # throughput; changes the optimization trajectory — see
+        # _pack_indices)
+        if bucket_graph_cap not in ("batch", "budget"):
+            raise ValueError(
+                f"bucket_graph_cap must be 'batch' or 'budget', "
+                f"got {bucket_graph_cap!r}"
+            )
+        if bucket_graph_cap == "budget" and not isinstance(
+            layout, BucketedLayout
+        ):
+            # budget packing only exists on the bucketed plan path; a
+            # silent no-op would read as "budget mode has no effect"
+            raise ValueError(
+                "bucket_graph_cap='budget' requires a bucketed layout "
+                "(Training.batch_buckets > 1)"
+            )
+        self.bucket_graph_cap = bucket_graph_cap
         # lazy: one sizes pass over the dataset (bucketed layouts only)
         self._bucket_ids = None
         self._sizes = None
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+
+    def _graph_cap(self) -> Optional[int]:
+        return None if self.bucket_graph_cap == "budget" else self.batch_size
 
     def _indices(self):
         n = len(self.dataset)
@@ -568,7 +604,8 @@ class GraphLoader:
                 # applied at batch granularity)
                 per_shard = [
                     _pack_indices(
-                        bidx[s :: self.num_shards], nodes, edges, trips, lay
+                        bidx[s :: self.num_shards], nodes, edges, trips, lay,
+                        batch_size=self._graph_cap(),
                     )
                     for s in range(self.num_shards)
                 ]
@@ -580,7 +617,10 @@ class GraphLoader:
             else:
                 plan.extend(
                     (b, chunk)
-                    for chunk in _pack_indices(bidx, nodes, edges, trips, lay)
+                    for chunk in _pack_indices(
+                        bidx, nodes, edges, trips, lay,
+                        batch_size=self._graph_cap(),
+                    )
                 )
         if self.shuffle and plan:
             if self.contiguous_buckets:
@@ -606,18 +646,43 @@ class GraphLoader:
         n = len(self._indices())
         return -(-n // self.batch_size)
 
-    def _batches(self):
+    def _batch_tasks(self):
+        """(layout, sample-index chunk) pairs — the cheap plan half of
+        iteration, separable from collation so worker pools can fan the
+        expensive half out."""
         if isinstance(self.layout, BucketedLayout):
             for b, chunk in self._batch_plan():
-                samples = [self.dataset[i] for i in chunk]
-                yield _collate_with_extras(samples, self.layout.layouts[b])
+                yield (self.layout.layouts[b], chunk)
             return
         idx = self._indices()
         for start in range(0, len(idx), self.batch_size):
-            chunk = [self.dataset[i] for i in idx[start : start + self.batch_size]]
-            yield _collate_with_extras(chunk, self.layout)
+            yield (self.layout, idx[start : start + self.batch_size])
+
+    def _collate_task(self, task):
+        layout, chunk = task
+        return _collate_with_extras([self.dataset[i] for i in chunk], layout)
+
+    def _batches(self):
+        for task in self._batch_tasks():
+            yield self._collate_task(task)
 
     def __iter__(self):
+        # HYDRAGNN_NUM_WORKERS > 1: fan sample fetch + collation over a
+        # worker pool (ordered), optionally core-pinned via OMP_PLACES +
+        # HYDRAGNN_AFFINITY — the reference HydraDataLoader's thread-pool
+        # + sched_setaffinity design (``load_data.py:94-204``, worker_init
+        # ``:118-154``). Matters on many-core TPU-VM hosts feeding
+        # multiple processes; pointless on a 1-core box.
+        workers = int(os.getenv("HYDRAGNN_NUM_WORKERS", "1"))
+        if workers > 1:
+            yield from prefetch_iter(
+                self._batch_tasks(),
+                max(self.prefetch, workers),
+                fn=self._collate_task,
+                workers=workers,
+                name="graphloader-worker",
+            )
+            return
         if self.prefetch <= 0:
             yield from self._batches()
             return
@@ -626,10 +691,69 @@ class GraphLoader:
         )
 
 
-def prefetch_iter(source, depth: int, fn=None, name: str = "prefetch"):
-    """Bounded background-thread pipeline stage: applies ``fn`` (identity
-    if None) to each item of ``source`` on a worker thread, up to ``depth``
-    results queued ahead of the consumer, yielded in order.
+def _parse_omp_places(spec: Optional[str] = None):
+    """OMP_PLACES -> list of core sets, one per place. Supports the forms
+    the reference's worker_init parses (``load_data.py:118-154``):
+    ``{0:4},{4:4}`` (start:len[:stride]) and explicit ``{0,2,4}`` lists.
+    Unparseable input -> no places (pinning silently off)."""
+    import re
+
+    if spec is None:
+        spec = os.environ.get("OMP_PLACES", "")
+    places = []
+    try:
+        for m in re.finditer(r"\{([^}]*)\}", spec):
+            cores = []
+            for part in m.group(1).split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if ":" in part:
+                    bits = [int(x) for x in part.split(":")]
+                    start, length = bits[0], bits[1]
+                    stride = bits[2] if len(bits) > 2 else 1
+                    cores.extend(
+                        range(start, start + length * stride, stride)
+                    )
+                else:
+                    cores.append(int(part))
+            if cores:
+                places.append(cores)
+    except ValueError:
+        return []
+    return places
+
+
+def _pin_worker(index: int, places) -> None:
+    """Pin the CURRENT thread to place ``index % len(places)`` — the
+    reference's ``sched_setaffinity`` worker pinning. No-op without
+    places, without OS support, or on denial (containers)."""
+    if not places or not hasattr(os, "sched_setaffinity"):
+        return
+    try:
+        os.sched_setaffinity(0, set(places[index % len(places)]))
+    except OSError:
+        pass
+
+
+def _affinity_places():
+    """Core places for worker pinning, when ``HYDRAGNN_AFFINITY`` opts in
+    (the reference's HYDRAGNN_AFFINITY family, ``load_data.py:120-126``)."""
+    if os.getenv("HYDRAGNN_AFFINITY", "0") != "1":
+        return []
+    return _parse_omp_places()
+
+
+def prefetch_iter(
+    source, depth: int, fn=None, name: str = "prefetch", workers: int = 1
+):
+    """Bounded background pipeline stage: applies ``fn`` (identity if
+    None) to each item of ``source`` on worker thread(s), up to ``depth``
+    results in flight ahead of the consumer, yielded in order.
+
+    ``workers > 1`` fans ``fn`` over an ordered thread pool (the
+    reference HydraDataLoader's num_workers model); each worker pins to
+    its OMP_PLACES place when ``HYDRAGNN_AFFINITY=1``.
 
     Shared by the loader's collation prefetch and the trainer's
     double-buffered device transfers. The shutdown protocol matters: puts
@@ -642,6 +766,10 @@ def prefetch_iter(source, depth: int, fn=None, name: str = "prefetch"):
 
     if fn is None:
         fn = lambda x: x  # noqa: E731
+    places = _affinity_places()
+    if workers > 1:
+        yield from _ordered_pool_map(source, fn, workers, depth, name, places)
+        return
     q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
     sentinel = object()
     stop = threading.Event()
@@ -657,6 +785,9 @@ def prefetch_iter(source, depth: int, fn=None, name: str = "prefetch"):
         return False
 
     def worker():
+        # single pipeline threads deliberately do NOT pin: the collation
+        # and device-transfer stages would otherwise all land on place 0
+        # and time-share one core — only POOL workers (workers > 1) pin
         try:
             for b in source:
                 if not _put_stop_aware(fn(b)):
@@ -689,6 +820,37 @@ def prefetch_iter(source, depth: int, fn=None, name: str = "prefetch"):
         raise err[0]
 
 
+def _ordered_pool_map(source, fn, workers, depth, name, places):
+    """Ordered bounded map over a thread pool: at most ``max(depth,
+    workers)`` items in flight, results yielded in source order. The
+    consumer thread walks ``source`` (cheap plan work); workers run
+    ``fn`` (fetch + collate). Abandonment cancels queued futures and the
+    pool context join reaps the threads."""
+    import itertools
+    from concurrent.futures import ThreadPoolExecutor
+
+    counter = itertools.count()
+
+    def _init():
+        _pin_worker(next(counter), places)
+
+    window = []
+    with ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix=name, initializer=_init
+    ) as ex:
+        try:
+            limit = max(depth, workers)
+            for item in source:
+                window.append(ex.submit(fn, item))
+                if len(window) >= limit:
+                    yield window.pop(0).result()
+            while window:
+                yield window.pop(0).result()
+        finally:
+            for f in window:
+                f.cancel()
+
+
 def create_dataloaders(
     trainset,
     valset,
@@ -698,6 +860,7 @@ def create_dataloaders(
     need_neighbors: bool = False,
     num_buckets: Optional[int] = None,
     contiguous_buckets: Optional[bool] = None,
+    bucket_graph_cap: str = "batch",
 ):
     """``num_buckets`` (the config's ``Training.batch_buckets``):
     size-bucketed layouts — <= num_buckets compiled programs per split,
@@ -719,11 +882,14 @@ def create_dataloaders(
     )
     return (
         GraphLoader(trainset, batch_size, layout, shuffle=True,
-                    contiguous_buckets=contiguous_buckets),
+                    contiguous_buckets=contiguous_buckets,
+                    bucket_graph_cap=bucket_graph_cap),
         GraphLoader(valset, batch_size, layout, shuffle=True,
-                    contiguous_buckets=contiguous_buckets),
+                    contiguous_buckets=contiguous_buckets,
+                    bucket_graph_cap=bucket_graph_cap),
         GraphLoader(testset, batch_size, layout, shuffle=True,
-                    contiguous_buckets=contiguous_buckets),
+                    contiguous_buckets=contiguous_buckets,
+                    bucket_graph_cap=bucket_graph_cap),
     )
 
 
@@ -763,6 +929,7 @@ def dataset_loading_and_splitting(config: dict):
         need_neighbors=need_neighbors,
         num_buckets=training.get("batch_buckets"),
         contiguous_buckets=training.get("contiguous_buckets"),
+        bucket_graph_cap=training.get("bucket_graph_cap", "batch"),
     )
 
 

@@ -90,6 +90,26 @@ def pytest_every_packed_batch_fits_its_layout():
     assert total == len(samples)
 
 
+def pytest_bucket_graph_cap_matches_reference_step_semantics():
+    """Default packing caps every batch at batch_size GRAPHS (a reference
+    step is batch_size graphs; budget-only packing trains a different
+    trajectory — QM9-at-scale round 4, BASELINE.md). 'budget' mode keeps
+    the pure-throughput fill available."""
+    samples = _oc20_shaped(300, seed=3)
+    layout = compute_layout([samples], batch_size=8, num_buckets=3)
+    capped = GraphLoader(samples, 8, layout, shuffle=False, num_shards=1,
+                         shard_id=0)
+    assert max(len(c) for _, c in capped._batch_plan()) <= 8
+    budget = GraphLoader(samples, 8, layout, shuffle=False, num_shards=1,
+                         shard_id=0, bucket_graph_cap="budget")
+    # the small-size bucket must actually exercise the budget headroom
+    assert max(len(c) for _, c in budget._batch_plan()) > 8
+    # both modes cover every sample exactly once
+    for ld in (capped, budget):
+        seen = sorted(i for _, c in ld._batch_plan() for i in c)
+        assert seen == list(range(len(samples)))
+
+
 def pytest_bucketed_loader_covers_every_sample_once():
     samples = _oc20_shaped(130, seed=2)
     for d, i in zip(samples, range(len(samples))):

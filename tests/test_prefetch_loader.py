@@ -56,3 +56,47 @@ def pytest_prefetch_propagates_errors():
     ds[4] = None  # poison a sample the second batch will touch
     with pytest.raises(Exception):
         list(loader)
+
+
+def pytest_multi_worker_matches_sync(monkeypatch):
+    """HYDRAGNN_NUM_WORKERS > 1 (the reference HydraDataLoader's worker
+    pool, ``load_data.py:94-204``) must be order- and content-identical
+    to the synchronous path."""
+    ds = _dataset(26)
+    layout = compute_layout([ds], batch_size=4, need_triplets=False)
+    sync = list(GraphLoader(ds, 4, layout, shuffle=False))
+    monkeypatch.setenv("HYDRAGNN_NUM_WORKERS", "3")
+    pooled = list(GraphLoader(ds, 4, layout, shuffle=False))
+    assert len(sync) == len(pooled)
+    for ba, bb in zip(sync, pooled):
+        np.testing.assert_array_equal(np.asarray(ba.x), np.asarray(bb.x))
+        np.testing.assert_array_equal(
+            np.asarray(ba.senders), np.asarray(bb.senders)
+        )
+
+
+def pytest_omp_places_parsing():
+    from hydragnn_tpu.data.loaders import _parse_omp_places
+
+    assert _parse_omp_places("{0:4},{4:4}") == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert _parse_omp_places("{0,2,4},{1,3,5}") == [[0, 2, 4], [1, 3, 5]]
+    assert _parse_omp_places("{0:2:4}") == [[0, 4]]  # start:len:stride
+    assert _parse_omp_places("") == []
+    assert _parse_omp_places("cores") == []  # abstract names: pinning off
+    assert _parse_omp_places("{bad}") == []
+
+
+def pytest_affinity_pinning_is_safe_noop_here(monkeypatch):
+    """With HYDRAGNN_AFFINITY=1 and OMP_PLACES set, the pinned worker pool
+    still produces correct batches (on this 1-core host every place maps
+    to... whatever the OS grants — pinning failures are silent no-ops)."""
+    ds = _dataset(10)
+    layout = compute_layout([ds], batch_size=5, need_triplets=False)
+    sync = list(GraphLoader(ds, 5, layout, shuffle=False))
+    monkeypatch.setenv("HYDRAGNN_NUM_WORKERS", "2")
+    monkeypatch.setenv("HYDRAGNN_AFFINITY", "1")
+    monkeypatch.setenv("OMP_PLACES", "{0:1},{0:1}")
+    pinned = list(GraphLoader(ds, 5, layout, shuffle=False))
+    assert len(sync) == len(pinned)
+    for ba, bb in zip(sync, pinned):
+        np.testing.assert_array_equal(np.asarray(ba.x), np.asarray(bb.x))
