@@ -45,7 +45,14 @@ class GATv2Conv(nn.Module):
         extras = batch.extras or {}
         if "nbr_idx" in extras:
             # dense scatter-free path: attention softmax is LOCAL over the
-            # K neighbor slots + 1 self-loop slot — no segment ops at all
+            # K neighbor slots + 1 self-loop slot — no segment ops at all.
+            # The [N, K, H*C] gathered messages are the HBM cost center at
+            # GAT's concat widths (H*C = 1536 at hidden 256): they are
+            # materialized ONCE and every consumer reads them in place —
+            # no [N, K+1, ...] concat copy (the self-loop slot is handled
+            # as separate [N, H, C] terms), and the weighted-message sum
+            # contracts the K axis with a dot instead of re-reading a
+            # broadcast product.
             from hydragnn_tpu.ops.dense_agg import gather_neighbors
 
             nmask = extras["nbr_mask"]  # [N, K]
@@ -55,24 +62,40 @@ class GATv2Conv(nn.Module):
                 extras["rev_idx"],
                 extras["rev_mask"],
             ).reshape(n, -1, h, c)  # [N, K, H, C]
-            # slot axis = K neighbors then the self-loop (add_self_loops)
-            msgs = jnp.concatenate([xl_j, x_l[:, None]], axis=1)
-            g = jax.nn.leaky_relu(
-                msgs + x_r[:, None], self.negative_slope
-            )
-            alpha = (g * att).sum(axis=-1)  # [N, K+1, H]
-            allmask = jnp.concatenate(
-                [nmask, batch.node_mask[:, None]], axis=1
-            )[..., None]
-            alpha = jnp.where(allmask, alpha, -1e9)
+            k = xl_j.shape[1]
+            alpha_n = (
+                jax.nn.leaky_relu(xl_j + x_r[:, None], self.negative_slope)
+                * att
+            ).sum(axis=-1)  # [N, K, H]
+            alpha_s = (
+                jax.nn.leaky_relu(x_l + x_r, self.negative_slope) * att
+            ).sum(axis=-1)  # [N, H] self-loop
+            alpha_n = jnp.where(nmask[..., None], alpha_n, -1e9)
+            alpha_s = jnp.where(batch.node_mask[:, None], alpha_s, -1e9)
             # fully-masked (padded) nodes: amax = -1e9 (finite by the
             # mask convention), exp(0)=1, then re-masked to 0 below
-            amax = alpha.max(axis=1, keepdims=True)
-            ex = jnp.exp(alpha - amax)
-            ex = jnp.where(allmask, ex, 0.0)
-            exd = nn.Dropout(rate=self.dropout, deterministic=not train)(ex)
-            num = (msgs * exd[..., None]).sum(axis=1)  # [N, H, C]
-            den = ex.sum(axis=1)  # [N, H]
+            amax = jnp.maximum(alpha_n.max(axis=1), alpha_s)[:, None]
+            ex_n = jnp.where(
+                nmask[..., None], jnp.exp(alpha_n - amax), 0.0
+            )
+            ex_s = jnp.where(
+                batch.node_mask[:, None],
+                jnp.exp(alpha_s - amax[:, 0]),
+                0.0,
+            )
+            drop = nn.Dropout(rate=self.dropout, deterministic=not train)
+            exd = drop(jnp.concatenate([ex_n, ex_s[:, None]], axis=1))
+            # weighted message sum as a K-axis contraction (XLA chooses
+            # the layout; reads xl_j once instead of a broadcast-product
+            # rematerialization)
+            num = jnp.einsum(
+                "nkh,nkhc->nhc",
+                exd[:, :k],
+                xl_j,
+                preferred_element_type=jnp.float32,
+            ).astype(x_l.dtype)
+            num = num + exd[:, k][..., None] * x_l
+            den = ex_n.sum(axis=1) + ex_s  # [N, H]
             out = num / jnp.maximum(den[..., None], 1e-16)
         else:
             # real edges + one self-loop per node (add_self_loops=True)
