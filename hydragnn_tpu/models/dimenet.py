@@ -209,12 +209,15 @@ def _dimenet_geometry_dense(
     pos_ji = pos[:, None, :] - pos_i  # [N, Ko, 3]
     pos_ki = pos_k[:, None, :, :] - pos_i[:, :, None, :]  # [N, Ko, Ki, 3]
     a = (pos_ji[:, :, None, :] * pos_ki).sum(-1)
-    b = jnp.linalg.norm(
-        jnp.cross(pos_ji[:, :, None, :], pos_ki), axis=-1
-    )
-    angle = jnp.arctan2(b, a)  # [N, Ko, Ki]
+    b2 = (jnp.cross(pos_ji[:, :, None, :], pos_ki) ** 2).sum(-1)
+    # Legendre needs cos(angle) only: cos(atan2(b, a)) == a / hypot(a, b)
+    # exactly, so the atan2+cos transcendental pair on the [N, Ko, Ki]
+    # grid becomes one rsqrt (the geometry is HALF the forward; see
+    # BASELINE.md round 4). eps guards the degenerate a=b=0 pairs
+    # (masked anyway, but NaN would poison the mask multiply).
+    cos_t = a * jax.lax.rsqrt(jnp.maximum(a * a + b2, 1e-24))
     cbf = jnp.stack(
-        _legendre(num_spherical - 1, jnp.cos(angle)), axis=-1
+        _legendre(num_spherical - 1, cos_t), axis=-1
     )  # [N, Ko, Ki, S]
     valid = (
         out_mask[:, :, None]

@@ -204,6 +204,23 @@ def pytest_train_model_dense_aggregation(model_type):
     )
 
 
+@pytest.mark.skipif(not FULL, reason="auto-dense e2e: FULL tier")
+def pytest_train_model_auto_dense_no_flag():
+    """At MXU widths the aggregation path is chosen AUTOMATICALLY (no
+    dense_aggregation key anywhere): the measured-crossover policy must
+    route this hidden-96 MFC run onto the dense path and still hit the
+    reference ceilings through the public API."""
+    unittest_train_model(
+        "MFC",
+        "ci.json",
+        False,
+        overwrite_config={
+            "NeuralNetwork": {"Architecture": {"hidden_dim": 96}}
+        },
+        num_samples_tot=300,
+    )
+
+
 @pytest.mark.parametrize("model_type", ["PNA"])
 def pytest_train_model_nll_loss(model_type):
     """Uncertainty-weighted NLL multi-task loss (the mode the reference
