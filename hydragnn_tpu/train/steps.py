@@ -34,6 +34,7 @@ class CompiledSteps:
         "predict_scan",
         "fit_scan",
         "eval_step",
+        "eval_multi",
     )
 
 
@@ -163,24 +164,38 @@ def build_steps(model, tx, training_config: dict) -> CompiledSteps:
         )
     )
 
-    def eval_epoch(params, batch_stats, data):
-        """Mean loss/tasks over a staged (stacked) eval set, no outputs.
-        Honors ``HYDRAGNN_MAX_NUM_BATCH`` like every other eval path."""
+    def eval_multi(params, batch_stats, data, nb=None):
+        """Scan ``eval_step`` over a stacked batch: metrics stacked per
+        microbatch ([K]/[K, T] — `_acc_add(multi=True)` format). The eval
+        counterpart of ``multi_train_step``: streaming validation/test
+        was still paying one dispatch RPC per batch after training
+        learned to stack (at-scale QM9, evals cost as much wall as the
+        whole stacked train epoch). The ONE scan-eval implementation —
+        ``eval_epoch`` is a reduction over it."""
 
         def body(_, idx):
             m = eval_step(params, batch_stats, _microbatch(data, idx))
             return _, (m["loss"], m["tasks"], m["num_graphs"])
 
+        if nb is None:
+            nb = jax.tree_util.tree_leaves(data)[0].shape[0]
+        _, (loss, tasks, g) = jax.lax.scan(body, None, jnp.arange(nb))
+        return {"loss": loss, "tasks": tasks, "num_graphs": g}
+
+    def eval_epoch(params, batch_stats, data):
+        """Mean loss/tasks over a staged (stacked) eval set, no outputs.
+        Honors ``HYDRAGNN_MAX_NUM_BATCH`` like every other eval path."""
         nb = jax.tree_util.tree_leaves(data)[0].shape[0]
         cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
         if cap is not None:
             nb = min(nb, int(cap))
-        _, (loss, tasks, g) = jax.lax.scan(
-            body, None, jnp.arange(nb)
-        )
-        g = g.astype(jnp.float32)
+        m = eval_multi(params, batch_stats, data, nb=nb)
+        g = m["num_graphs"].astype(jnp.float32)
         denom = jnp.maximum(g.sum(), 1.0)
-        return (loss * g).sum() / denom, (tasks * g[:, None]).sum(0) / denom
+        return (
+            (m["loss"] * g).sum() / denom,
+            (m["tasks"] * g[:, None]).sum(0) / denom,
+        )
 
     num_tasks = len(model.output_type)
 
@@ -353,4 +368,5 @@ def build_steps(model, tx, training_config: dict) -> CompiledSteps:
     # may alias state's buffers)
     steps.fit_scan = jax.jit(fit_scan, donate_argnums=(0, 2))
     steps.eval_step = jax.jit(eval_step)
+    steps.eval_multi = jax.jit(eval_multi)
     return steps

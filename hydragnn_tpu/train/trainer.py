@@ -122,6 +122,10 @@ class Trainer(PredictMixin):
     def _eval_step(self):
         return self._steps.eval_step
 
+    @property
+    def _eval_multi(self):
+        return self._steps.eval_multi
+
     # ---- state ---------------------------------------------------------
     def init_state(self, example_batch: GraphBatch, seed: int = 0) -> TrainState:
         if self.mesh is None or jax.process_count() == 1:
@@ -621,10 +625,25 @@ class Trainer(PredictMixin):
         return state, rng, loss, tasks
 
     def evaluate(self, state, loader, desc="validate"):
+        """Streaming eval with the SAME multi-step dispatch as training:
+        ``steps_per_dispatch`` same-shape batches stack into one scan
+        program (at-scale QM9, per-batch eval dispatches cost as much
+        wall as the whole stacked train epoch)."""
         acc = None
         nbatch = _nbatch(loader)
-        depth = self.device_prefetch
-        for dev in self._prefetch_put(loader, nbatch, depth):
-            metrics = self._eval_step(state.params, state.batch_stats, dev)
-            acc = self._acc_add(acc, metrics, multi=False)
+        K = max(1, self.steps_per_dispatch)
+        plan = self._group_plan(loader, nbatch, K)
+        for dev, count in self._prefetch_put(
+            plan, float("inf"), self.device_prefetch, put=self._put_group
+        ):
+            if count > 1:
+                metrics = self._eval_multi(
+                    state.params, state.batch_stats, dev
+                )
+                acc = self._acc_add(acc, metrics, multi=True)
+            else:
+                metrics = self._eval_step(
+                    state.params, state.batch_stats, dev
+                )
+                acc = self._acc_add(acc, metrics, multi=False)
         return self._acc_read(acc)

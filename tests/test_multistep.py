@@ -111,6 +111,30 @@ def pytest_multistep_matches_single_step():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def pytest_multistep_evaluate_matches_single_step():
+    """Streaming evaluation under steps_per_dispatch (stacked eval scan)
+    must produce EXACTLY the per-batch path's averaged metrics — eval has
+    no optimizer state, so the only difference allowed is dispatch
+    count."""
+    batches = _batches(5)  # K=2 -> two stacked groups + one trailing single
+    model = create_model_config(_arch())
+    results = {}
+    for k in (1, 2):
+        trainer = Trainer(
+            model,
+            training_config={
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+                "steps_per_dispatch": k,
+            },
+        )
+        state = trainer.init_state(batches[0])
+        results[k] = trainer.evaluate(state, ListLoader(batches))
+    loss1, tasks1 = results[1]
+    loss2, tasks2 = results[2]
+    assert np.isclose(loss1, loss2, rtol=1e-6), (loss1, loss2)
+    np.testing.assert_allclose(tasks1, tasks2, rtol=1e-6)
+
+
 def pytest_device_prefetch_matches_sync():
     """The double-buffered device-prefetch streaming path (transfers
     issued ahead from a background thread) must reproduce the strict
