@@ -234,6 +234,38 @@ def random_smiles(rng, max_subs=2):
     return out
 
 
+def pair_potential_forces(z, pos, cutoff=3.0, r0=1.5):
+    """Smooth species-weighted pair potential of the OBSERVED configuration
+    and its exact analytic forces.
+
+    phi(r) = w_ij (r - r0)^2 s(r) with the cosine cutoff
+    s(r) = 0.5 (1 + cos(pi r / rc)); w_ij = sqrt(z_i z_j) / 20.
+    Returns (total energy, per-atom forces = -grad E). Both are closed-form
+    functions of (z, pos) alone — no latent state — so a GNN can learn them
+    from single frames (the property the reference's deterministic targets
+    have, ``/root/reference/tests/deterministic_graph_data.py:160-193``).
+    """
+    zz = np.asarray(z, np.float64)
+    pos = np.asarray(pos, np.float64)
+    dvec = pos[:, None, :] - pos[None, :, :]
+    r = np.linalg.norm(dvec, axis=-1)
+    np.fill_diagonal(r, np.inf)
+    w = np.sqrt(zz[:, None] * zz[None, :]) / 20.0
+    inside = r < cutoff
+    rc = float(cutoff)
+    rs = np.where(inside, r, rc)  # finite stand-in outside the cutoff
+    s = np.where(inside, 0.5 * (1.0 + np.cos(np.pi * rs / rc)), 0.0)
+    ds = np.where(inside, -0.5 * np.pi / rc * np.sin(np.pi * rs / rc), 0.0)
+    dr = rs - r0
+    phi = w * dr**2 * s
+    dphi = w * (2.0 * dr * s + dr**2 * ds)  # dphi/dr
+    energy = float(phi.sum() / 2.0)  # each pair counted twice
+    with np.errstate(invalid="ignore"):
+        unit = np.where(inside[..., None], dvec / r[..., None], 0.0)
+    forces = -(dphi[..., None] * unit).sum(axis=1)
+    return energy, forces
+
+
 def pairwise_energy(z, pos, cutoff=3.0):
     """Deterministic smooth 'potential': element-weighted pair interaction
     within a cutoff. Learnable from (z, pos); plays the role of a real label."""

@@ -25,6 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from common import (
     example_arg,
     load_config,
+    pair_potential_forces,
     random_molecule,
     train_example,
 )
@@ -37,15 +38,22 @@ ELEMENTS = [3, 14, 26, 8]  # Li Si Fe O — battery-materials flavour
 def trajectory_records(rng, traj_id, frames=6):
     """One synthetic relaxation: every intermediate frame is a record in
     the MPtrj flat schema (energy per atom, forces along the relaxation
-    path) — the structure of real MPtrj frames."""
+    path) — the structure of real MPtrj frames.
+
+    Labels are the closed-form pair potential of each OBSERVED frame
+    (energy per atom + its exact analytic forces), and the trajectory
+    itself is gradient descent on that same potential — so frames are
+    genuine relaxation steps AND every label is a function of the frame
+    alone. (The round-4 generator labelled frames with the distance to a
+    latent per-trajectory equilibrium the model never observes, which is
+    unlearnable beyond dataset statistics — val MAE was flat from epoch
+    0. See VERDICT round 4, item 1.)"""
     z, pos = random_molecule(rng, ELEMENTS, int(rng.integers(6, 12)), spread=2.0)
-    eq = pos + rng.normal(0, 0.05, pos.shape)
     lattice = np.diag([30.0, 30.0, 30.0])  # big box; loader is non-PBC anyway
     records = []
-    cur = pos + rng.normal(0, 0.35, pos.shape)
+    cur = pos + rng.normal(0, 0.25, pos.shape)
     for fi in range(frames):
-        disp = cur - eq
-        energy = 0.5 * float((disp**2).sum()) / len(z)  # per atom
+        energy, forces = pair_potential_forces(z, cur)
         records.append(
             {
                 "mp_id": f"mp-{traj_id}",
@@ -53,12 +61,12 @@ def trajectory_records(rng, traj_id, frames=6):
                 "z": z.astype(np.int64),
                 "pos": cur.astype(np.float64) + 15.0,  # centered in the box
                 "lattice": lattice,
-                "energy": energy,
-                "forces": (-disp).astype(np.float64),
+                "energy": energy / len(z),  # per atom, like real MPtrj
+                "forces": forces,
                 "magmom": np.zeros(len(z)),
             }
         )
-        cur = cur - 0.4 * disp  # one relaxation step
+        cur = cur + 0.05 * np.clip(forces, -2.0, 2.0)  # one relaxation step
     return records
 
 
@@ -78,10 +86,12 @@ def main():
     if real_paths:
         # real MPtrj files present: never mix a leftover synthetic file in
         paths = real_paths
-    stale_synthetic = (
-        paths == [synthetic_path]
-        and os.path.exists(marker)
-        and open(marker).read().strip() != str(num_traj)
+    # v2: pair-potential labels (learnable from the frame); the marker keys
+    # on generator version + size so relabeling invalidates old files
+    marker_want = f"v2:{num_traj}"
+    stale_synthetic = paths == [synthetic_path] and (
+        not os.path.exists(marker)
+        or open(marker).read().strip() != marker_want
     )
     if not paths or stale_synthetic:
         rng = np.random.default_rng(5)
@@ -90,7 +100,7 @@ def main():
             records.extend(trajectory_records(rng, t))
         write_mptrj_json(synthetic_path, records)
         with open(marker, "w") as f:
-            f.write(str(num_traj))
+            f.write(marker_want)
         paths = [synthetic_path]
     dataset = []
     for p in paths:

@@ -216,8 +216,22 @@ def load_mptrj(
         ):
             continue
         pos = rec["pos"].astype(np.float32)
+        # node features [z, x, y, z-coord] — the reference's MPtrj pipeline
+        # feeds cartesian coordinates as node features alongside the atomic
+        # number (/root/reference/examples/mptrj/train.py:143,234-235 with
+        # input_node_features [0,1,2,3]): an invariant MLP node head can
+        # only learn a force field if directional information reaches it.
+        # coordinates are centered per-frame (forces are translation
+        # invariant; absolute box offsets only ill-condition the first layer)
         d = GraphData(
-            x=rec["z"].astype(np.float32).reshape(-1, 1), pos=pos
+            x=np.concatenate(
+                [
+                    rec["z"].astype(np.float32).reshape(-1, 1),
+                    pos - pos.mean(axis=0, keepdims=True),
+                ],
+                axis=1,
+            ),
+            pos=pos,
         )
         d.edge_index = radius_graph(pos, radius, max_neighbours)
         lengths = np.linalg.norm(pos[d.edge_index[0]] - pos[d.edge_index[1]], axis=1)

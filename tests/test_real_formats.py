@@ -11,6 +11,7 @@ downloaded in this environment (no network egress); dropping the real
 
 import json
 import os
+import sys
 
 import numpy as np
 import pytest
@@ -246,6 +247,38 @@ def pytest_mptrj_roundtrip(tmp_path):
     assert g.targets[0][0] == pytest.approx(-6.5)
     assert g.extras["mp_id"] == "mp-1"
     assert "magmom" in g.extras and "stress" in g.extras
+    # node features are [z, centered cartesian coords] — the reference's
+    # MPtrj feature layout (train.py:143 with input_node_features [0,1,2,3]);
+    # without coordinates the invariant MLP force head cannot learn forces
+    assert g.x.shape == (2, 4)
+    np.testing.assert_allclose(g.x[:, 0], [26, 8])
+    np.testing.assert_allclose(g.x[:, 1:].mean(axis=0), 0.0, atol=1e-6)
+    np.testing.assert_allclose(
+        g.x[:, 1:], g.pos - g.pos.mean(axis=0, keepdims=True), atol=1e-6
+    )
+
+
+def pytest_pair_potential_forces_are_exact_gradient():
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+    )
+    from common import pair_potential_forces
+
+    rng = np.random.default_rng(7)
+    z = rng.choice([3, 14, 26, 8], size=9).astype(np.float64)
+    pos = rng.normal(0.0, 1.5, (9, 3)).astype(np.float64)
+    e0, f = pair_potential_forces(z, pos)
+    assert np.isfinite(e0) and np.isfinite(f).all()
+    assert np.abs(f).max() > 0  # nontrivial field
+    eps = 1e-7
+    g = np.zeros_like(pos)
+    for i in range(9):
+        for d in range(3):
+            p = pos.copy()
+            p[i, d] += eps
+            e1, _ = pair_potential_forces(z, p)
+            g[i, d] = -(e1 - e0) / eps
+    np.testing.assert_allclose(g, f, atol=1e-5)
 
 
 def pytest_mptrj_fractional_sites():
