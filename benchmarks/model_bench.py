@@ -41,7 +41,7 @@ _DEFAULT_PEAK = 197.0
 
 
 _FALSY = ("0", "false", "no", "off")
-_BOOL_FLAGS = ("bf16", "dense")
+_BOOL_FLAGS = ("bf16", "dense", "remat")
 
 
 def _arg(flag, default=None):
@@ -59,10 +59,13 @@ def _arg(flag, default=None):
     return default
 
 
-def make_graphs(num_graphs, nodes, degree, seed=0, node_jitter=True):
+def make_graphs(num_graphs, nodes, degree, seed=0, node_jitter=True,
+                input_dim=1):
     """Synthetic molecule-scale graphs: ~`nodes` atoms, `degree` incident
     edges per node (ring-offset structure — same construction as bench.py,
-    scaled), positions random so distance-based models get real geometry."""
+    scaled), positions random so distance-based models get real geometry.
+    ``input_dim`` widens the node features — the effective conv width for
+    constant-width stacks like CGCNN."""
     rng = np.random.default_rng(seed)
 
     class _S:
@@ -73,7 +76,7 @@ def make_graphs(num_graphs, nodes, degree, seed=0, node_jitter=True):
         lo = max(2, nodes - 10)  # graphs need >= 2 nodes for ring edges
         n = int(rng.integers(lo, nodes + 1)) if node_jitter else max(2, nodes)
         s = _S()
-        s.x = rng.random((n, 1)).astype(np.float32)
+        s.x = rng.random((n, input_dim)).astype(np.float32)
         s.pos = (rng.random((n, 3)) * n ** (1 / 3)).astype(np.float32)
         src = np.repeat(np.arange(n), degree // 2)
         dst = (src + rng.integers(1, n, src.shape[0])) % n
@@ -82,16 +85,20 @@ def make_graphs(num_graphs, nodes, degree, seed=0, node_jitter=True):
         ).astype(np.int64)
         d = np.linalg.norm(s.pos[s.edge_index[0]] - s.pos[s.edge_index[1]], axis=1)
         s.edge_attr = d[:, None].astype(np.float32)
-        s.targets = [np.array([s.x.sum()], np.float32), s.x.astype(np.float32)]
+        # node-head target stays 1-wide whatever the input width
+        s.targets = [
+            np.array([s.x.sum()], np.float32),
+            s.x[:, :1].astype(np.float32),
+        ]
         out.append(s)
     return out
 
 
-def _arch(model_type, hidden, layers, nodes):
+def _arch(model_type, hidden, layers, nodes, input_dim=1):
     shared = max(32, hidden // 4)
     return {
         "model_type": model_type,
-        "input_dim": 1,
+        "input_dim": input_dim,
         "hidden_dim": hidden,
         "output_dim": [1, 1],
         "output_type": ["graph", "node"],
@@ -149,6 +156,37 @@ def _collate(samples, num_graphs, nodes, degree, with_triplets):
     return batch
 
 
+# the row-identity fields of every BENCH_EXTRA row, in order — bench.py's
+# merge/age machinery imports these so the two representations cannot drift
+KEY_FIELDS = ("model", "hidden", "graphs_per_batch", "nodes_per_graph",
+              "avg_degree", "layers", "precision", "aggregation", "remat",
+              "input_dim")
+
+
+def config_identity(model_type="PNA", hidden=64, num_graphs=64, nodes=90,
+                    degree=12, layers=3, bf16=False, dense=False,
+                    remat=False, input_dim=1, **_ignored):
+    """The BENCH row identity a ``bench_model(**kw)`` call produces —
+    SINGLE source of truth used both to build the measured row dict and by
+    bench.py to key its age/merge lookups. Non-default knobs appear only
+    when active so pre-existing row identities stay stable."""
+    ident = {
+        "model": model_type,
+        "hidden": hidden,
+        "graphs_per_batch": num_graphs,
+        "nodes_per_graph": nodes,
+        "avg_degree": degree,
+        "layers": layers,
+        "precision": "bf16" if bf16 else "f32",
+        "aggregation": "dense" if dense else "segment",
+    }
+    if remat:
+        ident["remat"] = True
+    if input_dim != 1:
+        ident["input_dim"] = input_dim
+    return ident
+
+
 def bench_model(
     model_type="PNA",
     hidden=64,
@@ -160,9 +198,14 @@ def bench_model(
     dense=False,
     iters=20,
     seed=0,
+    remat=False,
+    input_dim=1,
 ):
     """Measure one jitted train step. Returns a dict with fence-true
-    ms/step, graphs/sec, XLA-counted TFLOP/s, and MFU vs the chip's peak."""
+    ms/step, graphs/sec, XLA-counted TFLOP/s, and MFU vs the chip's peak.
+    ``remat`` enables conv checkpointing (recompute conv activations in the
+    backward pass — the memory lever for OOM-prone widths); ``input_dim``
+    widens node features (CGCNN's effective conv width)."""
     if iters < 1:
         raise ValueError(f"iters must be >= 1, got {iters}")
     import jax
@@ -172,7 +215,7 @@ def bench_model(
     from hydragnn_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
-    samples = make_graphs(num_graphs, nodes, degree, seed)
+    samples = make_graphs(num_graphs, nodes, degree, seed, input_dim=input_dim)
     batch = _collate(
         samples, num_graphs, nodes, degree, with_triplets=model_type == "DimeNet"
     )
@@ -180,7 +223,10 @@ def bench_model(
         from hydragnn_tpu.ops.dense_agg import attach_neighbor_lists
 
         batch = attach_neighbor_lists(batch)
-    model = create_model_config(_arch(model_type, hidden, layers, nodes))
+    arch = _arch(model_type, hidden, layers, nodes, input_dim=input_dim)
+    if remat:
+        arch["conv_checkpointing"] = True
+    model = create_model_config(arch)
     trainer = Trainer(
         model,
         training_config={
@@ -215,14 +261,11 @@ def bench_model(
     peak = _PEAK_TFLOPS.get(kind, _DEFAULT_PEAK)
     tflops = (flops / dt) / 1e12 if flops else None
     return {
-        "model": model_type,
-        "hidden": hidden,
-        "graphs_per_batch": num_graphs,
-        "nodes_per_graph": nodes,
-        "avg_degree": degree,
-        "layers": layers,
-        "precision": "bf16" if bf16 else "f32",
-        "aggregation": "dense" if dense else "segment",
+        **config_identity(
+            model_type=model_type, hidden=hidden, num_graphs=num_graphs,
+            nodes=nodes, degree=degree, layers=layers, bf16=bf16,
+            dense=dense, remat=remat, input_dim=input_dim,
+        ),
         "ms_per_step": round(dt * 1e3, 3),
         "graphs_per_sec": round(num_graphs / dt, 1),
         "flops_per_step": flops,
@@ -244,6 +287,8 @@ def main():
         bf16=bool(_arg("bf16", False)),
         dense=bool(_arg("dense", False)),
         iters=int(_arg("iters", 20)),
+        remat=bool(_arg("remat", False)),
+        input_dim=int(_arg("input_dim", 1)),
     )
     import json
 

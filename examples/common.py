@@ -70,6 +70,25 @@ def apply_cli_overrides(config: dict) -> dict:
         v = example_arg(key)
         if v is not None:
             training[key] = int(v)
+    v = example_arg("steps_per_dispatch")
+    if v is True:
+        raise SystemExit(
+            "--steps_per_dispatch needs a value (steps per XLA dispatch; "
+            "0/off disables stacking), e.g. --steps_per_dispatch 8"
+        )
+    if v is not None:
+        # falsy spellings disable stacking (trainer treats 1 as the plain
+        # per-batch path), matching the other boolean-ish flags
+        if str(v).lower() in ("0", "off", "false", "no"):
+            training["steps_per_dispatch"] = 1
+        else:
+            try:
+                training["steps_per_dispatch"] = int(v)
+            except ValueError:
+                raise SystemExit(
+                    f"--steps_per_dispatch: expected an integer or "
+                    f"0/off, got {v!r}"
+                )
     # execution-mode flags (every example gets them for free):
     # --device-resident stages the training set in HBM; --fit-chunk N
     # additionally runs whole-training chunks as single XLA dispatches
@@ -141,13 +160,18 @@ def train_with_loaders(config, trainset, valset, testset, log_name, seed=0):
     print_utils.setup_log(log_name)
 
     training = config["NeuralNetwork"]["Training"]
-    from hydragnn_tpu.data.loaders import needs_dense_neighbors
+    from hydragnn_tpu.data.loaders import (
+        arch_for_auto_policy,
+        needs_dense_neighbors,
+    )
 
     arch_cfg = config["NeuralNetwork"]["Architecture"]
     need_triplets = arch_cfg.get("model_type") == "DimeNet"
     train_loader, val_loader, test_loader = create_dataloaders(
         trainset, valset, testset, training["batch_size"], need_triplets,
-        need_neighbors=needs_dense_neighbors(arch_cfg),
+        need_neighbors=needs_dense_neighbors(
+            arch_for_auto_policy(config["NeuralNetwork"])
+        ),
         num_buckets=training.get("batch_buckets"),
         contiguous_buckets=training.get("contiguous_buckets"),
         bucket_graph_cap=training.get("bucket_graph_cap", "batch"),

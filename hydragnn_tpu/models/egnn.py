@@ -6,6 +6,11 @@ edge MLP on [h_row, h_col, ||dx||^2, e_ij] (2x Linear+ReLU), node MLP on
 xavier(gain=1e-3) final layer, message aggregation at the SENDER index
 (``:194,210`` — `row` = edge_index[0]), Identity feature layers (no encoder
 BatchNorm, ``:36-46``), coord update gated off on the last layer.
+
+TPU-first deviation: the first edge-MLP Linear is algebraically split into
+node-axis projections (see the fusion comment in :class:`E_GCL`) — same
+parameters, same math, degree-fold less edge-axis MXU work and no
+``[E, 2D+1+edge]`` concat intermediate in HBM.
 """
 
 import jax
@@ -14,7 +19,7 @@ from flax import linen as nn
 
 from hydragnn_tpu.graph import segment_sum
 from hydragnn_tpu.models.base import HydraBase
-from hydragnn_tpu.models.common import TorchLinear
+from hydragnn_tpu.models.common import SplitLinear, TorchLinear
 
 
 def _safe_sqrt(x):
@@ -71,6 +76,26 @@ class E_GCL(nn.Module):
         row, col = batch.senders, batch.receivers
         extras = batch.extras or {}
         dense = "nbr_idx" in extras
+        in_dim = x.shape[-1]
+
+        # ---- algebraic edge-MLP fusion (round-4 verdict item 2) ----
+        # The first edge-MLP Linear acts on concat([x_row, x_col, radial,
+        # e_ij]), so by linearity
+        #   L0 = x_row @ Wr + (x_col @ Wc + b) + radial * w_rad + e @ We
+        # with the two D x H projections computed ONCE per NODE (deg-fold
+        # less MXU work than the edge-axis matmul) and only cheap adds /
+        # a rank-1 radial term left on the edge axis. The [E, 2D+1+edge]
+        # concat intermediate disappears entirely. Parameters stay
+        # TorchLinear-compatible (SplitLinear shares names/shapes/init),
+        # same PNA move as models/pna.py:53-74.
+        fan_in = 2 * in_dim + 1 + self.edge_attr_dim
+        pre = SplitLinear(
+            features=self.hidden_dim, fan_in=fan_in, name="edge_mlp_0"
+        )
+        y_snd = pre.piece(x, 0)  # sender-side contribution [N, H]
+        y_rcv = pre.piece(x, in_dim) + pre.bias  # receiver side + bias
+        w_rad = pre.kernel[2 * in_dim]  # [H] radial row
+
         if dense:
             # dense scatter-free frame: per-edge values live as [N, K, *]
             # keyed by (receiver, slot); j = sender, i = receiver
@@ -78,33 +103,37 @@ class E_GCL(nn.Module):
 
             nmask = extras["nbr_mask"]
             emask_nd = nmask[..., None]
-            # ONE fused gather for features+positions (halves the gather /
-            # reverse-gather traffic — the dominant dense-mode cost here)
+            # ONE fused gather for projected-features+positions (halves the
+            # gather / reverse-gather traffic — the dominant dense-mode cost)
             both_j = gather_neighbors(
-                jnp.concatenate([x, pos], axis=-1),
+                jnp.concatenate([y_snd, pos], axis=-1),
                 extras["nbr_idx"],
                 extras["rev_idx"],
                 extras["rev_mask"],
             )
-            x_j, pos_j = both_j[..., : x.shape[-1]], both_j[..., x.shape[-1] :]
+            y_j, pos_j = both_j[..., : self.hidden_dim], both_j[..., self.hidden_dim :]
             coord_diff = pos_j - pos[:, None, :]
             radial = (coord_diff * coord_diff).sum(-1, keepdims=True)
             norm = _safe_sqrt(radial) + 1.0  # norm_diff=True
             coord_diff = coord_diff / norm
-            parts = [x_j, jnp.broadcast_to(x[:, None, :], x_j.shape), radial]
+            e = y_j + y_rcv[:, None, :] + radial * w_rad
             if self.edge_attr_dim > 0:
-                parts.append(batch.edge_attr[extras["nbr_edge"]])
+                # gather the NARROW raw edge_attr first, project after —
+                # projecting first would gather [N, K, H] instead of
+                # [N, K, edge_dim] and add a backward scatter
+                e = e + pre.piece(
+                    batch.edge_attr[extras["nbr_edge"]], 2 * in_dim + 1
+                )
         else:
             emask_nd = batch.edge_mask[:, None]
             coord_diff = pos[row] - pos[col]
             radial = (coord_diff * coord_diff).sum(-1, keepdims=True)
             norm = _safe_sqrt(radial) + 1.0  # norm_diff=True
             coord_diff = coord_diff / norm
-            parts = [x[row], x[col], radial]
+            e = y_snd[row] + y_rcv[col] + radial * w_rad
             if self.edge_attr_dim > 0:
-                parts.append(batch.edge_attr)
-        e = jnp.concatenate(parts, axis=-1)
-        e = jax.nn.relu(TorchLinear(self.hidden_dim, name="edge_mlp_0")(e))
+                e = e + pre.piece(batch.edge_attr, 2 * in_dim + 1)
+        e = jax.nn.relu(e)
         e = jax.nn.relu(TorchLinear(self.hidden_dim, name="edge_mlp_1")(e))
         e = jnp.where(emask_nd, e, 0.0)
 

@@ -111,3 +111,31 @@ def pytest_headline_shape():
     parsed = json.loads(line)
     assert set(parsed) == {"metric", "value", "unit", "vs_baseline"}
     assert len(line) < 200  # tail-capture safe
+
+
+def pytest_failed_attempt_annotates_without_losing_metrics(tmp_path):
+    """A failed re-measure must keep the last good row's metrics (history
+    is the point of the merge) while resetting attempt_age so the
+    oldest-first refresh order moves past the failing config."""
+    out = str(tmp_path / "extra.json")
+    bench.merge_extra_rows(out, [_row("PNA", ms=7.0), _row("GIN", ms=2.0)])
+    kw = dict(model_type="PNA", hidden=256, num_graphs=64, nodes=90,
+              degree=12, layers=3)
+    rows = bench.merge_extra_rows(out, [], failures=[(kw, "boom")])
+    pna = next(r for r in rows if r["model"] == "PNA")
+    gin = next(r for r in rows if r["model"] == "GIN")
+    assert pna["ms_per_step"] == 7.0  # metrics preserved
+    assert pna["failed"] == "boom"
+    assert pna["attempt_age"] == 0 and pna["age"] == 1  # data is stale,
+    assert gin["attempt_age"] == 1  # ...but the attempt is fresh
+    ages = bench.read_row_ages(out)
+    assert ages[bench._config_key(kw)] == 0
+    # a failing NEVER-measured config gets a stub so it ages too
+    kw2 = dict(kw, model_type="SAGE")
+    rows = bench.merge_extra_rows(out, [], failures=[(kw2, "oom")])
+    sage = next(r for r in rows if r["model"] == "SAGE")
+    assert sage["failed"] == "oom" and "ms_per_step" not in sage
+    # a later SUCCESS clears the failure annotation
+    rows = bench.merge_extra_rows(out, [_row("PNA", ms=6.5)])
+    pna = next(r for r in rows if r["model"] == "PNA")
+    assert "failed" not in pna and pna["ms_per_step"] == 6.5

@@ -150,6 +150,21 @@ def pytest_auto_dense_aggregation_policy():
     # at input_dim width, so hidden_dim is not a crossover signal.
     for m in ("SchNet", "EGNN", "CGCNN"):
         assert not needs_dense_neighbors({"model_type": m, "hidden_dim": 512})
+    # CGCNN's own rule keys on input_dim — its true conv width — and
+    # INVERSELY: the dense frame's gather traffic grows with input width
+    # while the scatter cost it removes stays flat (round-5 measured
+    # crossover, BASELINE.md). Narrow inputs (the realistic case) go dense.
+    assert needs_dense_neighbors(
+        {"model_type": "CGCNN", "hidden_dim": 64, "input_dim": 4}
+    )
+    assert needs_dense_neighbors(
+        {"model_type": "CGCNN", "hidden_dim": 512, "input_dim": 64}
+    )
+    assert not needs_dense_neighbors(
+        {"model_type": "CGCNN", "hidden_dim": 64, "input_dim": 256}
+    )
+    # absent input_dim stays conservative (segment), whatever the hidden
+    assert not needs_dense_neighbors({"model_type": "CGCNN", "hidden_dim": 512})
     # explicit override beats the policy in both directions
     assert not needs_dense_neighbors(
         {"model_type": "PNA", "hidden_dim": 256, "dense_aggregation": False}
