@@ -33,9 +33,9 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from common import example_arg, load_config, train_with_loaders
+from common import example_arg, load_config, pbc_pair_energy, train_with_loaders
 
-from hydragnn_tpu.data import radius_graph_pbc, split_dataset
+from hydragnn_tpu.data import split_dataset
 from hydragnn_tpu.data.extxyz import load_extxyz_dir, write_extxyz
 from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
 from hydragnn_tpu.parallel.distributed import (
@@ -50,10 +50,18 @@ ALAT = 3.6
 VACUUM = 15.0
 
 
-def make_structure(rng, radius, max_neighbours):
+def make_structure(rng, radius):
     """2-layer 2x2 FCC(100) slab + one adsorbate, as an extxyz frame dict
     (z, pos, cell, energy in info) — the synthetic stand-in for one real
-    OC20 frame."""
+    OC20 frame.
+
+    The energy label is the continuous minimum-image pair potential of the
+    observed (jittered) geometry. The round-4 label was a near-discrete
+    function of (adsorbate, metal, coordination count) — ~30 distinct
+    values at 20k frames — which the model saturated inside epoch 0, so
+    validation was flat from the first measurement (VERDICT round 4,
+    item 1). A smooth geometric target gives a genuine multi-epoch
+    regression task at any dataset size."""
     metal = METALS[int(rng.integers(len(METALS)))]
     ads = ADSORBATES[int(rng.integers(len(ADSORBATES)))]
     pos, z = [], []
@@ -68,15 +76,15 @@ def make_structure(rng, radius, max_neighbours):
     pos.append([site[0] * ALAT + 0.5 * ALAT, site[1] * ALAT + 0.5 * ALAT,
                 ALAT * 0.5 + 1.6 + rng.uniform(-0.2, 0.4)])
     z.append(ads)
-    pos = np.asarray(pos, np.float64) + rng.normal(0, 0.05, (9, 3))
+    pos = np.asarray(pos, np.float64) + rng.normal(0, 0.08, (9, 3))
     cell = np.diag([2 * ALAT, 2 * ALAT, ALAT + VACUUM])
-
-    # adsorption energy: species term + coordination of the adsorbate
-    edge_index, _ = radius_graph_pbc(pos, cell, radius, max_neighbours)
-    ads_coord = int((edge_index[1] == 8).sum())
-    energy = {1: -0.5, 8: -1.2, 6: -0.9}[ads] * (1 + 0.15 * ads_coord) + {
-        29: 0.1, 78: -0.3, 47: 0.2
-    }[metal]
+    # the potential cutoff IS the config's graph radius, so every
+    # contributing pair is an edge the model sees (no irreducible shell
+    # outside the graph); 3.5 pulls the interlayer metal pairs (3.12 A) in.
+    # Minimum image needs cutoff < in-plane period / 2 = 3.6.
+    if not radius < ALAT:
+        raise ValueError(f"radius {radius} breaks minimum image (< {ALAT})")
+    energy = pbc_pair_energy(z, pos, cell, cutoff=radius, r0=2.0)
     return {
         "z": np.asarray(z, np.int64),
         "pos": pos,
@@ -100,8 +108,7 @@ def preonly(config, modelname, num_samples):
         os.makedirs(xyz_dir, exist_ok=True)
         write_extxyz(
             my_xyz,
-            (make_structure(rng, arch["radius"], arch["max_neighbours"])
-             for _ in my_ids),
+            (make_structure(rng, arch["radius"]) for _ in my_ids),
         )
         files = [my_xyz]
     else:

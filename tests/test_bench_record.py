@@ -99,18 +99,21 @@ def pytest_merge_backs_up_corrupt_file(tmp_path, capsys):
 
 def pytest_headline_shape():
     """The driver json-parses the LAST stdout line: keep it one compact
-    object with the contracted keys."""
-    line = json.dumps(
-        {
-            "metric": "pna_multihead_train_graphs_per_sec",
-            "value": 1.0,
-            "unit": "graphs/sec",
-            "vs_baseline": 1.0,
-        }
-    )
+    object with the contracted keys — exercised through the REAL
+    formatting helper at worst-case value widths."""
+    line = bench.headline_line(123456.78, 1234.5678, 98765.43, 1234.5678)
     parsed = json.loads(line)
-    assert set(parsed) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(parsed) == {
+        "metric",
+        "value",
+        "unit",
+        "vs_baseline",
+        "legacy_value",
+        "legacy_vs_baseline",
+    }
     assert len(line) < 200  # tail-capture safe
+    # every baseline may fail independently; Nones must not crash or widen
+    assert json.loads(bench.headline_line(1.0, None, None, None))
 
 
 def pytest_failed_attempt_annotates_without_losing_metrics(tmp_path):
