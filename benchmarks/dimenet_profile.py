@@ -104,10 +104,11 @@ def main():
     fwd = jax.jit(lambda p, b: model.apply({"params": p}, b, train=False))
     t_fwd = _time(fwd, (state.params, dbatch), iters)
 
-    s2, m = trainer._train_step(state, dbatch, rng)
+    # ``state`` is DONATED by the compiled step: thread the returned state,
+    # never reuse the pre-warm one (its buffers are gone after the warm call)
+    s, m = trainer._train_step(state, dbatch, rng)
     np.asarray(m["loss"])
     t0 = time.perf_counter()
-    s = state
     for _ in range(iters):
         s, m = trainer._train_step(s, dbatch, rng)
     float(np.asarray(m["loss"]))

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 north-star re-runs: the two rows whose round-4 validation was
+# flat (OC20+DimeNet, MPtrj+EGNN — now with learnable continuous targets)
+# plus a fresh GFM row on the composed path (spd from gfm.json).
+# Sequential — they share the one chip. Logs under /tmp/northstar_r5/.
+set -u
+OUT=${1:-/tmp/northstar_r5}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "=== OC20 extxyz + DimeNet (20k frames, shard store) ===" > "$OUT/status"
+( cd examples/open_catalyst_2020 && rm -rf dataset/OC20R5* && time python train.py \
+    --preonly --num_samples 20000 --modelname OC20R5 ) \
+  > "$OUT/oc20_preonly.log" 2>&1
+echo "oc20 preonly rc=$?" >> "$OUT/status"
+( cd examples/open_catalyst_2020 && time python train.py \
+    --modelname OC20R5 --model_type DimeNet --hidden_dim 128 \
+    --num_epoch 10 ) \
+  > "$OUT/oc20.log" 2>&1
+echo "oc20 rc=$?" >> "$OUT/status"
+
+echo "=== MPtrj + EGNN (20k trajectories = 120k frames) ===" >> "$OUT/status"
+( cd examples/mptrj && rm -rf dataset/mptrj && time python train.py \
+    --num_samples 20000 --max_frames all --num_epoch 10 \
+    --log_name_suffix scale ) \
+  > "$OUT/mptrj.log" 2>&1
+echo "mptrj rc=$?" >> "$OUT/status"
+
+echo "=== Multidataset GFM (3 x 40k, steps_per_dispatch from gfm.json) ===" >> "$OUT/status"
+( cd examples/multidataset && time python train.py --preonly \
+    --num_samples 40000 ) \
+  > "$OUT/gfm_preonly.log" 2>&1
+echo "gfm preonly rc=$?" >> "$OUT/status"
+( cd examples/multidataset && time python train.py --num_samples 40000 \
+    --hidden_dim 128 --num_epoch 10 ) \
+  > "$OUT/gfm.log" 2>&1
+echo "gfm rc=$?" >> "$OUT/status"
+echo "ALL DONE" >> "$OUT/status"

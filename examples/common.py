@@ -269,11 +269,24 @@ def pair_potential_forces(z, pos, cutoff=3.0, r0=1.5, w_scale=0.05):
     from single frames (the property the reference's deterministic targets
     have, ``/root/reference/tests/deterministic_graph_data.py:160-193``).
     """
-    zz = np.asarray(z, np.float64)
     pos = np.asarray(pos, np.float64)
     dvec = pos[:, None, :] - pos[None, :, :]
     r = np.linalg.norm(dvec, axis=-1)
     np.fill_diagonal(r, np.inf)
+    phi, dphi, inside = _pair_terms(z, r, cutoff, r0, w_scale)
+    energy = float(phi.sum() / 2.0)  # each pair counted twice
+    with np.errstate(invalid="ignore"):
+        unit = np.where(inside[..., None], dvec / r[..., None], 0.0)
+    forces = -(dphi[..., None] * unit).sum(axis=1)
+    return energy, forces
+
+
+def _pair_terms(z, r, cutoff, r0, w_scale):
+    """Shared pair-potential core: phi(r), dphi/dr, and the inside-cutoff
+    mask from a pairwise distance matrix (diagonal pre-set to inf). The
+    single place the functional form lives — both the free-space and the
+    minimum-image labels call through here."""
+    zz = np.asarray(z, np.float64)
     w = w_scale * np.sqrt(zz[:, None] * zz[None, :])
     inside = r < cutoff
     rc = float(cutoff)
@@ -283,34 +296,26 @@ def pair_potential_forces(z, pos, cutoff=3.0, r0=1.5, w_scale=0.05):
     dr = rs - r0
     phi = w * dr**2 * s
     dphi = w * (2.0 * dr * s + dr**2 * ds)  # dphi/dr
-    energy = float(phi.sum() / 2.0)  # each pair counted twice
-    with np.errstate(invalid="ignore"):
-        unit = np.where(inside[..., None], dvec / r[..., None], 0.0)
-    forces = -(dphi[..., None] * unit).sum(axis=1)
-    return energy, forces
+    return phi, dphi, inside
 
 
 def pbc_pair_energy(z, pos, cell, cutoff=3.0, r0=2.0, w_scale=0.05):
     """Minimum-image (diagonal-cell) variant of the pair potential in
     :func:`pair_potential_forces` — energy only.
 
-    Same smooth functional form, distances taken through the periodic cell
-    so slab workloads get a label that is a continuous function of the
-    observed geometry. Valid while ``cutoff < min(diag(cell)) / 2`` (the
-    minimum-image criterion), which the OC20 slab satisfies (cutoff 3.0,
-    in-plane period 7.2)."""
-    zz = np.asarray(z, np.float64)
+    Same smooth functional form (shared :func:`_pair_terms` core),
+    distances taken through the periodic cell so slab workloads get a
+    label that is a continuous function of the observed geometry. Valid
+    while ``cutoff < min(diag(cell)) / 2`` (the minimum-image criterion),
+    which the OC20 slab satisfies (cutoff 3.5, in-plane period 7.2)."""
     pos = np.asarray(pos, np.float64)
     period = np.diag(np.asarray(cell, np.float64))
     dvec = pos[:, None, :] - pos[None, :, :]
     dvec -= np.round(dvec / period) * period
     r = np.linalg.norm(dvec, axis=-1)
     np.fill_diagonal(r, np.inf)
-    w = w_scale * np.sqrt(zz[:, None] * zz[None, :])
-    inside = r < cutoff
-    rs = np.where(inside, r, cutoff)
-    s = np.where(inside, 0.5 * (1.0 + np.cos(np.pi * rs / cutoff)), 0.0)
-    return float((w * (rs - r0) ** 2 * s).sum() / 2.0)
+    phi, _, _ = _pair_terms(z, r, cutoff, r0, w_scale)
+    return float(phi.sum() / 2.0)
 
 
 def pairwise_energy(z, pos, cutoff=3.0):

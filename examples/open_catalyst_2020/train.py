@@ -94,6 +94,22 @@ def make_structure(rng, radius):
     }
 
 
+# bump when the synthetic label generator changes: stale shard stores must
+# not be silently reused under a new task definition (the MPtrj v2→v3
+# marker pattern). The marker also pins radius — it shapes the stored
+# graphs AND the label cutoff.
+_GEN_VERSION = "v2"
+
+
+def _marker_path(modelname):
+    return f"dataset/{modelname}_gen.meta"
+
+
+def _marker_want(config):
+    radius = config["NeuralNetwork"]["Architecture"]["radius"]
+    return f"{_GEN_VERSION}:radius={radius}"
+
+
 def preonly(config, modelname, num_samples):
     world, rank = get_comm_size_and_rank()
     arch = config["NeuralNetwork"]["Architecture"]
@@ -133,6 +149,9 @@ def preonly(config, modelname, num_samples):
         w = ShardWriter(f"dataset/{modelname}_{name}", rank=rank)
         w.add(ds)
         w.save()
+    if rank == 0:
+        with open(_marker_path(modelname), "w") as f:
+            f.write(_marker_want(config))
     print(f"rank {rank}: wrote {len(trainset)}/{len(valset)}/{len(testset)}")
 
 
@@ -169,6 +188,15 @@ def main():
         preonly(config, modelname, num_samples)
         return
 
+    marker = _marker_path(modelname)
+    have = open(marker).read().strip() if os.path.exists(marker) else None
+    if have != _marker_want(config):
+        raise SystemExit(
+            f"shard store dataset/{modelname}_* was written by a different "
+            f"generator/radius (marker: {have!r}, config wants "
+            f"{_marker_want(config)!r}) — re-run with --preonly to "
+            "regenerate before training"
+        )
     preload = bool(example_arg("preload"))
     ddstore = bool(example_arg("ddstore"))
     width = example_arg("ddstore_width")

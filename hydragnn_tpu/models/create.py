@@ -6,6 +6,7 @@ the derived architecture section (after ``update_config``) and dispatches on
 materialized separately (functional JAX) by ``init_model_params``.
 """
 
+import functools
 from typing import Optional
 
 import jax
@@ -156,9 +157,18 @@ def create_model_config(config: dict, verbosity: int = 0) -> HydraBase:
 
 def init_model_params(model: HydraBase, example_batch, seed: int = 0):
     """Materialize parameters + batch stats (reference seeds torch with 0,
-    ``create.py:107``)."""
+    ``create.py:107``).
+
+    The init runs under ONE jit: eager flax init dispatches every traced
+    primitive as its own XLA program, and on backends where each tiny
+    compile costs ~0.5 s (the tunneled axon chip: 148 programs, 92 s of a
+    112 s bench stage) none of them clear JAX's 1 s persistent-cache
+    threshold — so the cost recurred every process. One program compiles
+    once, persists, and PRNG values are bit-identical either way."""
     rngs = {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(1)}
-    variables = model.init(rngs, example_batch, train=False)
+    variables = jax.jit(functools.partial(model.init, train=False))(
+        rngs, example_batch
+    )
     return variables
 
 

@@ -281,6 +281,43 @@ def pytest_pair_potential_forces_are_exact_gradient():
     np.testing.assert_allclose(g, f, atol=1e-5)
 
 
+def pytest_pbc_pair_energy_matches_brute_force_images():
+    """Minimum-image energy == explicit sum over periodic images (valid
+    while cutoff < min period / 2, the OC20 slab regime)."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+    )
+    from common import pbc_pair_energy
+
+    rng = np.random.default_rng(11)
+    cell = np.diag([7.2, 7.2, 18.6])
+    z = rng.choice([29, 78, 47, 8], size=9).astype(np.float64)
+    pos = rng.uniform(0.0, 7.2, (9, 3))
+    cutoff, r0, w_scale = 3.5, 2.0, 0.05
+
+    def brute(z, pos):
+        e = 0.0
+        period = np.diag(cell)
+        for i in range(len(z)):
+            for j in range(len(z)):
+                for sx in (-1, 0, 1):
+                    for sy in (-1, 0, 1):
+                        for sz in (-1, 0, 1):
+                            if i == j and sx == sy == sz == 0:
+                                continue
+                            d = pos[i] - pos[j] + np.array([sx, sy, sz]) * period
+                            r = np.linalg.norm(d)
+                            if r < cutoff:
+                                w = w_scale * np.sqrt(z[i] * z[j])
+                                s = 0.5 * (1 + np.cos(np.pi * r / cutoff))
+                                e += w * (r - r0) ** 2 * s
+        return e / 2.0
+
+    got = pbc_pair_energy(z, pos, cell, cutoff=cutoff, r0=r0, w_scale=w_scale)
+    np.testing.assert_allclose(got, brute(z, pos), rtol=1e-10)
+    assert got > 0  # nontrivial
+
+
 def pytest_mptrj_fractional_sites():
     s = {
         "lattice": {"matrix": [[2.0, 0, 0], [0, 2.0, 0], [0, 0, 2.0]]},
