@@ -106,8 +106,13 @@ def _marker_path(modelname):
 
 
 def _marker_want(config):
-    radius = config["NeuralNetwork"]["Architecture"]["radius"]
-    return f"{_GEN_VERSION}:radius={radius}"
+    arch = config["NeuralNetwork"]["Architecture"]
+    # radius AND max_neighbours shape the stored graphs (and radius the
+    # label cutoff): pin both
+    return (
+        f"{_GEN_VERSION}:radius={arch['radius']}"
+        f":max_neighbours={arch['max_neighbours']}"
+    )
 
 
 def preonly(config, modelname, num_samples):

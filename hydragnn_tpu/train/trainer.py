@@ -54,6 +54,12 @@ class Trainer(PredictMixin):
         verbosity: int = 0,
         freeze_conv: bool = False,
     ):
+        # every Trainer front-door (driver, examples, benches) gets the
+        # persistent XLA cache; idempotent, and on the tunneled backend it
+        # is worth ~25 s of sub-second recompiles per process startup
+        from hydragnn_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
         self.model = model
         self.training_config = training_config
         self.mesh = mesh

@@ -37,7 +37,20 @@ def enable_compile_cache():
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # persist SUB-second programs on accelerator backends: on the
+        # tunneled axon chip every tiny compile costs ~0.6-0.9 s and a
+        # training startup runs ~40 of them (put_batch layouts, metric
+        # readbacks) — none clear the default 1.0 s floor, so ~25 s of
+        # epoch-0 recompiles recurred per process (BASELINE.md round 5).
+        # CPU keeps a small floor: millisecond compiles gain nothing and
+        # the cache has no eviction, so persisting them is pure disk
+        # growth. HYDRAGNN_COMPILE_CACHE_MIN_SECS overrides either way.
+        floor = os.getenv("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
+        if floor is None:
+            floor = 0.1 if jax.default_backend() == "cpu" else 0.0
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(floor)
+        )
         _enabled = True
     except Exception:
         # cache is an optimization only — never fail a run over it
