@@ -45,11 +45,26 @@ def enable_compile_cache():
         # CPU keeps a small floor: millisecond compiles gain nothing and
         # the cache has no eviction, so persisting them is pure disk
         # growth. HYDRAGNN_COMPILE_CACHE_MIN_SECS overrides either way.
-        floor = os.getenv("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
-        if floor is None:
-            floor = 0.1 if jax.default_backend() == "cpu" else 0.0
+        # The platform is read from config/env ONLY — jax.default_backend()
+        # would initialize the XLA backend here, and this runs before
+        # jax.distributed.initialize() in the multi-host driver path.
+        env_floor = os.getenv("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
+        if env_floor is not None:
+            try:
+                floor = float(env_floor)
+            except ValueError:
+                print(
+                    "HYDRAGNN_COMPILE_CACHE_MIN_SECS="
+                    f"{env_floor!r} is not a number; ignoring"
+                )
+                env_floor = None
+        if env_floor is None:
+            platforms = (
+                jax.config.jax_platforms or os.getenv("JAX_PLATFORMS") or ""
+            )
+            floor = 0.1 if platforms.split(",")[0] == "cpu" else 0.0
         jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", float(floor)
+            "jax_persistent_cache_min_compile_time_secs", floor
         )
         _enabled = True
     except Exception:
