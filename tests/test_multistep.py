@@ -9,6 +9,8 @@ reference has no counterpart (its hot loop is eager per-batch,
 """
 
 import numpy as np
+import pytest
+
 import jax
 
 from hydragnn_tpu.graph import collate_graphs, pad_sizes_for, stack_batches
@@ -135,10 +137,13 @@ def pytest_multistep_evaluate_matches_single_step():
     np.testing.assert_allclose(tasks1, tasks2, rtol=1e-6)
 
 
-def pytest_device_prefetch_matches_sync():
+@pytest.mark.parametrize("spd", [1, 2])
+def pytest_device_prefetch_matches_sync(spd):
     """The double-buffered device-prefetch streaming path (transfers
     issued ahead from a background thread) must reproduce the strict
-    alternate-transfer-and-step trajectory exactly."""
+    alternate-transfer-and-step trajectory exactly — both for per-batch
+    dispatch and COMPOSED with multi-step stacking (spd=2: the round-5
+    production configuration, prefetching stacked groups)."""
     batches = _batches(5)
 
     def run(depth):
@@ -148,6 +153,7 @@ def pytest_device_prefetch_matches_sync():
             training_config={
                 "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
                 "device_prefetch": depth,
+                "steps_per_dispatch": spd,
             },
         )
         state = trainer.init_state(batches[0])
