@@ -32,6 +32,8 @@ from hydragnn_tpu.parallel.distributed import (
     get_comm_size_and_rank,
     host_allgather_int,
 )
+from hydragnn_tpu.utils import faults
+from hydragnn_tpu.utils.retry import retry_io
 
 _META_VERSION = 1
 
@@ -107,8 +109,14 @@ class SimplePickleDataset:
         self.basedir = basedir
         self.label = label
         self.var_config = var_config
-        with open(os.path.join(basedir, f"{label}-meta.pkl"), "rb") as f:
-            meta = pickle.load(f)
+        meta_path = os.path.join(basedir, f"{label}-meta.pkl")
+
+        def _read_meta():
+            faults.flaky_read(meta_path)
+            with open(meta_path, "rb") as f:
+                return pickle.load(f)
+
+        meta = retry_io(_read_meta, what=meta_path)
         if not isinstance(meta, dict) or "version" not in meta:
             raise ValueError(
                 f"{label}-meta.pkl is not a hydragnn_tpu pickle-dataset "
@@ -132,9 +140,15 @@ class SimplePickleDataset:
         path = _sample_path(
             self.basedir, self.label, k, self.use_subdir, self.nmax_persubdir
         )
-        with open(path, "rb") as f:
-            data = pickle.load(f)
-        return self._update(data)
+
+        def _read():
+            faults.flaky_read(path)
+            with open(path, "rb") as f:
+                return pickle.load(f)
+
+        # per-sample reads hit the filesystem once per __getitem__; on
+        # flaky shared mounts that's the hottest transient-OSError surface
+        return self._update(retry_io(_read, what=path))
 
     def _update(self, data: GraphData) -> GraphData:
         if self.var_config is not None:

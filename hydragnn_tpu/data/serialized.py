@@ -20,6 +20,8 @@ from hydragnn_tpu.data.transforms import (
     point_pair_features,
     spherical_descriptor,
 )
+from hydragnn_tpu.utils import faults
+from hydragnn_tpu.utils.retry import retry_io
 
 
 def extract_targets(
@@ -92,10 +94,16 @@ class SerializedGraphLoader:
         self.dist = dist
 
     def load_serialized_data(self, dataset_path: str) -> List[GraphData]:
-        with open(dataset_path, "rb") as f:
-            _ = pickle.load(f)  # minmax node
-            _ = pickle.load(f)  # minmax graph
-            dataset = pickle.load(f)
+        def _read():
+            faults.flaky_read(dataset_path)
+            with open(dataset_path, "rb") as f:
+                _ = pickle.load(f)  # minmax node
+                _ = pickle.load(f)  # minmax graph
+                return pickle.load(f)
+
+        # one big read off a shared filesystem: transient OSError gets
+        # jittered-backoff retries instead of killing the job at startup
+        dataset = retry_io(_read, what=dataset_path)
 
         if self.rotational_invariance:
             dataset = [normalize_rotation(d) for d in dataset]

@@ -25,6 +25,8 @@ import numpy as np
 
 from hydragnn_tpu.data.dataobj import GraphData
 from hydragnn_tpu.native.graphpack import PackReader, PackWriter
+from hydragnn_tpu.utils import faults
+from hydragnn_tpu.utils.retry import retry_io
 
 
 class ShardWriter:
@@ -165,13 +167,25 @@ class ShardDataset:
         paths = sorted(glob.glob(os.path.join(label, "shard.*.gpk")))
         if not paths:
             raise FileNotFoundError(f"no GraphPack shards under {label}")
-        self.readers = [PackReader(p, preload=preload) for p in paths]
+        # shared-filesystem opens are the reads most likely to hiccup at
+        # job start (thousands of ranks hitting GPFS/NFS at once) — retry
+        # with jittered backoff instead of dying on a transient EIO
+        self.readers = [
+            retry_io(
+                lambda p=p: PackReader(p, preload=preload), what=p
+            )
+            for p in paths
+        ]
         self._cum = np.cumsum([r.num_samples for r in self.readers])
         meta_path = os.path.join(label, "meta.json")
         self.meta: Dict[str, object] = {}
         if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                self.meta = json.load(f)
+            def _read_meta():
+                faults.flaky_read(meta_path)
+                with open(meta_path) as f:
+                    return json.load(f)
+
+            self.meta = retry_io(_read_meta, what=meta_path)
         self.target_types = list(self.meta.get("target_types", []))
 
         self.subset = None if subset is None else [int(i) for i in subset]
@@ -212,6 +226,12 @@ class ShardDataset:
         return self.readers[shard], local
 
     def get(self, idx: int) -> GraphData:
+        # mmap'd page faults can surface transient OSError on remote
+        # filesystems; one sample read is cheap, so retry the whole thing
+        return retry_io(lambda: self._get_once(idx), what=f"sample {idx}")
+
+    def _get_once(self, idx: int) -> GraphData:
+        faults.flaky_read(f"{self.label}[{idx}]")
         r, i = self._locate(idx)
         d = GraphData()
         d.x = r.read("x", i)

@@ -13,19 +13,41 @@ flax msgpack (framework-neutral, no pickle of code objects). Writes are
 atomic (tmp + rename) so a killed job can't leave a truncated checkpoint
 that parses; loads verify the checksum and fail loudly on corruption.
 Legacy headerless files from earlier rounds still load.
+
+Format v2 (resilience pass) adds two orthogonal pieces:
+
+- an optional ``train_meta`` payload section carrying training-loop state
+  (epoch index, host PRNG key, scheduler/early-stop/best-checkpoint
+  counters, loader epoch) so ``Training.continue`` resumes mid-run at the
+  exact epoch instead of restarting. v1 and legacy files still load — they
+  simply carry no ``train_meta`` and resume falls back to weights-only.
+- rolling keep-last-K retention: each ``save_model`` can also retain the
+  written bytes as an INDEPENDENT ``<name>.roll-<seq>.pk`` file (never a
+  hard link — see ``_retain_rolling``) and prune beyond the retention
+  count.
+  ``load_state_dict`` walks back to the newest intact rolling file when the
+  primary is corrupt, truncated, or missing — a bad byte costs one save
+  interval of progress, not the job.
 """
 
 import binascii
+import glob
 import os
+import re
 import struct
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 from flax import serialization
 
+from hydragnn_tpu.utils import faults
+
 _MAGIC = b"HGTPCKPT"  # 8 bytes; last byte bumps with the format
-_VERSION = 1
+_VERSION = 2  # v2 = v1 + optional "train_meta" payload section
+_ROLL_RE = re.compile(r"\.roll-(\d+)\.pk$")
+
+TRAIN_META_KEY = "train_meta"
 
 
 def _consolidate(leaf):
@@ -60,7 +82,62 @@ def _state_dict(state) -> Dict[str, Any]:
     )
 
 
-def save_model(state_or_dict, name: str, path: str = "./logs/"):
+def _resolve_keep_last(keep_last: Optional[int]) -> int:
+    """Retention policy: explicit argument > ``HYDRAGNN_CKPT_KEEP`` env >
+    0 (no rolling copies — the pre-v2 behavior, and what ad-hoc callers
+    like the unit tests get)."""
+    if keep_last is not None:
+        return max(int(keep_last), 0)
+    return max(int(os.getenv("HYDRAGNN_CKPT_KEEP", "0")), 0)
+
+
+def _rolling_paths(out_dir: str, name: str) -> List[str]:
+    """Rolling files for ``name`` sorted newest (highest seq) first."""
+    paths = glob.glob(os.path.join(out_dir, name + ".roll-*.pk"))
+    with_seq = []
+    for p in paths:
+        m = _ROLL_RE.search(p)
+        if m:
+            with_seq.append((int(m.group(1)), p))
+    return [p for _, p in sorted(with_seq, reverse=True)]
+
+
+def rolling_checkpoints(name: str, path: str = "./logs/") -> List[str]:
+    """Public view of the retained rolling checkpoints, newest first."""
+    return _rolling_paths(os.path.join(path, name), name)
+
+
+def _retain_rolling(out_dir: str, name: str, payload: bytes, keep: int):
+    """Write the save's bytes as an INDEPENDENT rolling file (no hard
+    link: a shared inode would mean corruption of the primary also eats
+    the newest fallback — the exact event the rolling history exists
+    for) and prune past the retention count."""
+    rolls = _rolling_paths(out_dir, name)
+    seq = 0
+    if rolls:
+        seq = int(_ROLL_RE.search(rolls[0]).group(1)) + 1
+    roll = os.path.join(out_dir, f"{name}.roll-{seq:06d}.pk")
+    tmp = roll + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, roll)
+    for old in _rolling_paths(out_dir, name)[keep:]:
+        try:
+            os.remove(old)
+        except OSError:
+            pass  # a vanished/busy old rolling file is not worth a crash
+
+
+def save_model(
+    state_or_dict,
+    name: str,
+    path: str = "./logs/",
+    train_meta: Optional[Dict[str, Any]] = None,
+    keep_last: Optional[int] = None,
+):
+    """Write the checkpoint atomically; optionally embed training-loop
+    state (``train_meta``) and retain a rolling history of the last
+    ``keep_last`` saves (see module docstring)."""
     from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
     _, rank = get_comm_size_and_rank()
@@ -77,6 +154,9 @@ def save_model(state_or_dict, name: str, path: str = "./logs/"):
     os.makedirs(out_dir, exist_ok=True)
     # to_state_dict flattens custom containers (optax states) to plain dicts
     sd = serialization.to_state_dict(sd)
+    if train_meta is not None:
+        sd = dict(sd)
+        sd[TRAIN_META_KEY] = serialization.to_state_dict(train_meta)
     blob = serialization.msgpack_serialize(
         jax.tree_util.tree_map(np.asarray, sd)
     )
@@ -90,13 +170,22 @@ def save_model(state_or_dict, name: str, path: str = "./logs/"):
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, final)  # atomic: never a half-written checkpoint
+    keep = _resolve_keep_last(keep_last)
+    if keep > 0:
+        _retain_rolling(out_dir, name, header + blob, keep)
+    faults.corrupt_checkpoint(final)
 
 
-def load_state_dict(name: str, path: str = "./logs/") -> Dict[str, Any]:
-    fname = os.path.join(path, name, name + ".pk")
-    with open(fname, "rb") as f:
-        raw = f.read()
+def _parse_checkpoint_bytes(raw: bytes, fname: str) -> Dict[str, Any]:
+    """Header/CRC validation + msgpack restore for one checkpoint file's
+    bytes. Raises ``ValueError`` on corruption/truncation (including a
+    truncated legacy blob) and on a from-the-future format version."""
     if raw[: len(_MAGIC)] == _MAGIC:
+        if len(raw) < len(_MAGIC) + 8:
+            raise ValueError(
+                f"checkpoint {fname} is corrupt (truncated inside the "
+                "header)"
+            )
         version, crc = struct.unpack_from("<II", raw, len(_MAGIC))
         if version > _VERSION:
             raise ValueError(
@@ -111,13 +200,70 @@ def load_state_dict(name: str, path: str = "./logs/") -> Dict[str, Any]:
             )
     else:
         blob = raw  # legacy headerless msgpack from earlier rounds
-    return serialization.msgpack_restore(blob)
+    try:
+        return serialization.msgpack_restore(blob)
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {fname} is corrupt (unreadable payload: {e})"
+        ) from e
+
+
+def load_state_dict(
+    name: str, path: str = "./logs/", fallback: bool = True
+) -> Dict[str, Any]:
+    """Load ``<path>/<name>/<name>.pk``. On corruption, truncation, or a
+    missing primary file, walk back to the newest INTACT rolling
+    checkpoint (``fallback=True``, the default) instead of aborting the
+    job; with no intact rolling file the original error propagates. A
+    from-the-future format version is always refused — silently resuming
+    older weights in that situation would not be an accident, it would be
+    a downgrade."""
+    fname = os.path.join(path, name, name + ".pk")
+    try:
+        with open(fname, "rb") as f:
+            raw = f.read()
+        return _parse_checkpoint_bytes(raw, fname)
+    except (ValueError, OSError) as primary_err:
+        is_version_refusal = (
+            isinstance(primary_err, ValueError)
+            and "format version" in str(primary_err)
+        )
+        if not fallback or is_version_refusal:
+            raise
+        for roll in _rolling_paths(os.path.join(path, name), name):
+            try:
+                with open(roll, "rb") as f:
+                    raw = f.read()
+                restored = _parse_checkpoint_bytes(raw, roll)
+            except (ValueError, OSError):
+                continue  # this rolling file is bad too — keep walking
+            import warnings
+
+            warnings.warn(
+                f"checkpoint {fname} unreadable ({primary_err}); restored "
+                f"last-good rolling checkpoint {os.path.basename(roll)}"
+            )
+            return restored
+        raise
+
+
+def pop_train_meta(restored: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Detach the v2 training-loop state from a loaded state dict (v1 and
+    legacy checkpoints return ``None``). Call before ``restore_into`` when
+    resuming; ``restore_into`` also strips the key defensively."""
+    if isinstance(restored, dict):
+        return restored.pop(TRAIN_META_KEY, None)
+    return None
 
 
 def restore_into(template, restored):
     """Re-impose the template pytree structure (opt_state NamedTuples etc.)
     onto the raw msgpack dict — the analog of the reference's DDP "module."
     prefix fixup on old checkpoints (``model.py:109-114``)."""
+    if isinstance(restored, dict) and TRAIN_META_KEY in restored:
+        restored = {
+            k: v for k, v in restored.items() if k != TRAIN_META_KEY
+        }
     return serialization.from_state_dict(template, restored)
 
 

@@ -19,7 +19,9 @@ from hydragnn_tpu.parallel.mesh import default_mesh
 from hydragnn_tpu.train.checkpoint import (
     checkpoint_exists,
     load_state_dict,
+    pop_train_meta,
     restore_into,
+    rolling_checkpoints,
     save_model,
 )
 from hydragnn_tpu.train.trainer import Trainer, train_validate_test
@@ -171,12 +173,22 @@ def run_training_impl(config):
     )
 
     training = config["NeuralNetwork"]["Training"]
+    resume_meta = None
     if "continue" in training and training["continue"]:
         model_name = training.get("startfrom", log_name)
-        if checkpoint_exists(model_name):
-            state = trainer.place_state(
-                restore_into(state, load_state_dict(model_name))
-            )
+        # a lost/deleted primary with intact rolling copies is still
+        # resumable — load_state_dict walks back to the newest good one
+        if checkpoint_exists(model_name) or rolling_checkpoints(model_name):
+            restored = load_state_dict(model_name)
+            # v2 checkpoints carry the training-loop state — honored ONLY
+            # when continuing THIS run (preemption resume). A 'startfrom'
+            # of some other run is a warm start: its epoch counter must
+            # not eat this run's training budget, so the meta is stripped
+            # and training runs from epoch 0 on the restored weights.
+            meta = pop_train_meta(restored)
+            if model_name == log_name:
+                resume_meta = meta
+            state = trainer.place_state(restore_into(state, restored))
 
     writer = _get_summary_writer(log_name)
     vis_cfg = config.get("Visualization", {})
@@ -192,8 +204,17 @@ def run_training_impl(config):
         writer=writer,
         create_plots=vis_cfg.get("create_plots", False),
         plot_init_solution=vis_cfg.get("plot_init_solution", False),
+        resume_meta=resume_meta,
     )
-    save_model(state, log_name)
+    # the epoch driver saves a resumable checkpoint at the final epoch on
+    # its own; repeating the (collective-heavy) consolidation here would
+    # only rewrite identical bytes
+    if not getattr(trainer, "final_state_saved", False):
+        save_model(
+            state,
+            log_name,
+            train_meta=getattr(trainer, "final_train_meta", None),
+        )
     timer.stop()
     print_timers(verbosity)
     tr.save(f"./logs/{log_name}/trace")
@@ -215,8 +236,16 @@ def run_prediction_impl(config):
     model, trainer, state = _build_model_and_trainer(
         config, train_loader, verbosity
     )
-    assert checkpoint_exists(log_name), f"No trained model found: {log_name}"
-    state = trainer.place_state(restore_into(state, load_state_dict(log_name)))
+    # an explicit error, not an assert: asserts vanish under ``python -O``
+    # and a prediction run silently using random weights is the worst
+    # possible failure mode
+    if not checkpoint_exists(log_name):
+        raise FileNotFoundError(f"No trained model found: {log_name}")
+    # fallback=False: rolling last-good recovery is for RESUMING training;
+    # a prediction must never silently report results from older weights
+    state = trainer.place_state(
+        restore_into(state, load_state_dict(log_name, fallback=False))
+    )
 
     error, tasks_error, true_values, predicted_values = trainer.predict(
         state, test_loader

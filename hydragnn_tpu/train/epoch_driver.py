@@ -11,10 +11,11 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from hydragnn_tpu.train.checkpoint import save_model
-from hydragnn_tpu.train.common import TrainState, _env_flag, _is_oom
+from hydragnn_tpu.train.common import SchedState, TrainState, _env_flag, _is_oom
 from hydragnn_tpu.train.optimizer import (
     get_learning_rate,
     set_learning_rate,
@@ -25,6 +26,49 @@ from hydragnn_tpu.train.scheduler import (
     ReduceLROnPlateau,
 )
 from hydragnn_tpu.utils.print_utils import print_distributed
+
+_FIT_SCHED_FIELDS = (
+    "plateau_best",
+    "plateau_bad",
+    "early_best",
+    "early_count",
+    "stopped",
+    "epoch",
+    "best_val",
+)
+
+
+def _build_train_meta(epoch, rng, scheduler, early, ckpt, guard, sched=None):
+    """Checkpoint-v2 training-loop state: everything a preempted job needs
+    to resume at epoch ``epoch + 1`` instead of epoch 0."""
+    meta = {
+        "format": 2,
+        "epoch": int(epoch),
+        "rng": np.asarray(rng),
+        "plateau": scheduler.state_dict(),
+    }
+    if early is not None:
+        meta["early"] = early.state_dict()
+    if ckpt is not None:
+        meta["best_ckpt"] = ckpt.state_dict()
+    if guard is not None:
+        meta["guard"] = guard.state_dict()
+    if sched is not None:
+        # fit_staged's device-resident SchedState, host-ified per field so
+        # a chunked whole-training run resumes at the chunk boundary
+        meta["fit_sched"] = {
+            k: np.asarray(getattr(sched, k)) for k in _FIT_SCHED_FIELDS
+        }
+    return meta
+
+
+def _restore_fit_sched(meta_fit_sched) -> SchedState:
+    return SchedState(
+        **{
+            k: jnp.asarray(np.asarray(meta_fit_sched[k]))
+            for k in _FIT_SCHED_FIELDS
+        }
+    )
 
 
 def train_validate_test(
@@ -39,20 +83,89 @@ def train_validate_test(
     writer=None,
     create_plots: bool = False,
     plot_init_solution: bool = False,
+    resume_meta=None,
+    checkpoint_path: str = "./logs/",
 ):
-    """Epoch driver (``train_validate_test.py:54-250``)."""
+    """Epoch driver (``train_validate_test.py:54-250``).
+
+    ``resume_meta`` is the checkpoint-v2 training-loop state extracted by
+    the caller (``checkpoint.pop_train_meta``): when present the run
+    resumes at the exact saved epoch with the saved PRNG key and
+    scheduler/early-stop/best-checkpoint counters, instead of restarting
+    from epoch 0 with restored weights only.
+    """
     training = config_nn["Training"]
     num_epoch = training["num_epoch"]
     early = EarlyStopping(training.get("patience", 5)) if training.get(
         "EarlyStopping", False
     ) else None
+    # best-validation checkpoints get their OWN file (<name>-best): the
+    # primary <name>.pk is the resumable latest-state checkpoint, and the
+    # two writers must not destroy each other's saves
     ckpt = (
-        BestCheckpoint(log_name, warmup=training.get("checkpoint_warmup", 10))
+        BestCheckpoint(
+            log_name + "-best",
+            warmup=training.get("checkpoint_warmup", 10),
+        )
         if training.get("Checkpoint", False)
         else None
     )
     scheduler = ReduceLROnPlateau(lr=get_learning_rate(state.opt_state))
     rng = jax.random.PRNGKey(1337)
+    guard = getattr(trainer, "guard", None)
+
+    # preemption-resume cadence: save a resumable (weights + loop state)
+    # checkpoint every N epochs (host path) / every chunk (fit path),
+    # keeping the last ``checkpoint_keep_last`` as rolling fallbacks
+    resume_every = int(
+        os.getenv(
+            "HYDRAGNN_RESUME_EVERY", str(training.get("resume_every", 1))
+        )
+    )
+    keep_last = int(
+        os.getenv(
+            "HYDRAGNN_CKPT_KEEP", str(training.get("checkpoint_keep_last", 3))
+        )
+    )
+
+    # the driver's end-of-run save reuses the newest loop state; seed it
+    # with the incoming meta so a continue-of-a-finished-run (no epochs
+    # left) does not strip resume state from the checkpoint.
+    # final_state_saved tracks whether the CURRENT state already sits in
+    # the primary checkpoint — the driver skips its (collective-heavy)
+    # duplicate end-of-run save when it does.
+    trainer.final_train_meta = resume_meta
+    trainer.final_state_saved = False
+    start_epoch = 0
+    if resume_meta:
+        start_epoch = int(resume_meta["epoch"]) + 1
+        if resume_meta.get("rng") is not None:
+            rng = jnp.asarray(np.asarray(resume_meta["rng"]), jnp.uint32)
+        if resume_meta.get("plateau") is not None:
+            scheduler.load_state_dict(resume_meta["plateau"])
+        if early is not None and resume_meta.get("early") is not None:
+            early.load_state_dict(resume_meta["early"])
+        if ckpt is not None and resume_meta.get("best_ckpt") is not None:
+            ckpt.load_state_dict(resume_meta["best_ckpt"])
+        if guard is not None and resume_meta.get("guard") is not None:
+            guard.load_state_dict(resume_meta["guard"])
+        if early is not None and early.early_stop:
+            # the run already stopped; training even one more epoch would
+            # overwrite the checkpoint with post-stop state
+            print_distributed(
+                verbosity,
+                "Resume: early stopping had already triggered — "
+                "nothing left to train",
+            )
+            start_epoch = num_epoch
+        print_distributed(
+            verbosity,
+            f"Resuming training at epoch {start_epoch} "
+            f"(lr {scheduler.lr:.3e})",
+        )
+        # nothing left to train -> the just-restored state IS the
+        # checkpoint content; the driver need not rewrite it
+        trainer.final_state_saved = start_epoch >= num_epoch
 
     visualizer = None
     if create_plots:
@@ -159,8 +272,24 @@ def train_validate_test(
 
         sched = None
         best_state = None
-        best_saved = np.inf
-        epoch0 = 0
+        # honor the best already ON DISK across a resume: without this a
+        # worse post-resume epoch would overwrite the saved best weights
+        best_saved = (
+            float(ckpt.best)
+            if ckpt is not None and ckpt.best is not None
+            else np.inf
+        )
+        epoch0 = start_epoch
+        if resume_meta and resume_meta.get("fit_sched") is not None:
+            sched = _restore_fit_sched(resume_meta["fit_sched"])
+            # best_state reseeds from the RESUME-POINT weights, which did
+            # not achieve the restored best_val — restart best tracking so
+            # those weights are never mislabeled as best
+            sched = sched.replace(
+                best_val=jnp.asarray(jnp.inf, jnp.float32)
+            )
+            if trainer.mesh is not None:
+                sched = jax.tree_util.tree_map(jnp.asarray, sched)
         # full sample->batch reshuffle at chunk boundaries (the staged scan
         # only permutes batch ORDER within a chunk; this restores the
         # reference DistributedSampler's per-epoch sample shuffling at
@@ -168,6 +297,8 @@ def train_validate_test(
         restage = _env_flag(
             "HYDRAGNN_RESTAGE_PER_CHUNK", training, "restage_per_chunk"
         )
+        if guard is not None and guard.last_good is None:
+            guard.commit(state)  # chunk-granular last-good seed
         while epoch0 < num_epoch:
             n = min(fit_chunk, num_epoch - epoch0)
             if restage and epoch0 > 0:
@@ -201,15 +332,63 @@ def train_validate_test(
                     series["test_loss"][i],
                     series["train_tasks"][i],
                 )
+            if guard is not None:
+                # chunk-granular divergence guard: trailing NaN rows with
+                # early-stop NOT fired mean the chunk diverged (stop-skip
+                # rows are NaN by design, so gate on `stopped`). Restore
+                # last-good with halved LR and RETRY the chunk — bounded
+                # by the guard's restore budget — and keep the poisoned
+                # state out of the best/resume checkpoints below.
+                last = series["train_loss"][n - 1]
+                stopped_now = bool(np.asarray(sched.stopped))
+                if not stopped_now and not np.isfinite(last):
+                    print_distributed(
+                        verbosity,
+                        f"Chunk at epoch {epoch0}: non-finite loss — "
+                        "restoring last-good state with halved LR",
+                    )
+                    state = guard.on_bad_epoch(state)
+                    trainer.final_state_saved = False
+                    continue
+                guard.commit(state)
             # persist the best state after every chunk that improved it —
             # a preempted job resumes from the last improvement, like the
             # reference's per-epoch BestCheckpoint (utils/model.py:207-248)
             if ckpt is not None:
                 bv = float(np.asarray(sched.best_val))
                 if np.isfinite(bv) and bv < best_saved:
-                    save_model(best_state, log_name, ckpt.path)
+                    save_model(best_state, ckpt.name, ckpt.path)
                     best_saved = bv
+                    # keep the host-side tracker in sync so the resume
+                    # meta carries the on-disk best across a preemption
+                    ckpt.best = bv
             epoch0 += n
+            # resumable chunk-boundary checkpoint: weights + loop state,
+            # so a preempted whole-training run resumes at this chunk
+            if resume_every > 0:
+                # the host scheduler/early objects never step on the fit
+                # path — mirror the DEVICE state into them so the meta
+                # stays truthful even if the resumed run lands on the
+                # streaming path (e.g. fit_chunk removed from the config)
+                scheduler.lr = float(get_learning_rate(state.opt_state))
+                pb = float(np.asarray(sched.plateau_best))
+                scheduler.best = pb if np.isfinite(pb) else None
+                scheduler.num_bad_epochs = int(np.asarray(sched.plateau_bad))
+                if early is not None:
+                    eb = float(np.asarray(sched.early_best))
+                    early.best = eb if np.isfinite(eb) else None
+                    early.counter = int(np.asarray(sched.early_count))
+                    early.early_stop = bool(np.asarray(sched.stopped))
+                fit_meta = _build_train_meta(
+                    epoch0 - 1, rng, scheduler, early, ckpt, guard,
+                    sched=sched,
+                )
+                save_model(
+                    state, log_name, checkpoint_path,
+                    train_meta=fit_meta, keep_last=keep_last,
+                )
+                trainer.final_train_meta = fit_meta
+                trainer.final_state_saved = True
             if bool(np.asarray(sched.stopped)):
                 ep_stop = epoch0 - n + int(np.argmax(series["stopped"]))
                 print_distributed(
@@ -226,8 +405,15 @@ def train_validate_test(
 
     epoch_time = 0.0
     staged_evals = None
-    for epoch in range(num_epoch if not ran_fit else 0):
+    if guard is not None and guard.last_good is None:
+        # seed last-good with the starting state so a non-finite FIRST
+        # epoch on the staged path is a bounded restore, not an unbounded
+        # silent NaN run (the streaming path seeds inside train_epoch)
+        guard.commit(state)
+    host_epochs = range(start_epoch, num_epoch) if not ran_fit else range(0)
+    for epoch in host_epochs:
         t0 = time.time()
+        trainer.final_state_saved = False  # state is about to change
         train_loader.set_epoch(epoch)
         if staged is not None:
             state, rng, train_loss, train_tasks = trainer.train_epoch_staged(
@@ -281,6 +467,26 @@ def train_validate_test(
             val_loss, val_tasks = trainer.evaluate(state, val_loader)
             test_loss, test_tasks = trainer.evaluate(state, test_loader)
 
+        if guard is not None:
+            if not (np.isfinite(train_loss) and np.isfinite(val_loss)):
+                # the epoch-granular guard: staged/on-device epochs have no
+                # per-step visibility, so a poisoned epoch restores
+                # last-good with halved LR (bounded; guard raises past it)
+                # and its metrics never reach the scheduler
+                print_distributed(
+                    verbosity,
+                    f"Epoch {epoch:04d}: non-finite loss "
+                    f"(train {train_loss}, val {val_loss}) — restoring "
+                    "last-good state with halved LR",
+                )
+                state = guard.on_bad_epoch(state)
+                continue
+            guard.commit(state)
+            # a guard restore halves the LR inside opt_state; resync the
+            # host scheduler so its next step() cannot force the LR back
+            # up to the pre-divergence value
+            scheduler.lr = float(get_learning_rate(state.opt_state))
+
         new_lr = scheduler.step(val_loss)
         if abs(new_lr - get_learning_rate(state.opt_state)) > 1e-12:
             state = state.replace(
@@ -302,7 +508,22 @@ def train_validate_test(
 
         if ckpt is not None:
             ckpt(state, epoch, val_loss, save_model)
-        if early is not None and early(val_loss):
+        stopping = early is not None and early(val_loss)
+        if resume_every > 0 and (
+            (epoch + 1) % resume_every == 0
+            or stopping
+            or epoch == num_epoch - 1
+        ):
+            meta = _build_train_meta(epoch, rng, scheduler, early, ckpt, guard)
+            save_model(
+                state, log_name, checkpoint_path,
+                train_meta=meta, keep_last=keep_last,
+            )
+            # the driver's final save reuses this so a COMPLETED run's
+            # checkpoint still carries loop state (continue = no-op resume)
+            trainer.final_train_meta = meta
+            trainer.final_state_saved = True
+        if stopping:
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break
 
@@ -310,6 +531,18 @@ def train_validate_test(
         from hydragnn_tpu.parallel.distributed import check_remaining
 
         if not check_remaining(epoch_time):
+            # wall-clock preemption is exactly when a resumable checkpoint
+            # matters — save one even off the resume_every cadence
+            if resume_every > 0 and not trainer.final_state_saved:
+                meta = _build_train_meta(
+                    epoch, rng, scheduler, early, ckpt, guard
+                )
+                save_model(
+                    state, log_name, checkpoint_path,
+                    train_meta=meta, keep_last=keep_last,
+                )
+                trainer.final_train_meta = meta
+                trainer.final_state_saved = True
             print_distributed(
                 verbosity, "Stopping: not enough job wall-clock time left"
             )

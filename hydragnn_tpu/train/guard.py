@@ -1,0 +1,149 @@
+"""Divergence guard: survive NaN/Inf steps instead of training on garbage.
+
+One bad batch (exploding gradients, a corrupt sample, an fp overflow) makes
+the loss or gradients non-finite; the optimizer update then poisons every
+parameter and the remaining epochs train on NaNs. The reference framework
+survives this only by operator vigilance; here it is mechanical:
+
+- every guarded optimizer step reports a device-computed ``finite`` scalar
+  (loss AND gradients all finite — wired in ``steps.py`` when
+  ``Training.divergence_guard`` is on);
+- a non-finite step is SKIPPED: the pre-step state snapshot is restored,
+  so the poisoned update never lands;
+- after ``max_bad_steps`` consecutive bad steps the guard restores the
+  last-good state (committed at each finite epoch boundary) with the
+  learning rate halved — the standard divergence response;
+- restores are bounded (``max_restores``); past the bound the guard fails
+  loudly with the full history instead of looping forever.
+
+Costs when enabled: one snapshot copy + one scalar device fetch per step
+(serializes dispatch), and ``steps_per_dispatch`` is forced to 1 so a bad
+step can be isolated. Off by default for exactly that reason; enable with
+``Training.divergence_guard: true`` or ``HYDRAGNN_DIVERGENCE_GUARD=1``.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.train.optimizer import get_learning_rate, set_learning_rate
+
+
+def guard_enabled(training_config: dict) -> bool:
+    from hydragnn_tpu.train.common import _env_flag
+
+    return _env_flag(
+        "HYDRAGNN_DIVERGENCE_GUARD", training_config, "divergence_guard"
+    )
+
+
+class DivergenceGuard:
+    """Host-side guard state for the streaming training loop.
+
+    Knobs (env over config, the framework convention):
+    - ``max_bad_steps`` / ``HYDRAGNN_GUARD_MAX_BAD_STEPS`` (default 3):
+      consecutive non-finite steps tolerated (each skipped) before a
+      last-good restore.
+    - ``max_restores`` / ``HYDRAGNN_GUARD_MAX_RESTORES`` (default 2):
+      restores allowed before failing loudly.
+    """
+
+    def __init__(self, training_config: dict):
+        self.max_bad_steps = int(
+            os.getenv(
+                "HYDRAGNN_GUARD_MAX_BAD_STEPS",
+                str(training_config.get("guard_max_bad_steps", 3)),
+            )
+        )
+        self.max_restores = int(
+            os.getenv(
+                "HYDRAGNN_GUARD_MAX_RESTORES",
+                str(training_config.get("guard_max_restores", 2)),
+            )
+        )
+        self.lr_factor = float(training_config.get("guard_lr_factor", 0.5))
+        self.bad_streak = 0
+        self.skipped = 0
+        self.restores = 0
+        self.last_good = None
+        # one jitted whole-tree copy: the train step DONATES its input
+        # state, so both the per-step snapshot and the last-good state
+        # need their own buffers; eager per-leaf copies would cost a
+        # dispatch per leaf on high-latency backends
+        self._copy = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        )
+
+    def snapshot(self, state):
+        """Pre-step copy — the thing restored when THIS step goes bad."""
+        return self._copy(state)
+
+    def commit(self, state):
+        """Mark ``state`` as last-good (call at finite epoch boundaries).
+        Resets the bad streak: surviving an epoch means the earlier bad
+        steps were transient, not a divergence."""
+        self.last_good = self._copy(state)
+        self.bad_streak = 0
+
+    def on_bad_step(self, prev_state):
+        """A step came back non-finite. Returns the state training must
+        continue from: the pre-step snapshot (skip semantics) or, after
+        ``max_bad_steps`` consecutive bad steps, the last-good state with
+        the LR halved. Raises ``RuntimeError`` past the restore bound."""
+        self.bad_streak += 1
+        self.skipped += 1
+        if self.bad_streak < self.max_bad_steps or self.last_good is None:
+            return prev_state
+        return self._restore()
+
+    def on_bad_epoch(self, fallback_state):
+        """Epoch-granular guard for staged/on-device paths (no per-step
+        visibility there): a non-finite epoch loss restores last-good with
+        halved LR. With nothing committed yet ``fallback_state`` is kept,
+        but still COUNTS against the restore bound — an unbounded silent
+        NaN run must be impossible regardless of call order."""
+        self.skipped += 1
+        if self.last_good is None:
+            self.restores += 1
+            if self.restores > self.max_restores:
+                raise RuntimeError(
+                    "divergence guard: training produced non-finite "
+                    f"losses for {self.restores} epochs with no finite "
+                    "epoch ever committed — the run is broken from the "
+                    "start; inspect the data/LR"
+                )
+            return fallback_state
+        return self._restore()
+
+    def _restore(self):
+        self.restores += 1
+        if self.restores > self.max_restores:
+            raise RuntimeError(
+                f"divergence guard: {self.restores - 1} last-good restores "
+                f"did not stabilize training ({self.skipped} non-finite "
+                "steps skipped) — refusing to keep spending the allocation; "
+                "inspect the data/LR, or raise guard_max_restores"
+            )
+        self.bad_streak = 0
+        restored = self._copy(self.last_good)
+        lr = get_learning_rate(restored.opt_state) * self.lr_factor
+        restored = restored.replace(
+            opt_state=set_learning_rate(restored.opt_state, lr)
+        )
+        # keep halving across successive restores, not oscillating back up
+        self.last_good = self._copy(restored)
+        return restored
+
+    def state_dict(self) -> dict:
+        """Counters only — snapshots are device state and re-form on
+        resume (checkpoint v2 embeds this so a resumed run keeps its
+        restore budget)."""
+        return {
+            "skipped": int(self.skipped),
+            "restores": int(self.restores),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.skipped = int(sd.get("skipped", 0))
+        self.restores = int(sd.get("restores", 0))

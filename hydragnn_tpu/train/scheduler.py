@@ -46,6 +46,22 @@ class ReduceLROnPlateau:
             self.num_bad_epochs = 0
         return self.lr
 
+    def state_dict(self) -> dict:
+        """Mutable counters only (hyperparameters come from the config the
+        resuming run was launched with) — checkpoint format v2 persists
+        this so a preemption-resumed run keeps the plateau history."""
+        return {
+            "lr": float(self.lr),
+            "best": None if self.best is None else float(self.best),
+            "num_bad_epochs": int(self.num_bad_epochs),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.lr = float(sd["lr"])
+        best = sd.get("best")
+        self.best = None if best is None else float(best)
+        self.num_bad_epochs = int(sd["num_bad_epochs"])
+
 
 class EarlyStopping:
     """Stop when validation loss hasn't improved for ``patience`` epochs
@@ -68,6 +84,19 @@ class EarlyStopping:
                 self.early_stop = True
         return self.early_stop
 
+    def state_dict(self) -> dict:
+        return {
+            "best": None if self.best is None else float(self.best),
+            "counter": int(self.counter),
+            "early_stop": bool(self.early_stop),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        best = sd.get("best")
+        self.best = None if best is None else float(best)
+        self.counter = int(sd["counter"])
+        self.early_stop = bool(sd["early_stop"])
+
 
 class BestCheckpoint:
     """Save-on-best-validation with warmup epochs (``utils/model.py:207-248``)."""
@@ -86,3 +115,10 @@ class BestCheckpoint:
             save_fn(state_dict, self.name, self.path)
             return True
         return False
+
+    def state_dict(self) -> dict:
+        return {"best": None if self.best is None else float(self.best)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        best = sd.get("best")
+        self.best = None if best is None else float(best)

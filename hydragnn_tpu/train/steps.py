@@ -51,6 +51,14 @@ def build_steps(model, tx, training_config: dict) -> CompiledSteps:
     # (tests/test_mixed_precision.py) — measure with a true completion
     # fence before enabling (see BASELINE.md measurement note).
     mixed = bool(training_config.get("mixed_precision", False))
+    # divergence guard (train/guard.py): when on, every train step also
+    # reports a device-computed "finite" scalar — loss AND all gradient
+    # leaves finite — so the host can skip a poisoned update without
+    # reading back whole tensors. Compiled in only when enabled: the
+    # reduction over every gradient leaf is not free.
+    from hydragnn_tpu.train.guard import guard_enabled
+
+    guarded = guard_enabled(training_config)
 
     def _cast_bf16(tree):
         return jax.tree_util.tree_map(
@@ -108,6 +116,12 @@ def build_steps(model, tx, training_config: dict) -> CompiledSteps:
             "tasks": jnp.stack(tasks) if tasks else jnp.zeros((0,)),
             "num_graphs": batch.graph_mask.sum(),
         }
+        if guarded:
+            metrics["finite"] = jax.tree_util.tree_reduce(
+                lambda ok, g: ok & jnp.isfinite(g).all(),
+                grads,
+                jnp.isfinite(loss),
+            )
         return new_state, metrics
 
     def eval_step(params, batch_stats, batch):

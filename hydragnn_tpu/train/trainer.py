@@ -93,6 +93,19 @@ class Trainer(PredictMixin):
                 str(training_config.get("device_prefetch", 0)),
             )
         )
+        # divergence guard (train/guard.py): skip non-finite steps, restore
+        # last-good with halved LR after N consecutive bad ones. Opt-in —
+        # it costs a snapshot + a scalar fetch per step.
+        from hydragnn_tpu.train.guard import DivergenceGuard, guard_enabled
+
+        self.guard = (
+            DivergenceGuard(training_config)
+            if guard_enabled(training_config)
+            else None
+        )
+        # process-global optimizer-step counter: drives the fault-injection
+        # hooks (kill_at_step / nan_at_step, utils/faults.py)
+        self._host_step = 0
 
     # compiled-program accessors: tests and the partitioned trainer reach
     # these by their historical names
@@ -605,9 +618,16 @@ class Trainer(PredictMixin):
         return self.put_batch(group[0]), 1
 
     def train_epoch(self, state, loader, rng):
+        from hydragnn_tpu.utils import faults
+
         acc = None
         nbatch = _nbatch(loader)
-        K = max(1, self.steps_per_dispatch)
+        guard = self.guard
+        # the guard must isolate ONE step to skip it; stacked multi-step
+        # dispatches apply K updates atomically, so guarded runs stream
+        K = 1 if guard is not None else max(1, self.steps_per_dispatch)
+        if guard is not None and guard.last_good is None:
+            guard.commit(state)
         tr.start("train")
         plan = self._group_plan(loader, nbatch, K)
         for dev, count in self._prefetch_put(
@@ -620,12 +640,31 @@ class Trainer(PredictMixin):
                 state, metrics = self._train_multi(state, dev, subs[1:])
                 tr.stop("train_step")
                 acc = self._acc_add(acc, metrics, multi=True)
+                first = self._host_step
+                self._host_step += count
+                for s in range(first, self._host_step):
+                    faults.kill_at_step(s)
             else:
+                if faults.nan_at_step(self._host_step):
+                    dev = dev.replace(x=dev.x * jnp.nan)
+                prev = None if guard is None else guard.snapshot(state)
                 rng, sub = jax.random.split(rng)
                 tr.start("train_step")
                 state, metrics = self._train_step(state, dev, sub)
                 tr.stop("train_step")
-                acc = self._acc_add(acc, metrics, multi=False)
+                if guard is not None and not bool(
+                    np.asarray(metrics["finite"])
+                ):
+                    # poisoned update: discard it (or restore last-good
+                    # with halved LR after a streak) and keep the batch's
+                    # metrics out of the epoch average
+                    state = guard.on_bad_step(prev)
+                else:
+                    if guard is not None:
+                        guard.bad_streak = 0
+                    acc = self._acc_add(acc, metrics, multi=False)
+                faults.kill_at_step(self._host_step)
+                self._host_step += 1
         loss, tasks = self._acc_read(acc)  # the epoch's one readback
         tr.stop("train")
         return state, rng, loss, tasks

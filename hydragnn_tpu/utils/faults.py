@@ -1,0 +1,107 @@
+"""Fault-injection harness for resilience testing.
+
+Every injection point is an env/config-driven hook that production code
+calls unconditionally; with no ``HYDRAGNN_FAULT_*`` variable set each hook
+is a cheap no-op, so the harness costs nothing outside tests. The points
+(all consumed by ``tests/test_resilience.py``):
+
+- ``HYDRAGNN_FAULT_KILL_AT_STEP=N`` — hard-kill the process (``os._exit``,
+  no cleanup handlers, the closest userspace analog of a SLURM preemption
+  SIGKILL) when the trainer reaches optimizer step ``N`` (0-based, counted
+  per process).
+- ``HYDRAGNN_FAULT_CORRUPT_CHECKPOINT=K`` — flip one payload byte of the
+  ``K``-th checkpoint file written by this process (1-based; ``all``
+  corrupts every write). Exercises the CRC detection + rolling-fallback
+  path.
+- ``HYDRAGNN_FAULT_FLAKY_READ=N`` — the first ``N`` dataset reads that
+  pass through a flaky-read checkpoint raise ``OSError`` (then reads
+  succeed). Exercises the retry-with-jittered-backoff wrappers.
+- ``HYDRAGNN_FAULT_NAN_AT_STEP=SPEC`` — poison the training batch with
+  NaNs at the optimizer steps named by ``SPEC`` (``"3"``, ``"3,5,9"`` or
+  ``"4:9"`` half-open range). Exercises the divergence guard.
+
+Counters are process-global and monotonic; :func:`reset` exists for tests
+that exercise several scenarios in one process.
+"""
+
+import os
+import threading
+
+_lock = threading.Lock()
+_counters = {"ckpt_writes": 0, "flaky_reads": 0}
+
+KILL_EXIT_CODE = 113  # distinctive, checked by the kill-and-resume e2e test
+
+
+def reset():
+    """Zero the process-global injection counters (test helper)."""
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+def _parse_step_spec(spec: str):
+    """``"3"`` / ``"3,5"`` / ``"4:9"`` -> membership predicate over ints."""
+    spec = spec.strip()
+    if not spec:
+        return lambda step: False
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        lo = int(lo) if lo else 0
+        hi = int(hi) if hi else None
+        return lambda step: step >= lo and (hi is None or step < hi)
+    members = {int(p) for p in spec.split(",") if p.strip()}
+    return lambda step: step in members
+
+
+def kill_at_step(step: int) -> None:
+    """Preemption injection: hard-exit when ``step`` hits the configured
+    value. ``os._exit`` skips atexit/finally on purpose — a preempted job
+    gets no goodbye either; only already-fsynced checkpoints survive."""
+    spec = os.getenv("HYDRAGNN_FAULT_KILL_AT_STEP")
+    if spec is None:
+        return
+    if int(spec) == int(step):
+        os._exit(KILL_EXIT_CODE)
+
+
+def nan_at_step(step: int) -> bool:
+    """True when the divergence-guard NaN injection covers ``step``."""
+    spec = os.getenv("HYDRAGNN_FAULT_NAN_AT_STEP")
+    if spec is None:
+        return False
+    return _parse_step_spec(spec)(int(step))
+
+
+def corrupt_checkpoint(path: str) -> None:
+    """Post-write corruption injection: called by ``save_model`` with the
+    final checkpoint path after the atomic rename; flips one byte in the
+    middle of the file when this write's ordinal is selected."""
+    spec = os.getenv("HYDRAGNN_FAULT_CORRUPT_CHECKPOINT")
+    if spec is None:
+        return
+    with _lock:
+        _counters["ckpt_writes"] += 1
+        ordinal = _counters["ckpt_writes"]
+    if spec != "all" and int(spec) != ordinal:
+        return
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def flaky_read(what: str = "") -> None:
+    """Transient-I/O injection: raise ``OSError`` for the first ``N``
+    reads that reach any flaky-read checkpoint, then behave."""
+    spec = os.getenv("HYDRAGNN_FAULT_FLAKY_READ")
+    if spec is None:
+        return
+    with _lock:
+        if _counters["flaky_reads"] >= int(spec):
+            return
+        _counters["flaky_reads"] += 1
+    raise OSError(f"injected transient read failure ({what or 'read'})")

@@ -1,0 +1,44 @@
+"""Retry-with-jittered-backoff for transient I/O.
+
+Long runs on shared parallel filesystems (the reference's Summit/Frontier
+GPFS, or NFS-mounted TPU-VM pods) see sporadic ``OSError``/``IOError``
+from reads that succeed on the next attempt. :func:`retry_io` wraps one
+read with bounded exponential backoff plus jitter (decorrelates the retry
+stampede when every data-loader worker hits the same hiccup at once).
+
+Knobs (env overrides argument defaults):
+- ``HYDRAGNN_IO_RETRIES``       total attempts, default 3 (1 = no retry)
+- ``HYDRAGNN_IO_RETRY_BASE_S``  first backoff delay seconds, default 0.05
+
+Only ``OSError`` (and subclasses: ``FileNotFoundError`` excluded — a
+missing file is not transient) is retried; everything else propagates
+immediately.
+"""
+
+import os
+import random
+import time
+
+
+def retry_io(fn, *, what: str = "", attempts=None, base_delay=None):
+    """Call ``fn()``; on transient ``OSError`` retry with exponential
+    backoff + uniform jitter. Re-raises the last error once attempts are
+    exhausted."""
+    if attempts is None:
+        attempts = int(os.getenv("HYDRAGNN_IO_RETRIES", "3"))
+    if base_delay is None:
+        base_delay = float(os.getenv("HYDRAGNN_IO_RETRY_BASE_S", "0.05"))
+    attempts = max(int(attempts), 1)
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except FileNotFoundError:
+            raise  # not transient: retrying a wrong path only adds latency
+        except OSError as e:
+            last = e
+            if i == attempts - 1:
+                break
+            delay = base_delay * (2.0 ** i) * (1.0 + random.uniform(0.0, 0.5))
+            time.sleep(delay)
+    raise last

@@ -1,0 +1,173 @@
+"""Worker for the kill-and-resume resilience e2e test (NOT a pytest module).
+
+Runs a small deterministic training through the REAL epoch driver
+(``train_validate_test``) with per-epoch resumable checkpoints, under
+whatever ``HYDRAGNN_FAULT_*`` injection the parent test set. Three modes:
+
+    python _resilience_worker.py <workdir> run      # fresh run
+    python _resilience_worker.py <workdir> resume   # Training.continue path
+
+The worker chdirs into ``workdir`` so checkpoints land under
+``<workdir>/logs/``; at clean exit it dumps ``result.json`` with the
+run's observable trajectory so the parent can compare killed+resumed
+against uninterrupted. A run killed by ``HYDRAGNN_FAULT_KILL_AT_STEP``
+exits hard (os._exit) and leaves no result.json — only the fsync'd
+checkpoints.
+"""
+
+import json
+import os
+import sys
+
+# the container pins JAX_PLATFORMS at interpreter startup; force CPU the
+# same way conftest.py does
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1").strip(),
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+NUM_EPOCH = 5
+LOG_NAME = "resil"
+
+
+def make_samples(num=24, seed=11):
+    from hydragnn_tpu.data.dataobj import GraphData
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        n = 6
+        g = GraphData()
+        g.x = rng.random((n, 1)).astype(np.float32)
+        g.pos = rng.random((n, 3)).astype(np.float32)
+        src = np.arange(n)
+        dst = (src + 1) % n
+        g.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        g.edge_attr = None
+        # closed-form targets: graph sum + identity node head
+        g.targets = [np.array([g.x.sum()], np.float32), g.x.copy()]
+        g.target_types = ["graph", "node"]
+        out.append(g)
+    return out
+
+
+def build():
+    from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+                "type": "mlp",
+            },
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {
+        "num_epoch": NUM_EPOCH,
+        "perc_train": 0.7,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "resume_every": 1,
+        "checkpoint_keep_last": 4,
+    }
+    samples = make_samples()
+    layout = compute_layout([samples], batch_size=4, need_triplets=False)
+    train_loader = GraphLoader(samples[:16], 4, layout, shuffle=True, seed=7)
+    val_loader = GraphLoader(samples[16:20], 4, layout, shuffle=False)
+    test_loader = GraphLoader(samples[20:], 4, layout, shuffle=False)
+    model = create_model_config(arch)
+    trainer = Trainer(model, training)
+    state = trainer.init_state(next(iter(train_loader)), seed=0)
+    return trainer, state, (train_loader, val_loader, test_loader), training
+
+
+def main():
+    workdir, mode = sys.argv[1], sys.argv[2]
+    os.chdir(workdir)
+
+    from hydragnn_tpu.train.checkpoint import (
+        checkpoint_exists,
+        load_state_dict,
+        pop_train_meta,
+        restore_into,
+    )
+    from hydragnn_tpu.train.epoch_driver import train_validate_test
+
+    trainer, state, loaders, training = build()
+
+    resume_meta = None
+    if mode == "resume":
+        if not checkpoint_exists(LOG_NAME):
+            raise FileNotFoundError("resume requested but no checkpoint")
+        restored = load_state_dict(LOG_NAME)
+        resume_meta = pop_train_meta(restored)
+        state = trainer.place_state(restore_into(state, restored))
+
+    # count the epochs THIS process actually trains (the resumed run must
+    # run only the remaining ones)
+    epochs_run = []
+    orig = trainer.train_epoch
+
+    def counting_train_epoch(state, loader, rng):
+        epochs_run.append(loader.epoch)
+        return orig(state, loader, rng)
+
+    trainer.train_epoch = counting_train_epoch
+
+    config_nn = {
+        "Training": training,
+        "Variables_of_interest": {"output_names": ["sum", "x"]},
+    }
+    state = train_validate_test(
+        trainer, state, *loaders, config_nn, LOG_NAME, verbosity=0,
+        resume_meta=resume_meta,
+    )
+
+    from hydragnn_tpu.train.optimizer import get_learning_rate
+
+    final = {
+        "mode": mode,
+        "resumed_from_epoch": (
+            None if resume_meta is None else int(resume_meta["epoch"]) + 1
+        ),
+        "epochs_run": epochs_run,
+        "final_lr": get_learning_rate(state.opt_state),
+        "final_params_digest": [
+            float(np.asarray(leaf, np.float64).sum())
+            for leaf in jax.tree_util.tree_leaves(
+                jax.device_get(state.params)
+            )
+        ],
+    }
+    with open("result.json", "w") as f:
+        json.dump(final, f)
+
+
+if __name__ == "__main__":
+    main()

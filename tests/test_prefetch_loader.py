@@ -58,6 +58,87 @@ def pytest_prefetch_propagates_errors():
         list(loader)
 
 
+def pytest_worker_error_surfaces_with_full_queue():
+    """A worker exception must reach the consumer even when the bounded
+    queue is FULL at failure time (the sentinel put must not wedge), and
+    the worker thread must be reaped."""
+    import threading
+    import time
+
+    from hydragnn_tpu.data.loaders import prefetch_iter
+
+    def source():
+        for i in range(50):  # far more items than the queue can hold
+            yield i
+            if i == 5:
+                raise OSError("boom mid-stream")
+
+    before = {t.name for t in threading.enumerate()}
+    got = []
+    with pytest.raises(OSError, match="boom"):
+        it = prefetch_iter(source(), depth=2, name="errq-test")
+        time.sleep(0.2)  # let the worker fill the queue and then die
+        for item in it:
+            got.append(item)
+    assert got == list(range(6))  # everything before the failure arrived
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [
+            t for t in threading.enumerate()
+            if t.name.startswith("errq-test") and t.name not in before
+        ]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"prefetch worker thread leaked: {leaked}"
+
+
+def pytest_abandoned_consumer_does_not_wedge_worker():
+    """Early consumer exit (break) with a full queue: the stop-aware puts
+    must let the worker shut down instead of blocking forever."""
+    import threading
+    import time
+
+    from hydragnn_tpu.data.loaders import prefetch_iter
+
+    produced = []
+
+    def source():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    it = prefetch_iter(source(), depth=1, name="abandon-test")
+    assert next(it) == 0
+    it.close()  # abandon: generator finally -> stop.set() + join
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        alive = [
+            t for t in threading.enumerate()
+            if t.name.startswith("abandon-test")
+        ]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, "worker still running after consumer abandoned"
+    assert len(produced) < 1000  # it stopped early, not after draining all
+
+
+def pytest_loader_prefetch_error_with_deep_queue():
+    """GraphLoader integration: a poisoned sample mid-dataset with a
+    prefetch depth smaller than the remaining batches surfaces the
+    collation error and the loader remains reusable afterwards."""
+    ds = _dataset(24)
+    layout = compute_layout([ds], batch_size=2, need_triplets=False)
+    loader = GraphLoader(ds, 2, layout, shuffle=False, prefetch=2)
+    poisoned = ds[9]
+    ds[9] = None
+    with pytest.raises(Exception):
+        list(loader)
+    ds[9] = poisoned  # heal: the same loader must iterate cleanly again
+    assert len(list(loader)) == len(loader)
+
+
 def pytest_multi_worker_matches_sync(monkeypatch):
     """HYDRAGNN_NUM_WORKERS > 1 (the reference HydraDataLoader's worker
     pool, ``load_data.py:94-204``) must be order- and content-identical

@@ -1,0 +1,513 @@
+"""Fault-tolerant training: preemption resume, rolling last-good
+checkpoints, divergence guard, retry-on-flaky-read — all proven with
+injected faults (``hydragnn_tpu/utils/faults.py``), not hope.
+
+The e2e piece runs train -> SIGKILL-equivalent (``os._exit`` via
+``HYDRAGNN_FAULT_KILL_AT_STEP``) -> resume in subprocesses through the
+real epoch driver and asserts the resumed trajectory matches the
+uninterrupted one exactly at the resume point AND at the end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+
+from hydragnn_tpu.train import checkpoint as ck
+from hydragnn_tpu.train.checkpoint import (
+    load_state_dict,
+    pop_train_meta,
+    rolling_checkpoints,
+    save_model,
+)
+from hydragnn_tpu.train.scheduler import (
+    BestCheckpoint,
+    EarlyStopping,
+    ReduceLROnPlateau,
+)
+from hydragnn_tpu.utils import faults
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _resilience_worker import make_samples  # noqa: E402
+
+FAST = int(os.getenv("HYDRAGNN_FAST_TEST", "0")) == 1
+
+
+def _state_dict_fixture(step=5):
+    return {
+        "params": {"w": np.arange(4, dtype=np.float32)},
+        "batch_stats": {},
+        "opt_state": {},
+        "step": np.int32(step),
+    }
+
+
+# ---- scheduler state round trips (v2-resume prerequisite) ----------------
+
+
+def pytest_plateau_scheduler_state_roundtrip():
+    a = ReduceLROnPlateau(lr=1e-3, patience=1)
+    for v in [1.0, 1.1, 1.2, 1.3]:
+        a.step(v)
+    b = ReduceLROnPlateau(lr=1e-3, patience=1)
+    b.load_state_dict(a.state_dict())
+    assert (b.lr, b.best, b.num_bad_epochs) == (a.lr, a.best, a.num_bad_epochs)
+    # continued stepping must stay in lockstep
+    for v in [1.4, 1.5, 0.1, 0.2]:
+        assert a.step(v) == b.step(v)
+    assert a.num_bad_epochs == b.num_bad_epochs
+
+
+def pytest_early_stopping_state_roundtrip():
+    a = EarlyStopping(patience=3)
+    for v in [1.0, 1.1, 1.2]:
+        a(v)
+    b = EarlyStopping(patience=3)
+    b.load_state_dict(a.state_dict())
+    assert (b.best, b.counter, b.early_stop) == (a.best, a.counter, a.early_stop)
+    assert a(1.3) == b(1.3)  # the next bad epoch trips both identically
+    assert a.early_stop == b.early_stop
+
+
+def pytest_best_checkpoint_state_roundtrip():
+    saves = []
+    a = BestCheckpoint("x", warmup=0)
+    a({}, 0, 1.0, lambda *args: saves.append(args))
+    b = BestCheckpoint("x", warmup=0)
+    b.load_state_dict(a.state_dict())
+    assert b.best == a.best == 1.0
+    # a worse loss does not save, a better one does
+    assert not b({}, 1, 2.0, lambda *args: saves.append(args))
+    assert b({}, 2, 0.5, lambda *args: saves.append(args))
+
+
+def pytest_fresh_state_dicts_roundtrip_none_best():
+    for cls in (lambda: ReduceLROnPlateau(lr=1e-3), EarlyStopping):
+        a = cls()
+        b = cls()
+        b.load_state_dict(a.state_dict())
+        assert b.best is None
+
+
+# ---- checkpoint format v2 ------------------------------------------------
+
+
+def pytest_v2_train_meta_roundtrip():
+    meta = {
+        "format": 2,
+        "epoch": 7,
+        "rng": np.asarray(jax.random.PRNGKey(42)),
+        "plateau": {"lr": 5e-4, "best": 0.25, "num_bad_epochs": 2},
+        "early": {"best": 0.25, "counter": 1, "early_stop": False},
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(_state_dict_fixture(), "m", path=tmp, train_meta=meta)
+        restored = load_state_dict("m", path=tmp)
+        got = pop_train_meta(restored)
+        assert "train_meta" not in restored  # detached for restore_into
+        assert int(got["epoch"]) == 7
+        np.testing.assert_array_equal(
+            np.asarray(got["rng"]), np.asarray(jax.random.PRNGKey(42))
+        )
+        assert float(got["plateau"]["lr"]) == 5e-4
+        assert int(got["early"]["counter"]) == 1
+        sched = ReduceLROnPlateau(lr=1.0)
+        sched.load_state_dict(got["plateau"])
+        assert sched.lr == 5e-4 and sched.num_bad_epochs == 2
+
+
+def pytest_v1_and_legacy_checkpoints_still_load():
+    """A v1 (headered, no train_meta) file and a legacy headerless blob
+    both load byte-identically; resume metadata is simply absent."""
+    import binascii
+    import struct
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sd = _state_dict_fixture()
+        save_model(dict(sd), "m", path=tmp)  # no meta
+        fname = os.path.join(tmp, "m", "m.pk")
+        raw = open(fname, "rb").read()
+        blob = raw[16:]
+
+        # rewrite as format version 1 (what pre-resilience builds wrote)
+        v1 = ck._MAGIC + struct.pack(
+            "<II", 1, binascii.crc32(blob) & 0xFFFFFFFF
+        ) + blob
+        open(fname, "wb").write(v1)
+        r1 = load_state_dict("m", path=tmp)
+        assert pop_train_meta(r1) is None
+        np.testing.assert_array_equal(r1["params"]["w"], sd["params"]["w"])
+        assert int(r1["step"]) == 5
+
+        # legacy headerless msgpack
+        open(fname, "wb").write(blob)
+        r0 = load_state_dict("m", path=tmp)
+        assert pop_train_meta(r0) is None
+        np.testing.assert_array_equal(r0["params"]["w"], sd["params"]["w"])
+
+
+# ---- rolling retention + last-good fallback ------------------------------
+
+
+def pytest_rolling_retention_prunes_to_keep_last():
+    with tempfile.TemporaryDirectory() as tmp:
+        for ep in range(5):
+            save_model(
+                _state_dict_fixture(ep), "m", path=tmp,
+                train_meta={"epoch": ep}, keep_last=2,
+            )
+        rolls = rolling_checkpoints("m", path=tmp)
+        assert len(rolls) == 2
+        # newest first, carrying the two most recent epochs
+        metas = [
+            int(pop_train_meta(ck._parse_checkpoint_bytes(
+                open(p, "rb").read(), p
+            ))["epoch"])
+            for p in rolls
+        ]
+        assert metas == [4, 3]
+
+
+def pytest_corrupt_primary_falls_back_to_last_good():
+    with tempfile.TemporaryDirectory() as tmp:
+        for ep in range(3):
+            save_model(
+                _state_dict_fixture(ep), "m", path=tmp,
+                train_meta={"epoch": ep}, keep_last=3,
+            )
+        fname = os.path.join(tmp, "m", "m.pk")
+        raw = bytearray(open(fname, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF  # bit corruption of the primary
+        open(fname, "wb").write(bytes(raw))
+        # rolling copies are INDEPENDENT bytes (not hard links), so the
+        # newest one still holds the corrupted save's content intact —
+        # zero progress lost
+        with pytest.warns(UserWarning, match="last-good"):
+            restored = load_state_dict("m", path=tmp)
+        assert int(pop_train_meta(restored)["epoch"]) == 2
+        assert int(restored["step"]) == 2
+
+        # strict mode (fallback off) still fails loudly
+        with pytest.raises(ValueError, match="corrupt"):
+            load_state_dict("m", path=tmp, fallback=False)
+
+
+def pytest_truncated_primary_falls_back():
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(_state_dict_fixture(0), "m", path=tmp,
+                   train_meta={"epoch": 0}, keep_last=3)
+        save_model(_state_dict_fixture(1), "m", path=tmp,
+                   train_meta={"epoch": 1}, keep_last=3)
+        fname = os.path.join(tmp, "m", "m.pk")
+        raw = open(fname, "rb").read()
+        open(fname, "wb").write(raw[: len(raw) // 3])  # torn write
+        with pytest.warns(UserWarning, match="last-good"):
+            restored = load_state_dict("m", path=tmp)
+        assert int(pop_train_meta(restored)["epoch"]) == 1
+
+        # truncation INSIDE the 16-byte header must also fall back, not
+        # escape as a struct error
+        open(fname, "wb").write(raw[:12])
+        with pytest.warns(UserWarning, match="last-good"):
+            restored = load_state_dict("m", path=tmp)
+        assert int(pop_train_meta(restored)["epoch"]) == 1
+
+
+def pytest_all_copies_corrupt_raises():
+    with tempfile.TemporaryDirectory() as tmp:
+        for ep in range(2):
+            save_model(_state_dict_fixture(ep), "m", path=tmp,
+                       train_meta={"epoch": ep}, keep_last=2)
+        targets = [os.path.join(tmp, "m", "m.pk")] + rolling_checkpoints(
+            "m", path=tmp
+        )
+        for i, p in enumerate(targets):
+            b = bytearray(open(p, "rb").read())
+            b[20 + i] ^= 0xFF
+            open(p, "wb").write(bytes(b))
+        with pytest.raises(ValueError, match="corrupt"):
+            load_state_dict("m", path=tmp)
+
+
+def pytest_corrupt_checkpoint_injection(monkeypatch):
+    """The ``HYDRAGNN_FAULT_CORRUPT_CHECKPOINT`` injection point: the
+    selected save's primary is corrupted post-write; detection + fallback
+    recover the same save's independent rolling copy."""
+    faults.reset()
+    monkeypatch.setenv("HYDRAGNN_FAULT_CORRUPT_CHECKPOINT", "2")
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(_state_dict_fixture(0), "m", path=tmp,
+                   train_meta={"epoch": 0}, keep_last=3)
+        save_model(_state_dict_fixture(1), "m", path=tmp,
+                   train_meta={"epoch": 1}, keep_last=3)  # primary corrupted
+        with pytest.warns(UserWarning, match="last-good"):
+            restored = load_state_dict("m", path=tmp)
+        assert int(pop_train_meta(restored)["epoch"]) == 1
+    faults.reset()
+
+
+# ---- retry with jittered backoff on flaky reads --------------------------
+
+
+def pytest_flaky_shard_reads_are_retried(monkeypatch):
+    from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
+
+    samples = make_samples(4)
+    with tempfile.TemporaryDirectory() as tmp:
+        label = os.path.join(tmp, "trainset")
+        w = ShardWriter(label)
+        w.add(samples)
+        w.save()
+        monkeypatch.setenv("HYDRAGNN_IO_RETRY_BASE_S", "0.001")
+        monkeypatch.setenv("HYDRAGNN_FAULT_FLAKY_READ", "2")
+        faults.reset()
+        ds = ShardDataset(label)  # meta read retries through the failures
+        got = ds[2]
+        np.testing.assert_allclose(np.asarray(got.x), samples[2].x)
+        faults.reset()
+
+
+def pytest_flaky_pickle_reads_are_retried(monkeypatch):
+    from hydragnn_tpu.data.pickledataset import (
+        SimplePickleDataset,
+        SimplePickleWriter,
+    )
+
+    samples = make_samples(3)
+    with tempfile.TemporaryDirectory() as tmp:
+        SimplePickleWriter(list(samples), tmp, label="t")
+        monkeypatch.setenv("HYDRAGNN_IO_RETRY_BASE_S", "0.001")
+        monkeypatch.setenv("HYDRAGNN_FAULT_FLAKY_READ", "2")
+        faults.reset()
+        ds = SimplePickleDataset(tmp, label="t")
+        got = ds[1]
+        np.testing.assert_allclose(np.asarray(got.x), samples[1].x)
+        faults.reset()
+
+
+def pytest_retry_gives_up_after_budget(monkeypatch):
+    from hydragnn_tpu.utils.retry import retry_io
+
+    monkeypatch.setenv("HYDRAGNN_FAULT_FLAKY_READ", "10")
+    faults.reset()
+    attempts = []
+
+    def read():
+        attempts.append(1)
+        faults.flaky_read("t")
+        return 1
+
+    with pytest.raises(OSError, match="injected"):
+        retry_io(read, attempts=3, base_delay=0.001)
+    assert len(attempts) == 3  # bounded, not infinite
+    faults.reset()
+
+
+def pytest_missing_file_is_not_retried():
+    from hydragnn_tpu.utils.retry import retry_io
+
+    attempts = []
+
+    def read():
+        attempts.append(1)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        retry_io(read, attempts=5, base_delay=0.001)
+    assert len(attempts) == 1  # a wrong path is not transient
+
+
+# ---- divergence guard ----------------------------------------------------
+
+
+def _tiny_trainer(training_extra=None):
+    from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+                "type": "mlp",
+            },
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+    training.update(training_extra or {})
+    samples = make_samples(16)
+    layout = compute_layout([samples], batch_size=4, need_triplets=False)
+    loader = GraphLoader(samples, 4, layout, shuffle=False)
+    trainer = Trainer(create_model_config(arch), training)
+    state = trainer.init_state(next(iter(loader)), seed=0)
+    return trainer, state, loader
+
+
+def pytest_nan_step_is_skipped_and_training_converges(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_DIVERGENCE_GUARD", "1")
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_AT_STEP", "2")
+    trainer, state, loader = _tiny_trainer()
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for epoch in range(4):
+        loader.set_epoch(epoch)
+        state, rng, loss, _ = trainer.train_epoch(state, loader, rng)
+        losses.append(loss)
+    assert trainer.guard.skipped == 1 and trainer.guard.restores == 0
+    assert all(np.isfinite(l) for l in losses)
+    # params stayed finite and training still converges on the synthetic set
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert losses[-1] < losses[0]
+
+
+def pytest_consecutive_bad_steps_restore_with_halved_lr(monkeypatch):
+    from hydragnn_tpu.train.optimizer import get_learning_rate
+
+    monkeypatch.setenv("HYDRAGNN_DIVERGENCE_GUARD", "1")
+    # guard_max_bad_steps default 3: steps 0-2 poisoned -> one restore
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_AT_STEP", "0:3")
+    trainer, state, loader = _tiny_trainer()
+    state, _, loss, _ = trainer.train_epoch(
+        state, loader, jax.random.PRNGKey(0)
+    )
+    assert trainer.guard.skipped == 3
+    assert trainer.guard.restores == 1
+    assert abs(get_learning_rate(state.opt_state) - 5e-3) < 1e-9
+    assert np.isfinite(loss)  # the post-restore steps trained normally
+
+
+def pytest_unbounded_divergence_fails_loudly(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_DIVERGENCE_GUARD", "1")
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_AT_STEP", "0:")  # every step
+    monkeypatch.setenv("HYDRAGNN_GUARD_MAX_RESTORES", "1")
+    trainer, state, loader = _tiny_trainer()
+    rng = jax.random.PRNGKey(0)
+    with pytest.raises(RuntimeError, match="divergence guard"):
+        for epoch in range(4):
+            loader.set_epoch(epoch)
+            state, rng, *_ = trainer.train_epoch(state, loader, rng)
+
+
+def pytest_guard_off_means_no_finite_metric():
+    """Without the guard the compiled step must NOT pay for the all-grads
+    finiteness reduction."""
+    trainer, state, loader = _tiny_trainer()
+    batch = trainer.put_batch(next(iter(loader)))
+    _, metrics = trainer._train_step(state, batch, jax.random.PRNGKey(0))
+    assert "finite" not in metrics
+    assert trainer.guard is None
+
+
+# ---- kill -> resume e2e --------------------------------------------------
+
+
+def _run_worker(workdir, mode, extra_env=None):
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("HYDRAGNN_FAULT_", "HYDRAGNN_RESUME",
+                             "HYDRAGNN_CKPT_", "HYDRAGNN_GUARD_"))
+    }
+    env.update(extra_env or {})
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "_resilience_worker.py"
+    )
+    return subprocess.run(
+        [sys.executable, worker, workdir, mode],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+
+
+def _meta_of(path_pk):
+    return pop_train_meta(
+        ck._parse_checkpoint_bytes(open(path_pk, "rb").read(), path_pk)
+    )
+
+
+@pytest.mark.skipif(FAST, reason="subprocess e2e — full tier only")
+def pytest_kill_and_resume_matches_uninterrupted_run():
+    """Preemption e2e: a run hard-killed mid-epoch-2 resumes from the
+    epoch-1 checkpoint, trains ONLY the remaining epochs, and lands on the
+    uninterrupted run's exact trajectory — restored epoch, LR and
+    scheduler counters match at the resume point, final parameters match
+    at the end."""
+    with tempfile.TemporaryDirectory() as killdir, \
+            tempfile.TemporaryDirectory() as refdir:
+        # uninterrupted reference (same seeds, same data)
+        ref = _run_worker(refdir, "run")
+        assert ref.returncode == 0, ref.stderr[-2000:]
+
+        # 4 steps/epoch; killing at step 9 is mid-epoch-2 — epochs 0 and 1
+        # are checkpointed, epoch 2's partial progress is lost by design
+        killed = _run_worker(
+            killdir, "run", {"HYDRAGNN_FAULT_KILL_AT_STEP": "9"}
+        )
+        assert killed.returncode == faults.KILL_EXIT_CODE, (
+            killed.returncode, killed.stderr[-2000:]
+        )
+        assert not os.path.exists(os.path.join(killdir, "result.json"))
+
+        # the surviving checkpoint is epoch 1, with loop state
+        kmeta = _meta_of(os.path.join(killdir, "logs", "resil", "resil.pk"))
+        assert int(kmeta["epoch"]) == 1
+
+        # ...and it matches the uninterrupted run's state at that epoch
+        # (recorded in its rolling history)
+        ref_roll = {
+            int(_meta_of(p)["epoch"]): p
+            for p in rolling_checkpoints(
+                "resil", path=os.path.join(refdir, "logs")
+            )
+        }
+        rmeta = _meta_of(ref_roll[1])
+        np.testing.assert_array_equal(
+            np.asarray(kmeta["rng"]), np.asarray(rmeta["rng"])
+        )
+        for key in ("lr", "best", "num_bad_epochs"):
+            assert float(kmeta["plateau"][key]) == float(
+                rmeta["plateau"][key]
+            ), key
+
+        resumed = _run_worker(killdir, "resume")
+        assert resumed.returncode == 0, resumed.stderr[-2000:]
+        got = json.load(open(os.path.join(killdir, "result.json")))
+        ref_res = json.load(open(os.path.join(refdir, "result.json")))
+
+        # resumed at the exact epoch; trained the REMAINING epochs only
+        assert got["resumed_from_epoch"] == 2
+        assert got["epochs_run"] == [2, 3, 4]
+        assert ref_res["epochs_run"] == [0, 1, 2, 3, 4]
+
+        # ...onto the identical trajectory
+        assert got["final_lr"] == ref_res["final_lr"]
+        np.testing.assert_allclose(
+            got["final_params_digest"],
+            ref_res["final_params_digest"],
+            rtol=0,
+            atol=1e-7,
+        )
