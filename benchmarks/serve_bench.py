@@ -1,0 +1,158 @@
+"""Serving A/B: micro-batched bucket-compiled server vs naive
+per-request predict (ISSUE 2 acceptance artifact).
+
+Drives the in-process :class:`~hydragnn_tpu.serve.InferenceServer` with
+concurrent mixed-size requests (OC20-shaped log-normal sizes, the
+distribution the bucketed-layout work measured) and reports p50/p99
+request latency and sustained throughput against the naive baseline —
+one padded single-graph batch per request, dispatched synchronously,
+which is what calling the offline predict path per request would cost.
+
+Usage: ``python benchmarks/serve_bench.py [--num=512] [--clients=8]
+[--buckets=3] [--batch=8] [--hidden=64] [--wait-ms=5]``
+
+Output: one JSON object per configuration (the BENCH_* line style).
+"""
+
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.model_bench import _arg, _arch  # noqa: E402
+
+
+def _oc20_requests(num, seed=0, degree=8):
+    from hydragnn_tpu.data.dataobj import GraphData
+
+    rng = np.random.default_rng(seed)
+    sizes = np.clip(
+        np.round(np.exp(rng.normal(np.log(60.0), 0.55, num))), 20, 250
+    ).astype(int)
+    out = []
+    for n in sizes:
+        d = GraphData(
+            x=rng.random((int(n), 1)).astype(np.float32),
+            pos=(rng.random((int(n), 3)) * n ** (1 / 3)).astype(np.float32),
+        )
+        src = np.repeat(np.arange(n), degree // 2)
+        dst = (src + rng.integers(1, n, src.shape[0])) % n
+        d.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        out.append(d)
+    return out
+
+
+def _build(requests, hidden, batch, buckets):
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.serve import ModelRegistry, plan_from_samples
+    from hydragnn_tpu.train.trainer import Trainer
+
+    plan = plan_from_samples(
+        requests, max_batch_graphs=batch, num_buckets=buckets
+    )
+    model = create_model_config(_arch("SAGE", hidden, 3, 250))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    init_batch, _ = plan.pack([requests[0]], 0)
+    state = trainer.init_state(init_batch)
+    registry = ModelRegistry()
+    registry.register("bench", model, state.params, state.batch_stats)
+    return registry, plan
+
+
+def _pcts(lat):
+    lat = np.sort(np.asarray(lat))
+    return {
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+    }
+
+
+def run_server(registry, plan, requests, clients, wait_ms):
+    from hydragnn_tpu.serve import InferenceServer
+
+    server = InferenceServer(
+        registry,
+        plan,
+        max_wait_s=wait_ms / 1e3,
+        queue_capacity=max(4 * len(requests), 256),
+    )
+    latencies = []
+
+    def one(g):
+        t0 = time.perf_counter()
+        server.predict(g, timeout=120)
+        latencies.append(time.perf_counter() - t0)
+
+    with server:
+        # warm measurement pass
+        with ThreadPoolExecutor(max_workers=clients) as pool:
+            list(pool.map(one, requests[: len(requests) // 4]))
+        latencies.clear()
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=clients) as pool:
+            list(pool.map(one, requests))
+        wall = time.perf_counter() - t0
+        snap = server.metrics.snapshot()
+    return {
+        "mode": "server",
+        "clients": clients,
+        "max_wait_ms": wait_ms,
+        "buckets": plan.num_buckets,
+        **_pcts(latencies),
+        "throughput_rps": round(len(requests) / wall, 1),
+        "batches": snap["batches_total"],
+        "compiles": snap["compiles_total"],
+        "padding_waste_ratio": snap["padding_waste_ratio"],
+    }
+
+
+def run_naive(registry, plan, requests):
+    """One synchronous single-graph dispatch per request — the offline
+    per-request cost floor (no micro-batching, same bucket shapes)."""
+    from hydragnn_tpu.serve import InferenceServer
+
+    server = InferenceServer(registry, plan)
+    server.warmup()  # compile parity with the served case
+    entry = registry.get("bench")
+    latencies = []
+    t0 = time.perf_counter()
+    for g in requests:
+        t1 = time.perf_counter()
+        b = plan.select(g)
+        batch, _ = plan.pack([g], b)
+        outs = server._dispatch_compiled(entry, b, batch)
+        np.asarray(outs[0])  # completion fence
+        latencies.append(time.perf_counter() - t1)
+    wall = time.perf_counter() - t0
+    return {
+        "mode": "naive_per_request",
+        "buckets": plan.num_buckets,
+        **_pcts(latencies),
+        "throughput_rps": round(len(requests) / wall, 1),
+    }
+
+
+def main():
+    num = int(_arg("num", 512))
+    clients = int(_arg("clients", 8))
+    buckets = int(_arg("buckets", 3))
+    batch = int(_arg("batch", 8))
+    hidden = int(_arg("hidden", 64))
+    wait_ms = float(_arg("wait-ms", 5))
+    requests = _oc20_requests(num)
+    registry, plan = _build(requests, hidden, batch, buckets)
+    print(json.dumps(run_naive(registry, plan, requests)))
+    print(json.dumps(run_server(registry, plan, requests, clients, wait_ms)))
+
+
+if __name__ == "__main__":
+    main()

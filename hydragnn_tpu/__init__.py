@@ -18,6 +18,15 @@ Design stance (TPU-first, not a port):
 
 from hydragnn_tpu.run_training import run_training
 from hydragnn_tpu.run_prediction import run_prediction
-from hydragnn_tpu import graph, models, data, train, parallel, utils, postprocess
+from hydragnn_tpu import (
+    graph,
+    models,
+    data,
+    train,
+    parallel,
+    serve,
+    utils,
+    postprocess,
+)
 
 __version__ = "0.1.0"

@@ -427,14 +427,20 @@ def padding_efficiency(datasets, layout, batch_size: int) -> float:
     return real / max(padded, 1)
 
 
-def _collate_with_extras(samples, layout: BatchLayout):
+def collate_for_layout(samples, layout: BatchLayout, with_targets: bool = True):
+    """Collate ``samples`` into the static shapes of ``layout``, including
+    any model-specific extras (DimeNet triplet tables, dense neighbor
+    lists). The ONE layout-aware collation path — the training loader and
+    the serving request packer (``hydragnn_tpu/serve``) both route through
+    here. ``with_targets=False`` packs inputs only (inference requests
+    carry no labels)."""
     batch = collate_graphs(
         samples,
         layout.n_pad,
         layout.e_pad,
         layout.g_pad,
-        head_types=layout.head_types,
-        head_dims=layout.head_dims,
+        head_types=layout.head_types if with_targets else (),
+        head_dims=layout.head_dims if with_targets else (),
     )
     if layout.packs_triplets:
         from hydragnn_tpu.graph.batch import pack_triplets
@@ -461,6 +467,9 @@ def _collate_with_extras(samples, layout: BatchLayout):
         merged.update(nbr)
         batch = batch.replace(extras=merged)
     return batch
+
+
+_collate_with_extras = collate_for_layout
 
 
 class ConcatDataset:

@@ -9,6 +9,16 @@ import json
 
 
 def run_prediction(config, use_devices=None):
+    # use_devices was accepted and silently ignored since the facade was
+    # first ported; silently dropping a device request is worse than
+    # refusing it, so it now fails loudly. Device selection belongs to
+    # JAX: set JAX_PLATFORMS / jax.distributed.initialize() instead.
+    if use_devices is not None:
+        raise TypeError(
+            "run_prediction(use_devices=...) is deprecated and was never "
+            "honored; remove the argument and control device placement "
+            "via JAX_PLATFORMS (or jax.distributed for multi-host runs)"
+        )
     if isinstance(config, str):
         with open(config, "r") as f:
             config = json.load(f)

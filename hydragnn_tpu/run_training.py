@@ -9,6 +9,14 @@ import json
 
 
 def run_training(config, use_devices=None):
+    # same contract as run_prediction: the argument was accepted and
+    # silently ignored since the facade was ported — fail loudly instead
+    if use_devices is not None:
+        raise TypeError(
+            "run_training(use_devices=...) is deprecated and was never "
+            "honored; remove the argument and control device placement "
+            "via JAX_PLATFORMS (or jax.distributed for multi-host runs)"
+        )
     if isinstance(config, str):
         with open(config, "r") as f:
             config = json.load(f)

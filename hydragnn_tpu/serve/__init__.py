@@ -1,0 +1,54 @@
+"""hydragnn_tpu.serve — online inference: micro-batched, bucket-compiled,
+observable prediction serving (docs/serving.md).
+
+The offline path (``run_prediction``) sweeps a whole test split; this
+package answers SINGLE ad-hoc graphs at low latency by reusing the two
+ingredients the batching layer already provides — static padded shapes
+and node-count buckets — as a pad-once/compile-once request server:
+
+    from hydragnn_tpu.serve import (
+        InferenceServer, ModelRegistry, plan_from_samples,
+    )
+
+    registry = ModelRegistry()
+    registry.load_checkpoint("PNA-r-2.0-...-run")        # strict v2 loader
+    plan = plan_from_samples(sample_graphs, max_batch_graphs=8)
+    with InferenceServer(registry, plan,
+                         observability_port=8080) as server:
+        heads = server.predict(graph)                    # sync
+        fut = server.submit(graph, deadline_s=0.1)       # async
+"""
+
+from hydragnn_tpu.serve.buckets import (
+    BucketCapacity,
+    GraphTooLarge,
+    ServingBucketPlan,
+    plan_from_layout,
+    plan_from_samples,
+)
+from hydragnn_tpu.serve.http import ObservabilityServer
+from hydragnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from hydragnn_tpu.serve.registry import ModelEntry, ModelRegistry
+from hydragnn_tpu.serve.server import (
+    DeadlineExceeded,
+    InferenceServer,
+    ServeFuture,
+    ServerOverloaded,
+)
+
+__all__ = [
+    "BucketCapacity",
+    "DeadlineExceeded",
+    "GraphTooLarge",
+    "InferenceServer",
+    "LatencyHistogram",
+    "ModelEntry",
+    "ModelRegistry",
+    "ObservabilityServer",
+    "ServeFuture",
+    "ServeMetrics",
+    "ServerOverloaded",
+    "ServingBucketPlan",
+    "plan_from_layout",
+    "plan_from_samples",
+]

@@ -1,0 +1,145 @@
+"""Frozen, versioned model registry for the predict server.
+
+A registry entry is everything inference needs, immutable once
+registered: the flax module, its restored ``params``/``batch_stats``,
+and the head schema. Checkpoints load through the STRICT v2 loader
+(``load_state_dict(..., fallback=False)`` — serving must never silently
+answer from an older rolling checkpoint; that rule already guards
+``run_prediction``, ``train/driver.py``) and any embedded ``train_meta``
+is stripped: serving state is weights only.
+
+Multiple models serve side by side (one entry per name); re-registering
+a name bumps its version and new requests pick up the new entry at the
+next micro-batch — in-flight batches keep the entry they were packed
+with (each batch captures the frozen entry, not the name).
+"""
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    """One immutable serveable model version."""
+
+    name: str
+    version: int
+    model: Any  # flax module (HydraBase subclass)
+    params: Any  # restored param pytree
+    batch_stats: Any  # restored BN stats pytree ({} when stat-free)
+    output_type: Tuple[str, ...]  # per head: "graph" | "node"
+    output_dim: Tuple[int, ...]
+    source: str = "memory"  # checkpoint path or "memory"
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.name, self.version)
+
+
+class ModelRegistry:
+    """Name -> latest :class:`ModelEntry`, with version history."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, List[ModelEntry]] = {}
+
+    def register(
+        self,
+        name: str,
+        model,
+        params,
+        batch_stats=None,
+        source: str = "memory",
+    ) -> ModelEntry:
+        """Freeze (model, weights) as the next version of ``name``."""
+        with self._lock:
+            version = len(self._entries.get(name, ())) + 1
+            entry = ModelEntry(
+                name=name,
+                version=version,
+                model=model,
+                params=params,
+                batch_stats=batch_stats if batch_stats is not None else {},
+                output_type=tuple(model.output_type),
+                output_dim=tuple(model.output_dim),
+                source=source,
+            )
+            self._entries.setdefault(name, []).append(entry)
+            return entry
+
+    def load_checkpoint(
+        self,
+        checkpoint_name: str,
+        arch_config: Optional[dict] = None,
+        path: str = "./logs/",
+        name: Optional[str] = None,
+        verbosity: int = 0,
+    ) -> ModelEntry:
+        """Load ``<path>/<checkpoint_name>/<checkpoint_name>.pk`` into a
+        fresh entry. ``arch_config`` is the derived Architecture section
+        (post-``update_config``); when omitted it is read from the
+        ``config.json`` the training driver saved next to the checkpoint.
+        ``name`` defaults to the checkpoint name."""
+        from hydragnn_tpu.models.create import create_model_config
+        from hydragnn_tpu.train.checkpoint import (
+            load_state_dict,
+            pop_train_meta,
+        )
+
+        if arch_config is None:
+            cfg_path = os.path.join(path, checkpoint_name, "config.json")
+            with open(cfg_path, "r") as f:
+                arch_config = json.load(f)["NeuralNetwork"]["Architecture"]
+        model = create_model_config(dict(arch_config), verbosity)
+        # strict: corruption/truncation aborts, no rolling fallback
+        restored = load_state_dict(checkpoint_name, path=path, fallback=False)
+        pop_train_meta(restored)
+        if "params" not in restored:
+            raise ValueError(
+                f"checkpoint {checkpoint_name} has no 'params' section — "
+                "not a model checkpoint"
+            )
+        return self.register(
+            name or checkpoint_name,
+            model,
+            restored["params"],
+            restored.get("batch_stats", {}),
+            source=os.path.join(path, checkpoint_name),
+        )
+
+    def get(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        with self._lock:
+            history = self._entries.get(name)
+            if not history:
+                raise KeyError(f"no model registered under {name!r}")
+            if version is None:
+                return history[-1]
+            for entry in history:
+                if entry.version == version:
+                    return entry
+            raise KeyError(f"model {name!r} has no version {version}")
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def describe(self) -> Dict[str, Dict]:
+        """Registry summary for ``/healthz``."""
+        with self._lock:
+            return {
+                name: {
+                    "version": history[-1].version,
+                    "versions": len(history),
+                    "output_type": list(history[-1].output_type),
+                    "output_dim": list(history[-1].output_dim),
+                    "source": history[-1].source,
+                }
+                for name, history in self._entries.items()
+            }
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,534 @@
+"""The in-process predict server: micro-batched, bucket-compiled inference.
+
+Request lifecycle::
+
+    submit(graph) --admission--> bounded queue --batcher thread-->
+      group by (model, bucket) --max-wait / budget-full flush-->
+        pack into the bucket's static padding (pad once) -->
+          pre-warmed jitted executable (compile once) -->
+            split outputs per request --> future resolves
+
+Design rules, in the order they bite:
+
+- **Static shapes are the unit of compilation** (the repo's batching
+  thesis, ``graph/batch.py``): every dispatch reuses one of the plan's
+  <= num_buckets shape signatures, so after startup warmup steady state
+  runs ZERO recompiles — the compile counter on ``/metrics`` is the
+  regression alarm.
+- **Micro-batching trades a bounded wait for throughput**: requests
+  wait at most ``max_wait_s`` for co-riders; a full budget (node/edge/
+  graph pads) flushes immediately.
+- **Graceful degradation**: a full queue sheds NEW work at submit time
+  with a retry-after hint (callers back off; latency of accepted work
+  stays bounded) — never silently queues unbounded. Expired deadlines
+  resolve with :class:`DeadlineExceeded` before wasting a dispatch.
+  Graphs denser than their node-natural bucket fall through to the next
+  larger one (``ServingBucketPlan.select``).
+- **Failure isolation**: a dispatch error fails only that batch's
+  requests; the batcher thread survives and keeps serving.
+"""
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.serve.buckets import ServingBucketPlan
+from hydragnn_tpu.serve.metrics import ServeMetrics
+from hydragnn_tpu.serve.registry import ModelEntry, ModelRegistry
+
+
+class ServerOverloaded(RuntimeError):
+    """Queue full — the request was shed, retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"predict queue full; retry after {retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline expired before its batch dispatched."""
+
+
+class ServeFuture:
+    """Minimal future resolved by the batcher thread."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, result) -> bool:
+        # first resolution wins (atomically): a shutdown sweep racing a
+        # completed dispatch must not overwrite a result with an error
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result = result
+            self._event.set()
+            return True
+
+    def set_exception(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._error = error
+            self._event.set()
+            return True
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("prediction not ready")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = (
+        "graph", "entry", "bucket", "sizes", "future", "enqueued_at",
+        "deadline", "fallback",
+    )
+
+    def __init__(self, graph, entry, bucket, sizes, deadline, fallback):
+        self.graph = graph
+        self.entry = entry
+        self.bucket = bucket
+        self.sizes = sizes  # (nodes, edges, triplets)
+        self.future = ServeFuture()
+        self.enqueued_at = time.monotonic()
+        self.deadline = deadline  # absolute monotonic time or None
+        self.fallback = fallback  # served above its node-natural bucket
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+
+class InferenceServer:
+    """Micro-batching predict server over a :class:`ModelRegistry` and a
+    :class:`ServingBucketPlan`.
+
+    In-process and thread-safe: any number of caller threads ``submit``;
+    one batcher thread packs and dispatches (single-threaded device use —
+    jit dispatch from multiple threads buys nothing and interleaves
+    badly). ``/healthz`` + ``/metrics`` come from
+    :class:`~hydragnn_tpu.serve.http.ObservabilityServer`, started here
+    when ``observability_port`` is not None (0 = ephemeral port)."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        plan: ServingBucketPlan,
+        default_model: Optional[str] = None,
+        max_wait_s: float = 0.005,
+        queue_capacity: int = 256,
+        default_deadline_s: Optional[float] = None,
+        observability_port: Optional[int] = None,
+        metrics: Optional[ServeMetrics] = None,
+    ):
+        self.registry = registry
+        self.plan = plan
+        self.default_model = default_model
+        self.max_wait_s = float(max_wait_s)
+        self.queue_capacity = int(queue_capacity)
+        self.default_deadline_s = default_deadline_s
+        self.metrics = metrics or ServeMetrics()
+        self._queue: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=self.queue_capacity
+        )
+        # mutated only by the batcher thread; the lock covers the cross-
+        # thread reads (_depth from submitters, drain checks from stop)
+        self._pending_lock = threading.Lock()
+        self._pending: Dict[Tuple[str, int, int], List[_Request]] = {}
+        self._predict_fns: Dict[Tuple[str, int], object] = {}
+        self._seen_shapes: Set[Tuple] = set()
+        self._thread: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        # guards the stopped-check + enqueue pair in submit() against
+        # stop(): without it a submit could pass the check, then enqueue
+        # AFTER stop()'s sweep — a request no one would ever answer
+        self._submit_lock = threading.Lock()
+        self._stopped = False  # start() -> stop() happened; submits refuse
+        self._warm = False
+        self._observability_port = observability_port
+        self._http = None
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self, warmup: bool = True):
+        """Warm every (registered model, bucket) executable, then start
+        the batcher thread (and the observability endpoint, if asked)."""
+        if self._running.is_set():
+            return self
+        self._stopped = False
+        from hydragnn_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+        if warmup:
+            self.warmup()
+        self._running.set()
+        self._thread = threading.Thread(
+            target=self._batcher_loop,
+            name="hydragnn-serve-batcher",
+            daemon=True,
+        )
+        self._thread.start()
+        if self._observability_port is not None:
+            from hydragnn_tpu.serve.http import ObservabilityServer
+
+            self._http = ObservabilityServer(
+                self, port=self._observability_port
+            )
+            self._http.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 10.0):
+        """Stop the batcher; ``drain=True`` serves already-queued work
+        first, otherwise queued requests fail with a shutdown error.
+        Also sweeps a never-started server's queue, so requests
+        submitted before ``start()`` cannot strand."""
+        with self._submit_lock:
+            # after this block no submit can enqueue: any submit holding
+            # the lock finished its put before the flag flipped, and the
+            # sweep below runs strictly later — nothing slips past it
+            self._stopped = True
+        if self._running.is_set():
+            if drain:
+                deadline = time.monotonic() + timeout
+                while self._depth() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+            self._running.clear()
+            if self._thread is not None:
+                self._thread.join(timeout)
+                self._thread = None
+        # fail anything still queued — no silent black hole. Counted as
+        # errors so the metrics lifecycle invariant (every accepted
+        # request ends in responses/timeouts/errors) survives shutdown.
+        stranded: List[_Request] = []
+        while True:
+            try:
+                stranded.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        with self._pending_lock:
+            # a batcher outliving join(timeout) still pops groups under
+            # this lock; taking ownership here prevents double-resolution
+            for group in self._pending.values():
+                stranded.extend(group)
+            self._pending.clear()
+        failed = sum(
+            req.future.set_exception(RuntimeError("server stopped"))
+            for req in stranded
+        )
+        if failed:
+            self.metrics.on_error(failed)
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    @property
+    def observability_address(self) -> Optional[Tuple[str, int]]:
+        return None if self._http is None else self._http.address
+
+    # ---- warmup --------------------------------------------------------
+    def warmup(self):
+        """Compile every (model, bucket) executable before traffic: one
+        dispatch of the plan's warmup sample per bucket per model. After
+        this, any request the plan admits reuses a cached program."""
+        sample = self.plan.warmup_sample
+        if sample is None:
+            raise ValueError(
+                "plan has no warmup_sample; pass one (a small GraphData) "
+                "or build the plan via plan_from_samples/plan_from_layout"
+            )
+        for name in self.registry.names():
+            entry = self.registry.get(name)
+            for b in range(self.plan.num_buckets):
+                batch, _ = self.plan.pack([sample], b)
+                self._dispatch_compiled(entry, b, batch)
+        self._warm = True
+
+    def is_warm(self) -> bool:
+        return self._warm
+
+    # ---- submission ----------------------------------------------------
+    def submit(
+        self,
+        graph: GraphData,
+        model: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> ServeFuture:
+        """Enqueue one graph; returns a future resolving to a list of
+        per-head numpy outputs (graph head: ``[dim]``, node head:
+        ``[num_nodes, dim]``). Raises :class:`ServerOverloaded` when the
+        queue is full and :class:`GraphTooLarge` when no bucket admits
+        the graph (both BEFORE queueing — shed work fails fast)."""
+        name = model or self.default_model
+        if name is None:
+            names = self.registry.names()
+            if len(names) != 1:
+                raise ValueError(
+                    "no model= given and no default_model set with "
+                    f"{len(names)} models registered"
+                )
+            name = names[0]
+        entry = self.registry.get(name)
+        bucket, sizes = self.plan.admit(graph)  # GraphTooLarge propagates
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        deadline = (
+            None if deadline_s is None else time.monotonic() + deadline_s
+        )
+        req = _Request(
+            graph,
+            entry,
+            bucket,
+            sizes,
+            deadline,
+            fallback=bucket > self.plan.natural_bucket(graph.num_nodes),
+        )
+        # check-and-enqueue atomically vs stop(): once stop() takes this
+        # lock to set _stopped, no request can slip into the dead queue
+        # after its sweep
+        with self._submit_lock:
+            if self._stopped:
+                raise RuntimeError("server stopped; submits are refused")
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                self.metrics.on_shed()
+                # the queue drains one max-wait window per flush round; a
+                # full queue clears in about capacity/batch flushes of it
+                raise ServerOverloaded(
+                    retry_after_s=max(self.max_wait_s, 0.001)
+                )
+        self.metrics.on_submit()
+        self.metrics.set_queue_depth(self._depth())
+        return req.future
+
+    def predict(
+        self,
+        graph: GraphData,
+        model: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(graph, model=model, deadline_s=timeout).result(
+            timeout
+        )
+
+    def _depth(self) -> int:
+        with self._pending_lock:
+            pending = sum(len(g) for g in self._pending.values())
+        return self._queue.qsize() + pending
+
+    # ---- batcher -------------------------------------------------------
+    def _batcher_loop(self):
+        tick = max(self.max_wait_s / 4, 0.0005)
+        while self._running.is_set():
+            try:
+                req = self._queue.get(timeout=tick)
+            except queue.Empty:
+                req = None
+            if req is not None:
+                self._admit_pending(req)
+                # greedy drain: move everything already queued into its
+                # group before checking flush conditions — one wakeup
+                # packs the whole burst
+                while True:
+                    try:
+                        more = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._admit_pending(more)
+            self._flush_due()
+            self.metrics.set_queue_depth(self._depth())
+        # shutdown flush: serve whatever is pending so stop(drain=True)
+        # never strands accepted work
+        with self._pending_lock:
+            keys = list(self._pending)
+        for key in keys:
+            self._flush_group(key)
+
+    def _admit_pending(self, req: _Request):
+        key = (req.entry.name, req.entry.version, req.bucket)
+        with self._pending_lock:
+            self._pending.setdefault(key, []).append(req)
+
+    def _flush_due(self):
+        now = time.monotonic()
+        with self._pending_lock:
+            keys = list(self._pending)
+        for key in keys:
+            group = self._pending.get(key)
+            if not group:
+                with self._pending_lock:
+                    self._pending.pop(key, None)
+                continue
+            if self._group_full(key, group) or (
+                now - group[0].enqueued_at >= self.max_wait_s
+            ):
+                self._flush_group(key)
+
+    def _group_full(self, key, group) -> bool:
+        """Full = the bucket budget cannot take one more request of the
+        group's smallest plausible size — approximated by: adding the
+        LAST request's sizes again would overflow (cheap, and exact for
+        same-size streams; worst case we flush one request early)."""
+        bucket = key[2]
+        n = sum(r.sizes[0] for r in group)
+        e = sum(r.sizes[1] for r in group)
+        t = sum(r.sizes[2] for r in group)
+        return not self.plan.fits_batch(
+            bucket, n, e, t, len(group), group[-1].sizes
+        )
+
+    def _flush_group(self, key):
+        with self._pending_lock:
+            group = self._pending.pop(key, None)
+        if not group:
+            return
+        now = time.monotonic()
+        live: List[_Request] = []
+        expired = 0
+        for req in group:
+            if req.expired(now):
+                req.future.set_exception(
+                    DeadlineExceeded(
+                        "deadline expired after "
+                        f"{now - req.enqueued_at:.3f}s in queue"
+                    )
+                )
+                expired += 1
+            else:
+                live.append(req)
+        if expired:
+            self.metrics.on_timeout(expired)
+        bucket = key[2]
+        # budget-greedy split: a group can exceed one batch's budgets
+        # (e.g. a burst larger than g_pad-1) — emit as many full batches
+        # as needed, every one inside the bucket's static shapes
+        while live:
+            take: List[_Request] = []
+            n = e = t = 0
+            for req in live:
+                if take and not self.plan.fits_batch(
+                    bucket, n, e, t, len(take), req.sizes
+                ):
+                    break
+                take.append(req)
+                n += req.sizes[0]
+                e += req.sizes[1]
+                t += req.sizes[2]
+            live = live[len(take):]
+            self._dispatch_batch(take, bucket, real_nodes=n)
+
+    def _dispatch_batch(self, requests: List[_Request], bucket: int,
+                        real_nodes: int):
+        entry = requests[0].entry
+        t0 = time.monotonic()
+        try:
+            batch, coords = self.plan.pack(
+                [r.graph for r in requests], bucket
+            )
+            outputs = self._dispatch_compiled(entry, bucket, batch)
+            outputs = [np.asarray(o) for o in outputs]
+        except Exception as e:  # fail the batch, keep the server alive
+            self.metrics.on_error(len(requests))
+            for req in requests:
+                req.future.set_exception(e)
+            return
+        now = time.monotonic()
+        for req, (g, off, n) in zip(requests, coords):
+            per_head = []
+            for ihead, kind in enumerate(entry.output_type):
+                if kind == "graph":
+                    per_head.append(outputs[ihead][g])
+                else:
+                    per_head.append(outputs[ihead][off: off + n])
+            req.future.set_result(per_head)
+            self.metrics.on_response_latency(now - req.enqueued_at)
+        self.metrics.on_batch(
+            bucket,
+            len(requests),
+            real_nodes=real_nodes,
+            padded_nodes=self.plan.layouts[bucket].n_pad,
+            batch_seconds=now - t0,
+            fallbacks=sum(1 for r in requests if r.fallback),
+        )
+
+    # ---- compiled dispatch ---------------------------------------------
+    def _predict_fn(self, entry: ModelEntry):
+        fn = self._predict_fns.get(entry.key)
+        if fn is None:
+            import jax
+
+            model = entry.model
+
+            def _apply(params, batch_stats, batch):
+                variables = {"params": params}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                return model.apply(variables, batch, train=False)
+
+            fn = jax.jit(_apply)
+            self._predict_fns[entry.key] = fn
+        return fn
+
+    def _dispatch_compiled(self, entry: ModelEntry, bucket: int, batch):
+        """Run the bucket's executable; account a compile whenever this
+        (model version, shape signature) has not been seen — warmup sees
+        every bucket once, so any later increment means a shape leaked
+        past the plan (the exact bug class ``/metrics`` must expose)."""
+        import jax
+
+        shape_key = (
+            entry.key,
+            tuple(
+                (tuple(a.shape), str(getattr(a, "dtype", type(a))))
+                for a in jax.tree_util.tree_leaves(batch)
+            ),
+        )
+        if shape_key not in self._seen_shapes:
+            self._seen_shapes.add(shape_key)
+            self.metrics.on_compile()
+        dev_batch = jax.tree_util.tree_map(np.asarray, batch)
+        return self._predict_fn(entry)(
+            entry.params, entry.batch_stats, dev_batch
+        )
+
+    # ---- health --------------------------------------------------------
+    def health(self) -> Dict:
+        """``/healthz`` payload: liveness + registry + warmup state."""
+        return {
+            "status": "ok" if self._running.is_set() else "stopped",
+            "warm": self._warm,
+            "models": self.registry.describe(),
+            "buckets": [
+                {
+                    "max_nodes": cap.max_nodes,
+                    "max_edges": cap.max_edges,
+                    "n_pad": lay.n_pad,
+                    "e_pad": lay.e_pad,
+                    "g_pad": lay.g_pad,
+                }
+                for cap, lay in zip(self.plan.capacities, self.plan.layouts)
+            ],
+            "queue_depth": self._depth(),
+            "queue_capacity": self.queue_capacity,
+            "max_wait_s": self.max_wait_s,
+        }

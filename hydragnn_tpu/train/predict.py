@@ -18,7 +18,29 @@ class PredictMixin:
     # beyond that the streaming path is the safe default. Best-effort only:
     # it cannot see HBM already held by staged training data / params — the
     # caller additionally catches the device's own RESOURCE_EXHAUSTED.
+    # Default only: HYDRAGNN_PREDICT_STAGE_BUDGET / the training config's
+    # ``predict_stage_budget_bytes`` override it (_predict_stage_budget).
     _PREDICT_STAGE_BUDGET_BYTES = 8 * 1024**3
+
+    def _predict_stage_budget(self) -> int:
+        """Staging budget in bytes: ``HYDRAGNN_PREDICT_STAGE_BUDGET`` env
+        (accepts scientific notation, e.g. ``4e9``) > training config
+        ``predict_stage_budget_bytes`` > the 8 GiB class default. Chips
+        are not all v5e-sized — a v4 host wants a bigger stage, a CPU CI
+        host a far smaller one."""
+        env = os.getenv("HYDRAGNN_PREDICT_STAGE_BUDGET")
+        if env is not None:
+            try:
+                return int(float(env))
+            except ValueError:
+                raise ValueError(
+                    "HYDRAGNN_PREDICT_STAGE_BUDGET must be a byte count, "
+                    f"got {env!r}"
+                ) from None
+        cfg = self.training_config.get("predict_stage_budget_bytes")
+        if cfg is not None:
+            return int(cfg)
+        return self._PREDICT_STAGE_BUDGET_BYTES
 
     def predict(self, state, loader):
         """Full test pass with sample collection — the reference's ``test()``
@@ -50,6 +72,10 @@ class PredictMixin:
             ),
         )
         if device_resident and (self.mesh is None or jax.process_count() == 1):
+            # resolve the budget OUTSIDE the fallback try: a malformed
+            # HYDRAGNN_PREDICT_STAGE_BUDGET must fail loudly here, not be
+            # swallowed as a "ragged shapes" fallback below
+            budget = self._predict_stage_budget()
             host_batches = []
             for ibatch, batch in enumerate(loader):
                 if ibatch >= nbatch:
@@ -59,7 +85,7 @@ class PredictMixin:
                 # only the two documented failure modes trigger the
                 # fallback: ragged shapes (stack raises ValueError) and the
                 # host-side budget estimate (MemoryError)
-                stacked = self._stack_for_predict(host_batches)
+                stacked = self._stack_for_predict(host_batches, budget)
             except (ValueError, MemoryError):
                 loader = host_batches
             else:
@@ -133,9 +159,13 @@ class PredictMixin:
             predicted_values[ihead].append(pred)
             true_values[ihead].append(true)
 
-    def _stack_for_predict(self, host_batches):
+    def _stack_for_predict(self, host_batches, budget=None):
         """Stack + host-side budget estimate for the staged predict path.
-        Raises ValueError (ragged shapes) or MemoryError (over budget)."""
+        Raises ValueError (ragged shapes) or MemoryError (over budget).
+        ``budget`` should be resolved by the caller via
+        :meth:`_predict_stage_budget` BEFORE entering any fallback
+        handler — resolving it here would let a malformed env override
+        masquerade as a ragged-shape ValueError."""
         from hydragnn_tpu.graph.batch import stack_batches
 
         stacked = stack_batches(host_batches)  # ValueError if ragged
@@ -153,9 +183,12 @@ class PredictMixin:
             nb * out_rows[t] * d * 4
             for t, d in zip(self.model.output_type, self.model.output_dim)
         )
-        if stage_bytes + out_bytes > self._PREDICT_STAGE_BUDGET_BYTES:
+        if budget is None:
+            budget = self._predict_stage_budget()
+        if stage_bytes + out_bytes > budget:
             raise MemoryError(
-                f"staged predict would need {stage_bytes + out_bytes} bytes"
+                f"staged predict would need {stage_bytes + out_bytes} bytes "
+                f"(budget {budget})"
             )
         return stacked
 

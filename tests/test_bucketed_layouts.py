@@ -190,6 +190,49 @@ def pytest_bucketed_training_matches_reference_ceiling():
     )
 
 
+def pytest_bucket_for_edge_cases():
+    """bucket_for outside the trained size range: a graph LARGER than the
+    largest bucket clamps to the last bucket (collation then fails loudly
+    if it truly cannot fit — never a silent wrong bucket), a zero-node
+    graph lands in the smallest, and exact boundary sizes stay in their
+    own (inclusive-upper-bound) bucket."""
+    samples = _oc20_shaped(200, seed=11)
+    layout = compute_layout([samples], batch_size=8, num_buckets=3)
+    assert isinstance(layout, BucketedLayout)
+    last = len(layout.layouts) - 1
+    assert layout.bucket_for(layout.node_bounds[-1] + 1000) == last
+    assert layout.bucket_for(0) == 0
+    assert layout.bucket_for(1) == 0
+    for b, bound in enumerate(layout.node_bounds):
+        assert layout.bucket_for(bound) == b  # inclusive upper bound
+        if b + 1 < len(layout.node_bounds):
+            assert layout.bucket_for(bound + 1) == b + 1
+
+
+def pytest_batch_buckets_env_override(monkeypatch):
+    """HYDRAGNN_BATCH_BUCKETS wins over whatever the caller passed — the
+    ONE precedence site lives in create_dataloaders — and a non-integer
+    value fails loudly instead of silently running unbucketed."""
+    samples = _oc20_shaped(120, seed=9)
+    third = len(samples) // 3
+    splits = (samples[:third], samples[third : 2 * third], samples[2 * third :])
+
+    monkeypatch.setenv("HYDRAGNN_BATCH_BUCKETS", "3")
+    train_loader, _, _ = create_dataloaders(*splits, batch_size=8)
+    assert isinstance(train_loader.layout, BucketedLayout)
+    assert len(train_loader.layout.layouts) <= 3
+
+    # env also DOWNGRADES an explicit request back to a single layout
+    monkeypatch.setenv("HYDRAGNN_BATCH_BUCKETS", "1")
+    train_loader, _, _ = create_dataloaders(*splits, batch_size=8,
+                                            num_buckets=4)
+    assert isinstance(train_loader.layout, BatchLayout)
+
+    monkeypatch.setenv("HYDRAGNN_BATCH_BUCKETS", "four")
+    with pytest.raises(ValueError):
+        create_dataloaders(*splits, batch_size=8)
+
+
 def pytest_bucketed_dense_aggregation_layout():
     """Dense neighbor-list widths are computed per bucket."""
     samples = _oc20_shaped(60, seed=5)

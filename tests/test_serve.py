@@ -1,0 +1,379 @@
+"""Online inference serving (hydragnn_tpu/serve): micro-batched,
+bucket-compiled, observable predict server.
+
+Acceptance (ISSUE 2): an in-process server under concurrent mixed-size
+traffic must return predictions matching the offline
+``PredictMixin.predict`` path for the same graphs, and after warmup the
+compile counter must stay flat across >= 100 further requests (zero
+steady-state recompiles). Plus the degradation contract: queue-full
+shedding with a retry-after hint, per-request deadlines, next-larger-
+bucket fallback for over-dense graphs, and the /healthz + /metrics
+endpoint pair.
+"""
+
+import json
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.serve import (
+    DeadlineExceeded,
+    GraphTooLarge,
+    InferenceServer,
+    LatencyHistogram,
+    ModelRegistry,
+    ServerOverloaded,
+    plan_from_samples,
+)
+from hydragnn_tpu.train.trainer import Trainer
+
+from test_models_forward import arch_config
+
+
+def _graph(n, rng, degree=4, with_targets=True):
+    d = GraphData(
+        x=rng.random((n, 1)).astype(np.float32),
+        pos=rng.random((n, 3)).astype(np.float32),
+    )
+    src = np.repeat(np.arange(n), max(degree // 2, 1))
+    dst = (src + rng.integers(1, n, src.shape[0])) % n
+    d.edge_index = np.stack(
+        [np.concatenate([src, dst]), np.concatenate([dst, src])]
+    ).astype(np.int64)
+    if with_targets:
+        d.targets = [np.asarray([d.x.sum()], np.float32), d.x.copy()]
+        d.target_types = ["graph", "node"]
+    return d
+
+
+_HARNESS = {}
+
+
+def _harness():
+    """One (samples, model, state, registry, plan) per module — jit
+    warmup is the expensive part; every test reuses it."""
+    if _HARNESS:
+        return _HARNESS
+    rng = np.random.default_rng(42)
+    samples = [_graph(int(n), rng) for n in rng.integers(4, 40, 60)]
+    model = create_model_config(arch_config("SAGE"))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    plan = plan_from_samples(samples, max_batch_graphs=4, num_buckets=3)
+    init_batch, _ = plan.pack([samples[0]], 0)
+    state = trainer.init_state(init_batch)
+    registry = ModelRegistry()
+    registry.register(
+        "sage", model, state.params, state.batch_stats
+    )
+    _HARNESS.update(
+        samples=samples,
+        model=model,
+        trainer=trainer,
+        state=state,
+        registry=registry,
+        plan=plan,
+    )
+    return _HARNESS
+
+
+def pytest_serve_smoke_one_request_per_bucket():
+    """CI smoke: start in-process, serve one request per bucket, shut
+    down cleanly — the ci.yml serve gate."""
+    h = _harness()
+    plan, rng = h["plan"], np.random.default_rng(0)
+    with InferenceServer(h["registry"], plan, max_wait_s=0.002) as server:
+        assert server.is_warm()
+        for cap in plan.capacities:
+            g = _graph(cap.max_nodes, rng, with_targets=False)
+            heads = server.predict(g, timeout=30)
+            assert heads[0].shape == (1,)
+            assert heads[1].shape == (cap.max_nodes, 1)
+            assert all(np.isfinite(o).all() for o in heads)
+    # clean shutdown: batcher gone, late submits fail fast instead of
+    # queueing into a server that will never answer
+    assert server.health()["status"] == "stopped"
+    with pytest.raises(RuntimeError, match="stopped"):
+        server.submit(_graph(8, rng, with_targets=False))
+
+
+def pytest_serve_matches_offline_predict_under_concurrency():
+    """The acceptance e2e: concurrent mixed-size requests == offline
+    PredictMixin.predict, and zero steady-state recompiles."""
+    h = _harness()
+    samples, trainer, state = h["samples"], h["trainer"], h["state"]
+
+    # offline reference: single max-sized layout, dataset order
+    layout = compute_layout([samples], batch_size=4)
+    loader = GraphLoader(
+        samples, 4, layout, shuffle=False, num_shards=1, shard_id=0
+    )
+    _, _, _, offline = trainer.predict(state, loader)
+
+    server = InferenceServer(
+        h["registry"], h["plan"], max_wait_s=0.005, queue_capacity=512
+    )
+    with server:
+        compiles_after_warmup = server.metrics.compiles_total
+        assert compiles_after_warmup == h["plan"].num_buckets
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futs = [
+                pool.submit(server.predict, g, None, 60) for g in samples
+            ]
+            results = [f.result() for f in futs]
+
+        # same graphs, same weights: per-head rows must match the offline
+        # sweep (reshaped to its flattened [rows, 1] collection format)
+        for ihead in range(2):
+            served = np.concatenate(
+                [np.asarray(r[ihead]).reshape(-1, 1) for r in results]
+            )
+            np.testing.assert_allclose(
+                served, offline[ihead], rtol=1e-5, atol=1e-5
+            )
+
+        # steady state: >= 100 further requests, compile counter flat
+        rng = np.random.default_rng(7)
+        futs = [
+            server.submit(_graph(int(n), rng, with_targets=False))
+            for n in rng.integers(4, 40, 110)
+        ]
+        for f in futs:
+            f.result(60)
+        assert server.metrics.compiles_total == compiles_after_warmup
+    snap = server.metrics.snapshot()
+    assert snap["responses_total"] >= len(samples) + 110
+    assert snap["errors_total"] == 0
+    assert 0.0 <= snap["padding_waste_ratio"] < 1.0
+    assert snap["request_latency"]["p99"] >= snap["request_latency"]["p50"]
+
+
+def pytest_serve_queue_full_sheds_with_retry_hint():
+    h = _harness()
+    server = InferenceServer(
+        h["registry"], h["plan"], max_wait_s=0.01, queue_capacity=3
+    )
+    # batcher NOT started: the queue fills deterministically
+    g = _graph(10, np.random.default_rng(1), with_targets=False)
+    futs = [server.submit(g) for _ in range(3)]
+    with pytest.raises(ServerOverloaded) as exc:
+        server.submit(g)
+    assert exc.value.retry_after_s > 0
+    assert server.metrics.shed_total == 1
+    assert server.metrics.requests_total == 3  # shed work never counted
+    # stop() sweeps the never-started queue: accepted work fails loudly
+    # and lands in errors_total (the metrics lifecycle invariant)
+    server.stop()
+    for f in futs:
+        with pytest.raises(RuntimeError, match="stopped"):
+            f.result(5)
+    assert server.metrics.errors_total == 3
+
+
+def pytest_serve_deadline_expires_in_queue():
+    h = _harness()
+    with InferenceServer(
+        h["registry"], h["plan"], max_wait_s=0.02
+    ) as server:
+        g = _graph(10, np.random.default_rng(2), with_targets=False)
+        fut = server.submit(g, deadline_s=0.0)  # already expired
+        with pytest.raises(DeadlineExceeded):
+            fut.result(30)
+        assert server.metrics.timeouts_total >= 1
+
+
+def pytest_serve_dense_graph_falls_back_to_larger_bucket():
+    """A graph whose NODE count fits the smallest bucket but whose edge
+    count overflows it must ride a larger bucket, not fail."""
+    h = _harness()
+    plan = h["plan"]
+    cap0 = plan.capacities[0]
+    n = cap0.max_nodes
+    # dense enough to overflow sparse bucket 0, small enough for the top
+    rng = np.random.default_rng(3)
+    half = cap0.max_edges // (2 * n) + 1
+    g = _graph(n, rng, degree=2 * half, with_targets=False)
+    assert g.num_edges > cap0.max_edges
+    assert g.num_edges <= plan.capacities[-1].max_edges
+    b = plan.select(g)
+    assert b > 0
+    with InferenceServer(h["registry"], plan, max_wait_s=0.002) as server:
+        heads = server.predict(g, timeout=30)
+        assert heads[1].shape == (n, 1)
+        assert server.metrics.bucket_fallbacks >= 1
+
+    # and nothing admits a graph beyond the largest bucket
+    with pytest.raises(GraphTooLarge):
+        plan.select(_graph(10_000, rng, with_targets=False))
+
+
+def pytest_serve_registry_versions_and_checkpoint_load(tmp_path):
+    """Registry: versioned re-registration; checkpoint load uses the
+    STRICT v2 loader (corruption refuses — never a silent rolling
+    fallback for serving)."""
+    from hydragnn_tpu.train.checkpoint import save_model
+
+    h = _harness()
+    registry = ModelRegistry()
+    e1 = registry.register(
+        "m", h["model"], h["state"].params, h["state"].batch_stats
+    )
+    e2 = registry.register(
+        "m", h["model"], h["state"].params, h["state"].batch_stats
+    )
+    assert (e1.version, e2.version) == (1, 2)
+    assert registry.get("m").version == 2
+    assert registry.get("m", version=1) is e1
+
+    save_model(h["state"], "served", path=str(tmp_path))
+    entry = registry.load_checkpoint(
+        "served", arch_config=arch_config("SAGE"), path=str(tmp_path)
+    )
+    assert entry.name == "served" and entry.version == 1
+    assert entry.output_type == ("graph", "node")
+    # restored weights serve identically to the in-memory registration
+    plan = h["plan"]
+    g = h["samples"][0]
+    with InferenceServer(registry, plan, default_model="served",
+                         max_wait_s=0.002) as server:
+        ref = server.predict(g, model="m", timeout=30)
+        out = server.predict(g, timeout=30)  # default_model path
+    for a, b in zip(ref, out):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    # strict loader: flip a payload byte -> serving load refuses
+    fname = tmp_path / "served" / "served.pk"
+    raw = bytearray(fname.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    fname.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="corrupt"):
+        registry.load_checkpoint(
+            "served", arch_config=arch_config("SAGE"), path=str(tmp_path)
+        )
+
+
+def pytest_serve_observability_endpoints():
+    h = _harness()
+    with InferenceServer(
+        h["registry"], h["plan"], max_wait_s=0.002, observability_port=0
+    ) as server:
+        server.predict(
+            _graph(12, np.random.default_rng(4), with_targets=False),
+            timeout=30,
+        )
+        host, port = server.observability_address
+        health = json.load(
+            urllib.request.urlopen(f"http://{host}:{port}/healthz")
+        )
+        assert health["status"] == "ok" and health["warm"] is True
+        assert "sage" in health["models"]
+        assert len(health["buckets"]) == h["plan"].num_buckets
+
+        text = (
+            urllib.request.urlopen(f"http://{host}:{port}/metrics")
+            .read()
+            .decode()
+        )
+        assert "hydragnn_serve_requests_total" in text
+        assert "hydragnn_serve_compiles_total" in text
+        assert 'quantile="0.99"' in text
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://{host}:{port}/nope")
+        assert exc.value.code == 404
+    assert server.observability_address is None  # listener torn down
+
+
+def pytest_plan_from_training_layout_serves():
+    """Adopting a training-time bucketed layout as the serving plan:
+    shapes match training's compiled family and requests still serve."""
+    from hydragnn_tpu.serve import plan_from_layout
+
+    h = _harness()
+    samples = h["samples"]
+    layout = compute_layout([samples], batch_size=4, num_buckets=3)
+    smallest = min(samples, key=lambda s: s.num_nodes)
+    plan = plan_from_layout(layout, warmup_sample=smallest)
+    assert plan.num_buckets == len(layout.layouts)
+    assert [l.n_pad for l in plan.layouts] == [
+        l.n_pad for l in layout.layouts
+    ]
+    with InferenceServer(h["registry"], plan, max_wait_s=0.002) as server:
+        for g in samples[:6]:
+            heads = server.predict(g, timeout=30)
+            assert heads[1].shape == (g.num_nodes, 1)
+
+
+def pytest_latency_histogram_quantiles():
+    hist = LatencyHistogram(bounds=(0.001, 0.01, 0.1))
+    for _ in range(98):
+        hist.observe(0.005)
+    hist.observe(0.05)
+    hist.observe(0.05)
+    assert 0.001 < hist.quantile(0.5) <= 0.01
+    assert 0.01 < hist.quantile(0.99) <= 0.1
+    assert hist.state()["count"] == 100
+
+
+# ---- satellite: run_prediction(use_devices) ------------------------------
+
+
+def pytest_run_prediction_use_devices_is_a_loud_error():
+    """The facades accepted use_devices and silently ignored it; now
+    both refuse with guidance instead of pretending to honor it."""
+    from hydragnn_tpu import run_prediction, run_training
+
+    with pytest.raises(TypeError, match="use_devices"):
+        run_prediction({}, use_devices=[0, 1])
+    with pytest.raises(TypeError, match="use_devices"):
+        run_training({}, use_devices=[0, 1])
+
+
+# ---- satellite: configurable predict staging budget ----------------------
+
+
+def pytest_predict_stage_budget_precedence(monkeypatch):
+    """env > training config > 8 GiB class default, and the budget is
+    what _stack_for_predict enforces."""
+    h = _harness()
+    trainer = h["trainer"]
+    monkeypatch.delenv("HYDRAGNN_PREDICT_STAGE_BUDGET", raising=False)
+    assert trainer._predict_stage_budget() == 8 * 1024**3
+
+    cfg_trainer = Trainer(
+        h["model"],
+        {
+            "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            "predict_stage_budget_bytes": 12345,
+        },
+    )
+    assert cfg_trainer._predict_stage_budget() == 12345
+    monkeypatch.setenv("HYDRAGNN_PREDICT_STAGE_BUDGET", "4e9")
+    assert cfg_trainer._predict_stage_budget() == 4_000_000_000
+    monkeypatch.setenv("HYDRAGNN_PREDICT_STAGE_BUDGET", "lots")
+    with pytest.raises(ValueError, match="byte count"):
+        cfg_trainer._predict_stage_budget()
+
+    # a tiny budget pushes the staged path to its documented MemoryError
+    monkeypatch.setenv("HYDRAGNN_PREDICT_STAGE_BUDGET", "1")
+    layout = compute_layout([h["samples"]], batch_size=4)
+    loader = GraphLoader(
+        h["samples"], 4, layout, shuffle=False, num_shards=1, shard_id=0
+    )
+    batch = next(iter(loader))
+    with pytest.raises(MemoryError, match="budget"):
+        trainer._stack_for_predict([batch])
+
+    # through the REAL predict path a malformed override must fail
+    # loudly, not be swallowed by the ragged-shape/over-budget fallback
+    monkeypatch.setenv("HYDRAGNN_PREDICT_DEVICE_RESIDENT", "1")
+    monkeypatch.setenv("HYDRAGNN_PREDICT_STAGE_BUDGET", "lots")
+    with pytest.raises(ValueError, match="byte count"):
+        trainer.predict(h["state"], loader)
