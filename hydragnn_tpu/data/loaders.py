@@ -412,18 +412,14 @@ def _pack_indices(
 def padding_efficiency(datasets, layout, batch_size: int) -> float:
     """Real node rows / padded node rows over one epoch's worth of batches
     — the round-3 verdict's acceptance metric for bucketed layouts.
-    Simulates the loader's own packing (shuffle off, one shard)."""
+    Simulates the loader's own packing (shuffle off, one shard) through
+    the SAME accounting the telemetry layer reports per epoch
+    (:meth:`GraphLoader.epoch_padding_stats`), so the two can't diverge."""
     samples = [d for ds in datasets for d in ds]
-    real = int(sum(d.num_nodes for d in samples))
     loader = GraphLoader(
         samples, batch_size, layout, shuffle=False, num_shards=1, shard_id=0,
     )
-    if isinstance(layout, BucketedLayout):
-        padded = sum(
-            layout.layouts[b].n_pad for b, _ in loader._batch_plan()
-        )
-    else:
-        padded = len(loader) * layout.n_pad
+    real, padded = loader.epoch_padding_stats()
     return real / max(padded, 1)
 
 
@@ -572,6 +568,8 @@ class GraphLoader:
         # lazy: one sizes pass over the dataset (bucketed layouts only)
         self._bucket_ids = None
         self._sizes = None
+        self._plain_nodes = None  # node counts cache for the plain layout
+        self._padding_stats_cache = None  # (epoch, (real, padded))
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -688,6 +686,67 @@ class GraphLoader:
             return len(self._batch_plan())
         n = len(self._indices())
         return -(-n // self.batch_size)
+
+    def epoch_padding_stats(self):
+        """(real_node_rows, padded_node_rows) over THIS epoch's (sharded)
+        batch plan, or ``None`` when computing it would cost a dataset
+        I/O pass — the training-side padding-waste accounting (the predict
+        server tracks the same two integrals per micro-batch, and the
+        telemetry layer reports ``1 - real/padded`` per epoch). Reuses the
+        cached sizes/plan and is itself cached per epoch — the fit path
+        logs a whole chunk of epochs against one unchanged plan."""
+        if (
+            self._padding_stats_cache is not None
+            and self._padding_stats_cache[0] == self.epoch
+        ):
+            return self._padding_stats_cache[1]
+        if isinstance(self.layout, BucketedLayout):
+            plan_ready = (
+                self._plan_cache is not None
+                and self._plan_cache[0] == self.epoch
+            )
+            if not plan_ready and self._padding_stats_cache is not None:
+                # the plan for THIS epoch was never built (device-resident
+                # path: the loader is staged once, then only set_epoch
+                # advances) — reporting the last computed integrals beats
+                # forcing an O(dataset) repack purely for telemetry
+                return self._padding_stats_cache[1]
+            # the sizes pass is already paid: bucketed planning needs it
+            self._bucket_assignments()
+            nodes = self._sizes[0]
+            plan = self._batch_plan()
+            if plan:
+                cat = np.concatenate([chunk for _, chunk in plan])
+                real = int(nodes[cat].sum())
+            else:
+                real = 0
+            padded = int(
+                sum(self.layout.layouts[b].n_pad for b, _ in plan)
+            )
+        else:
+            if self._plain_nodes is None:
+                in_memory = isinstance(self.dataset, list) or (
+                    isinstance(self.dataset, ConcatDataset)
+                    and all(
+                        isinstance(d, list) for d in self.dataset.datasets
+                    )
+                )
+                if not in_memory:
+                    # disk-backed datasets (ShardDataset, DistDataset)
+                    # would deserialize EVERY sample just to read
+                    # num_nodes — a full I/O pass stalling the epoch loop;
+                    # telemetry simply omits the waste series there
+                    return None
+                self._plain_nodes = np.fromiter(
+                    (d.num_nodes for d in self.dataset),
+                    np.int64,
+                    count=len(self.dataset),
+                )
+            idx = np.asarray(self._indices(), np.int64)
+            real = int(self._plain_nodes[idx].sum())
+            padded = len(self) * int(self.layout.n_pad)
+        self._padding_stats_cache = (self.epoch, (real, padded))
+        return real, padded
 
     def _batch_tasks(self):
         """(layout, sample-index chunk) pairs — the cheap plan half of

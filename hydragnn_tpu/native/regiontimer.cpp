@@ -160,6 +160,25 @@ int rt_chrome(void* h, const char* filename, int pid) {
 }
 
 // Accessors for tests / summaries.
+// Newline-separated "path<TAB>total_seconds" dump of every region — the
+// host-side consumer is the telemetry layer's region-totals forwarding
+// (utils/tracer.py totals()). Returns bytes written, or -(bytes needed)
+// when the buffer is too small so the caller can retry sized right.
+int rt_totals(void* h, char* buf, int cap) {
+  Timer* t = static_cast<Timer*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::string out;
+  char line[512];
+  for (auto& kv : t->stats) {
+    snprintf(line, sizeof line, "%s\t%.9f\n", kv.first.c_str(),
+             kv.second.total);
+    out += line;
+  }
+  if ((int)out.size() + 1 > cap) return -(int)(out.size() + 1);
+  memcpy(buf, out.c_str(), out.size() + 1);
+  return (int)out.size();
+}
+
 uint64_t rt_count(void* h, const char* path) {
   Timer* t = static_cast<Timer*>(h);
   std::lock_guard<std::mutex> lk(t->mu);

@@ -24,6 +24,8 @@ def _load():
     lib.rt_chrome.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.rt_count.restype = ctypes.c_uint64
     lib.rt_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_totals.restype = ctypes.c_int
+    lib.rt_totals.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.rt_total.restype = ctypes.c_double
     lib.rt_total.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     _lib = lib
@@ -63,6 +65,23 @@ class NativeRegionTimer:
 
     def total(self, path: str) -> float:
         return float(self._lib.rt_total(self._h, path.encode()))
+
+    def totals(self) -> dict:
+        """{region path: accumulated seconds} for every region — the
+        telemetry layer's end-of-run region forwarding reads this."""
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rt_totals(self._h, buf, cap)
+            if n >= 0:
+                break
+            cap = -n
+        out = {}
+        for line in buf.value.decode().splitlines():
+            if "\t" in line:
+                path, tot = line.rsplit("\t", 1)
+                out[path] = float(tot)
+        return out
 
     def __del__(self):
         try:

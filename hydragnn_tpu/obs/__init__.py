@@ -1,0 +1,67 @@
+"""hydragnn_tpu.obs — unified telemetry (docs/observability.md).
+
+One coherent observability layer for training AND serving:
+
+- :mod:`~hydragnn_tpu.obs.metrics` — the shared metrics core (counters,
+  gauges, latency histograms, Prometheus text), promoted from
+  ``serve/metrics.py``; serving re-exports it unchanged.
+- :mod:`~hydragnn_tpu.obs.events` — structured run events: append-only
+  JSONL per run with a documented schema (manifest, per-epoch records,
+  checkpoint/guard/resume lifecycle).
+- :mod:`~hydragnn_tpu.obs.scalars` — backend-agnostic ``ScalarWriter``
+  fan-out (always-on JSONL/CSV, TensorBoard when torch is importable).
+- :mod:`~hydragnn_tpu.obs.http` — the stdlib ``/healthz`` + ``/metrics``
+  listener, shared by the predict server and live training runs.
+- :mod:`~hydragnn_tpu.obs.runtime` — per-run glue: ``RunTelemetry``,
+  ``TrainingMetrics``, and the no-op-when-inactive module hooks the
+  training code calls.
+
+Quick start (training side)::
+
+    HYDRAGNN_OBS_PORT=8090 python train.py   # live /metrics + /healthz
+    tail -f logs/<run>/events.jsonl          # structured run events
+"""
+
+from hydragnn_tpu.obs.events import (
+    EVENT_FIELDS,
+    SCHEMA_VERSION,
+    RunEventLog,
+    validate_events,
+)
+from hydragnn_tpu.obs.http import ObservabilityServer
+from hydragnn_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BOUNDS,
+    EPOCH_LATENCY_BOUNDS,
+    LatencyHistogram,
+    MetricsRegistry,
+    ServeMetrics,
+)
+from hydragnn_tpu.obs.runtime import (
+    RunTelemetry,
+    TrainingMetrics,
+    activate,
+    active,
+    deactivate,
+    init_run_telemetry,
+)
+from hydragnn_tpu.obs.scalars import ScalarWriter
+
+__all__ = [
+    "DEFAULT_LATENCY_BOUNDS",
+    "EPOCH_LATENCY_BOUNDS",
+    "EVENT_FIELDS",
+    "LatencyHistogram",
+    "MetricsRegistry",
+    "ObservabilityServer",
+    "RunEventLog",
+    "RunTelemetry",
+    "SCHEMA_VERSION",
+    "ScalarWriter",
+    "ServeMetrics",
+    "TrainingMetrics",
+    "activate",
+    "active",
+    "deactivate",
+    "init_run_telemetry",
+    "validate_events",
+]

@@ -1,0 +1,94 @@
+"""Stdlib ``/healthz`` + ``/metrics`` listener, shared by serving and training.
+
+Promoted out of ``hydragnn_tpu/serve/http.py`` (PR 2): the listener never
+cared that its provider was an inference server — it needs exactly two
+things, a ``health() -> dict`` method (``status`` key decides 200 vs 503)
+and a ``metrics.render_prometheus() -> str`` attribute. Training's
+:class:`~hydragnn_tpu.obs.runtime.RunTelemetry` satisfies the same
+protocol, so one listener serves both; ``hydragnn_tpu.serve.http``
+re-exports this class unchanged.
+
+``GET /healthz`` — JSON liveness/readiness; non-2xx when the provider
+reports a non-ok status, so a load balancer can eject the replica (or an
+operator can spot a wedged training job). ``GET /metrics`` — Prometheus
+text exposition.
+
+``http.server`` only (the container bakes in no web framework); the
+listener runs on a daemon thread and ``port=0`` binds an ephemeral port
+(tests and multi-replica hosts), readable from ``address`` after
+``start()``.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+
+class ObservabilityServer:
+    """Serves ``/healthz`` + ``/metrics`` for one provider object
+    (an :class:`~hydragnn_tpu.serve.server.InferenceServer`, a training
+    :class:`~hydragnn_tpu.obs.runtime.RunTelemetry`, ...)."""
+
+    def __init__(self, provider, port: int = 8080,
+                 host: str = "127.0.0.1"):
+        self._provider = provider
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        provider = self._provider
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path == "/healthz":
+                    health = provider.health()
+                    body = json.dumps(health).encode()
+                    code = 200 if health.get("status") == "ok" else 503
+                    ctype = "application/json"
+                elif self.path == "/metrics":
+                    body = provider.metrics.render_prometheus().encode()
+                    code = 200
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    body = b"not found: serve exposes /healthz and /metrics\n"
+                    code = 404
+                    ctype = "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrape spam off stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="hydragnn-observability",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def address(self) -> Optional[Tuple[str, int]]:
+        """(host, port) actually bound — port 0 resolves here."""
+        if self._httpd is None:
+            return None
+        return self._httpd.server_address[:2]
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        self._httpd = None

@@ -1,0 +1,420 @@
+"""Run-scoped telemetry: the glue between training code and obs primitives.
+
+One :class:`RunTelemetry` per training run bundles the three tentpole
+pieces — the structured event stream (``events.jsonl``), the live
+``/metrics``+``/healthz`` endpoint, and the training metrics registry —
+behind module-level hook functions (:func:`emit`, :func:`epoch_complete`,
+:func:`guard_skip`, ...) that the epoch driver, trainer, divergence
+guard, and checkpoint layer call unconditionally. (The trainer's per-step
+path resolves :func:`active` once per epoch and calls
+``metrics.on_step`` directly — one global read per epoch, not per step.)
+
+The hooks follow the fault-injection harness pattern
+(``utils/faults.py``): with no active telemetry each call is ONE global
+read and a return, so instrumented code costs nothing when observability
+is off — the acceptance bar is "telemetry-disabled epoch-loop wall time
+within noise of baseline", enforced by ``tests/test_observability.py``.
+
+Enablement (rank 0 only; other ranks keep the no-op hooks):
+
+- events + metrics: on by default for driver runs; ``HYDRAGNN_TELEMETRY=0``
+  or ``config["Telemetry"]["enable"] = false`` disables.
+- HTTP endpoint: opt-in — ``HYDRAGNN_OBS_PORT=<port>`` (0 = ephemeral)
+  or ``config["Telemetry"]["port"]``.
+"""
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from hydragnn_tpu.obs.events import SCHEMA_VERSION, RunEventLog
+from hydragnn_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BOUNDS,
+    EPOCH_LATENCY_BOUNDS,
+    MetricsRegistry,
+)
+
+_active: Optional["RunTelemetry"] = None
+
+
+class TrainingMetrics:
+    """The training run's live series — everything ``/metrics`` reports.
+
+    Built on the shared :class:`MetricsRegistry` core; serving's
+    ``ServeMetrics`` is the other client of the same machinery."""
+
+    def __init__(self):
+        r = MetricsRegistry("hydragnn_train")
+        r.counter("epochs_total", "Completed epochs")
+        r.counter("steps_total", "Dispatched optimizer steps")
+        r.counter("guard_skips_total", "Non-finite steps/epochs skipped")
+        r.counter("guard_restores_total", "Last-good restores (halved LR)")
+        r.counter("checkpoints_saved_total", "Checkpoint files written")
+        r.counter("compiles_total", "XLA compilations observed")
+        r.gauge("epoch", "Current epoch index")
+        r.gauge("train_loss", "Last epoch training loss")
+        r.gauge("val_loss", "Last epoch validation loss")
+        r.gauge("test_loss", "Last epoch test loss")
+        r.gauge("graphs_per_second", "Last epoch training throughput")
+        r.gauge("nodes_per_second", "Last epoch real-node-row throughput")
+        r.gauge(
+            "padding_waste_ratio",
+            "Padded node rows carrying no real node (training batches)",
+        )
+        r.gauge(
+            "heartbeat_age_seconds",
+            "Seconds since the training loop last reported progress",
+        )
+        r.histogram(
+            "epoch_seconds", "Epoch wall time", bounds=EPOCH_LATENCY_BOUNDS
+        )
+        r.histogram(
+            "step_dispatch_seconds",
+            "Host-side train-step dispatch latency",
+            bounds=DEFAULT_LATENCY_BOUNDS,
+        )
+        self.registry = r
+        self.last_beat = time.time()
+
+    def beat(self):
+        self.last_beat = time.time()
+
+    def on_step(self, seconds: float, count: int = 1):
+        self.registry.inc("steps_total", count)
+        self.registry.observe("step_dispatch_seconds", seconds)
+        # steps ARE progress: without this, heartbeat_age grows for the
+        # whole of a long epoch and stall alerts fire on healthy runs
+        self.last_beat = time.time()
+
+    def on_epoch(
+        self,
+        epoch: int,
+        train_loss: float,
+        val_loss: float,
+        test_loss: float,
+        seconds: Optional[float] = None,
+        graphs_per_sec: Optional[float] = None,
+        nodes_per_sec: Optional[float] = None,
+        padding_waste: Optional[float] = None,
+    ):
+        r = self.registry
+        r.inc("epochs_total")
+        r.set("epoch", float(epoch))
+        r.set("train_loss", float(train_loss))
+        r.set("val_loss", float(val_loss))
+        r.set("test_loss", float(test_loss))
+        if seconds is not None:
+            r.observe("epoch_seconds", seconds)
+        if graphs_per_sec is not None:
+            r.set("graphs_per_second", float(graphs_per_sec))
+        if nodes_per_sec is not None:
+            r.set("nodes_per_second", float(nodes_per_sec))
+        if padding_waste is not None:
+            r.set("padding_waste_ratio", float(padding_waste))
+        self.beat()
+
+    def render_prometheus(self) -> str:
+        self.registry.set(
+            "heartbeat_age_seconds", max(time.time() - self.last_beat, 0.0)
+        )
+        return self.registry.render_prometheus()
+
+    def snapshot(self) -> Dict:
+        return self.registry.snapshot()
+
+
+_compile_listener_registered = False
+
+
+def _register_compile_listener():
+    """Count XLA compilations via jax's monitoring events when the API is
+    available (it is internal-ish; absence just leaves the counter at 0).
+    ONE process-global listener routing to whatever telemetry is active —
+    jax has no unregister API, so a per-run listener would leak a closure
+    (and retain its metrics) for every run in a long-lived process."""
+    global _compile_listener_registered
+    if _compile_listener_registered:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float = 0.0, **kwargs):
+            # '/jax/core/compile/backend_compile_duration' fires once per
+            # actual XLA compilation (cache hits don't reach the backend)
+            t = _active
+            if t is not None and "backend_compile" in event:
+                t.metrics.registry.inc("compiles_total")
+
+        if hasattr(monitoring, "register_event_duration_secs_listener"):
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            _compile_listener_registered = True
+    except Exception:
+        pass
+
+
+def _config_hash(config: dict) -> str:
+    try:
+        blob = json.dumps(config, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        blob = repr(config)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _git_rev() -> str:
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+class RunTelemetry:
+    """Everything observable about one training run, under one lifetime.
+
+    Satisfies the :class:`~hydragnn_tpu.obs.http.ObservabilityServer`
+    provider protocol (``health()`` + ``.metrics.render_prometheus()``),
+    so the serving listener exposes a live training job unchanged."""
+
+    def __init__(
+        self,
+        run_name: str,
+        log_dir: str,
+        port: Optional[int] = None,
+        events: bool = True,
+    ):
+        self.run_name = run_name
+        self.log_dir = log_dir
+        self.metrics = TrainingMetrics()
+        self.events: Optional[RunEventLog] = (
+            RunEventLog(os.path.join(log_dir, "events.jsonl"))
+            if events
+            else None
+        )
+        self.server = None
+        self._closed = False
+        _register_compile_listener()
+        if port is not None:
+            from hydragnn_tpu.obs.http import ObservabilityServer
+
+            self.server = ObservabilityServer(self, port=port).start()
+
+    # ---- provider protocol ---------------------------------------------
+    def health(self) -> Dict:
+        s = self.metrics.snapshot()
+        return {
+            "status": "ok" if not self._closed else "stopped",
+            "run": self.run_name,
+            "epoch": int(s["epoch"]),
+            "epochs_total": s["epochs_total"],
+            "heartbeat_age_s": round(
+                max(time.time() - self.metrics.last_beat, 0.0), 3
+            ),
+        }
+
+    @property
+    def address(self):
+        return None if self.server is None else self.server.address
+
+    # ---- lifecycle -----------------------------------------------------
+    def emit(self, event: str, **fields):
+        if self.events is not None:
+            self.events.emit(event, **fields)
+
+    def emit_manifest(self, config: dict, run_name: str):
+        import jax
+
+        devices = jax.devices()
+        self.emit(
+            "run_manifest",
+            schema_version=SCHEMA_VERSION,
+            run=run_name,
+            config_hash=_config_hash(config),
+            git_rev=_git_rev(),
+            world_size=jax.process_count(),
+            device_kind=devices[0].platform if devices else "none",
+            device_count=len(devices),
+            num_epoch=int(
+                config.get("NeuralNetwork", {})
+                .get("Training", {})
+                .get("num_epoch", 0)
+            ),
+        )
+
+    def close(self, status: str = "complete"):
+        if self._closed:
+            return
+        self._closed = True
+        self.emit("run_end", status=status)
+        if self.events is not None:
+            self.events.close()
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+
+# ---- module-level hooks (no-op fast path when no run is active) ----------
+
+
+def active() -> Optional[RunTelemetry]:
+    return _active
+
+
+def activate(telemetry: RunTelemetry):
+    global _active
+    prev = _active
+    _active = telemetry
+    if prev is not None and prev is not telemetry:
+        # a run that never deactivated (crashed between init and its
+        # cleanup) must not leak its event-stream handle into this one
+        prev.close(status="abandoned")
+    return telemetry
+
+
+def deactivate(status: str = "complete"):
+    global _active
+    t = _active
+    _active = None
+    if t is not None:
+        t.close(status)
+
+
+def emit(event: str, **fields):
+    t = _active
+    if t is not None:
+        t.emit(event, **fields)
+
+
+def epoch_complete(
+    epoch: int,
+    train_loss,
+    val_loss,
+    test_loss,
+    seconds=None,
+    graphs_per_sec=None,
+    nodes_per_sec=None,
+    padding_waste=None,
+    mode: str = "stream",
+):
+    t = _active
+    if t is None:
+        return
+    t.metrics.on_epoch(
+        int(epoch),
+        float(train_loss),
+        float(val_loss),
+        float(test_loss),
+        seconds=seconds,
+        graphs_per_sec=graphs_per_sec,
+        nodes_per_sec=nodes_per_sec,
+        padding_waste=padding_waste,
+    )
+    t.emit(
+        "epoch",
+        epoch=int(epoch),
+        train_loss=float(train_loss),
+        val_loss=float(val_loss),
+        test_loss=float(test_loss),
+        mode=mode,
+        **(
+            {}
+            if seconds is None
+            else {
+                "wall_time_s": round(float(seconds), 6),
+                "graphs_per_sec": (
+                    None
+                    if graphs_per_sec is None
+                    else round(float(graphs_per_sec), 3)
+                ),
+                "nodes_per_sec": (
+                    None
+                    if nodes_per_sec is None
+                    else round(float(nodes_per_sec), 3)
+                ),
+            }
+        ),
+        **(
+            {}
+            if padding_waste is None
+            else {"padding_waste": round(float(padding_waste), 6)}
+        ),
+    )
+
+
+def guard_skip(scope: str, skipped: int, streak: int = 0):
+    t = _active
+    if t is None:
+        return
+    t.metrics.registry.inc("guard_skips_total")
+    t.emit("guard_skip", scope=scope, skipped=int(skipped),
+           streak=int(streak))
+
+
+def guard_restore(restores: int, lr: float):
+    t = _active
+    if t is None:
+        return
+    t.metrics.registry.inc("guard_restores_total")
+    t.emit("guard_restore", restores=int(restores), lr=float(lr))
+
+
+def checkpoint_saved(name: str, kind: str, **fields):
+    t = _active
+    if t is None:
+        return
+    t.metrics.registry.inc("checkpoints_saved_total")
+    t.emit("checkpoint_saved", name=name, kind=kind, **fields)
+
+
+def checkpoint_restored(name: str, source: str):
+    t = _active
+    if t is None:
+        return
+    t.emit("checkpoint_restored", name=name, source=source)
+
+
+# ---- run construction ----------------------------------------------------
+
+
+def init_run_telemetry(
+    config: dict, log_name: str, path: str = "./logs/"
+) -> Optional[RunTelemetry]:
+    """Build + activate telemetry for a driver run, honoring the env/config
+    knobs (module docstring). Returns None (hooks stay no-ops) on
+    non-zero ranks or when disabled."""
+    from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+    _, rank = get_comm_size_and_rank()
+    if rank != 0:
+        return None
+    tcfg = config.get("Telemetry", {}) or {}
+    env = os.getenv("HYDRAGNN_TELEMETRY")
+    enabled = (
+        env.strip().lower() not in ("", "0", "false", "no", "off")
+        if env is not None
+        else bool(tcfg.get("enable", True))
+    )
+    if not enabled:
+        return None
+    port_env = os.getenv("HYDRAGNN_OBS_PORT")
+    port: Optional[int]
+    if port_env is not None and port_env.strip() != "":
+        port = int(port_env)
+    elif tcfg.get("port") is not None:
+        port = int(tcfg["port"])
+    else:
+        port = None
+    telemetry = RunTelemetry(
+        log_name, os.path.join(path, log_name), port=port
+    )
+    telemetry.emit_manifest(config, log_name)
+    return activate(telemetry)

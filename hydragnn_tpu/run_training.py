@@ -3,12 +3,21 @@
 Accepts a config dict or a path to a JSON config file; orchestrates
 distributed setup -> data loading/splitting -> config derivation -> model ->
 optimizer -> train/validate/test -> checkpoint save.
+
+Every driver run records unified telemetry (docs/observability.md): a
+structured ``events.jsonl`` stream and per-epoch scalars under
+``./logs/<run>/``, and — when ``telemetry_port`` /
+``config["Telemetry"]["port"]`` / ``HYDRAGNN_OBS_PORT`` opts in — a live
+``/metrics`` + ``/healthz`` endpoint for the duration of the run.
+``HYDRAGNN_TELEMETRY=0`` disables the event stream, metrics, and endpoint
+(the plain-file scalar backend stays on — every run keeps its loss
+curves).
 """
 
 import json
 
 
-def run_training(config, use_devices=None):
+def run_training(config, use_devices=None, telemetry_port=None):
     # same contract as run_prediction: the argument was accepted and
     # silently ignored since the facade was ported — fail loudly instead
     if use_devices is not None:
@@ -20,6 +29,14 @@ def run_training(config, use_devices=None):
     if isinstance(config, str):
         with open(config, "r") as f:
             config = json.load(f)
+    if telemetry_port is not None:
+        # programmatic opt-in to the live training endpoint (0 = ephemeral
+        # port); equivalent to config["Telemetry"]["port"], and still
+        # overridable by HYDRAGNN_OBS_PORT (env beats config, the framework
+        # convention)
+        config = dict(config)
+        config["Telemetry"] = dict(config.get("Telemetry", {}) or {})
+        config["Telemetry"]["port"] = int(telemetry_port)
     from hydragnn_tpu.train.driver import run_training_impl
 
     return run_training_impl(config)

@@ -1,85 +1,15 @@
-"""Stdlib observability endpoint pair for the predict server.
+"""Serving observability endpoint — re-export of the shared listener.
 
-``GET /healthz`` — JSON liveness/readiness (registry contents, warmup
-state, queue depth); non-2xx when the server is stopped, so a load
-balancer can eject the replica. ``GET /metrics`` — Prometheus text
-exposition of :class:`~hydragnn_tpu.serve.metrics.ServeMetrics`.
-
-``http.server`` only (the container bakes in no web framework); the
-listener runs on a daemon thread and ``port=0`` binds an ephemeral port
-(tests and multi-replica hosts), readable from ``address`` after
-``start()``.
+The stdlib ``/healthz`` + ``/metrics`` listener that started here (PR 2)
+was promoted to :mod:`hydragnn_tpu.obs.http`: it only ever needed a
+provider with ``health()`` and ``metrics.render_prometheus()``, which an
+:class:`~hydragnn_tpu.serve.server.InferenceServer` and a training
+:class:`~hydragnn_tpu.obs.runtime.RunTelemetry` both satisfy. This module
+keeps the historical import path alive with an unchanged public API.
 """
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
+from hydragnn_tpu.obs.http import (  # noqa: F401  (re-exported API)
+    ObservabilityServer,
+)
 
-
-class ObservabilityServer:
-    """Serves ``/healthz`` + ``/metrics`` for one
-    :class:`~hydragnn_tpu.serve.server.InferenceServer`."""
-
-    def __init__(self, inference_server, port: int = 8080,
-                 host: str = "127.0.0.1"):
-        self._inference = inference_server
-        self._host = host
-        self._port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self):
-        if self._httpd is not None:
-            return self
-        inference = self._inference
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib naming)
-                if self.path == "/healthz":
-                    health = inference.health()
-                    body = json.dumps(health).encode()
-                    code = 200 if health.get("status") == "ok" else 503
-                    ctype = "application/json"
-                elif self.path == "/metrics":
-                    body = inference.metrics.render_prometheus().encode()
-                    code = 200
-                    ctype = "text/plain; version=0.0.4"
-                else:
-                    body = b"not found: serve exposes /healthz and /metrics\n"
-                    code = 404
-                    ctype = "text/plain"
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):  # scrape spam off stderr
-                pass
-
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="hydragnn-serve-observability",
-            daemon=True,
-        )
-        self._thread.start()
-        return self
-
-    @property
-    def address(self) -> Optional[Tuple[str, int]]:
-        """(host, port) actually bound — port 0 resolves here."""
-        if self._httpd is None:
-            return None
-        return self._httpd.server_address[:2]
-
-    def stop(self):
-        if self._httpd is None:
-            return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(5.0)
-            self._thread = None
-        self._httpd = None
+__all__ = ["ObservabilityServer"]

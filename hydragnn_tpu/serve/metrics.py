@@ -1,224 +1,24 @@
-"""Serving metrics: counters, gauges, latency histograms.
+"""Serving metrics — re-export of the shared observability core.
 
-The observability contract of the predict server (docs/serving.md
-"Metrics schema"): every number the ``/metrics`` endpoint reports is
-accumulated here, under one lock, by the request and batcher threads.
-Stdlib-only by design — the repo bakes in no prometheus_client; the
-Prometheus text exposition format is simple enough to emit directly, and
-``snapshot()`` returns the same numbers as a plain dict for tests,
-benchmarks, and ``/healthz``.
+The ``MetricsRegistry``/``LatencyHistogram``/Prometheus-text machinery
+that started here (PR 2) was promoted to :mod:`hydragnn_tpu.obs.metrics`
+so training and serving report through ONE implementation; this module
+keeps the historical import path alive with an unchanged public API.
+``/metrics`` output is byte-identical to the pre-refactor module (locked
+by ``tests/test_observability.py``). The serving metrics contract itself
+is documented in docs/serving.md ("Metrics schema").
 """
 
-import bisect
-import threading
-from typing import Dict, List
-
-# log-spaced seconds, 500us .. 10s — single-graph GNN inference spans
-# ~1ms (warm CPU/TPU bucket hit) to seconds (cold compile / queueing)
-DEFAULT_LATENCY_BOUNDS = (
-    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
-    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+from hydragnn_tpu.obs.metrics import (  # noqa: F401  (re-exported API)
+    DEFAULT_LATENCY_BOUNDS,
+    LatencyHistogram,
+    MetricsRegistry,
+    ServeMetrics,
 )
 
-
-class LatencyHistogram:
-    """Fixed-bound histogram with quantile estimates.
-
-    Quantiles interpolate linearly inside the winning bucket (the
-    Prometheus ``histogram_quantile`` rule) — exact enough for p50/p99
-    reporting without retaining per-request samples."""
-
-    def __init__(self, bounds=DEFAULT_LATENCY_BOUNDS):
-        self.bounds: List[float] = list(bounds)
-        self.counts: List[int] = [0] * (len(self.bounds) + 1)  # +inf tail
-        self.total = 0
-        self.sum = 0.0
-
-    def observe(self, seconds: float):
-        self.counts[bisect.bisect_left(self.bounds, seconds)] += 1
-        self.total += 1
-        self.sum += seconds
-
-    def quantile(self, q: float) -> float:
-        """Estimated q-quantile in seconds (0 with no observations; the
-        last finite bound when the target falls in the +inf tail)."""
-        if self.total == 0:
-            return 0.0
-        target = q * self.total
-        seen = 0
-        for i, c in enumerate(self.counts):
-            if seen + c >= target and c > 0:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = (
-                    self.bounds[i]
-                    if i < len(self.bounds)
-                    else self.bounds[-1]
-                )
-                return lo + (hi - lo) * (target - seen) / c
-            seen += c
-        return self.bounds[-1]
-
-    def state(self) -> Dict:
-        return {
-            "count": self.total,
-            "sum": round(self.sum, 6),
-            "p50": round(self.quantile(0.50), 6),
-            "p99": round(self.quantile(0.99), 6),
-        }
-
-
-class ServeMetrics:
-    """All counters the predict server reports (thread-safe).
-
-    ``requests_total`` counts every accepted submit; a request then ends
-    in exactly one of ``responses_total``, ``timeouts_total``, or
-    ``errors_total``. ``shed_total`` counts queue-full rejections (never
-    accepted, so not in ``requests_total``). Padding waste is tracked as
-    the two raw integrals (real vs padded node rows) so the ratio stays
-    exact under any aggregation window."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.requests_total = 0
-        self.responses_total = 0
-        self.shed_total = 0
-        self.timeouts_total = 0
-        self.errors_total = 0
-        self.batches_total = 0
-        self.compiles_total = 0
-        self.bucket_hits: Dict[int, int] = {}
-        self.bucket_fallbacks = 0  # graph served by a larger bucket
-        self.real_node_rows = 0
-        self.padded_node_rows = 0
-        self.queue_depth = 0
-        self.request_latency = LatencyHistogram()
-        self.batch_latency = LatencyHistogram()
-
-    # ---- recording -----------------------------------------------------
-    def on_submit(self):
-        with self._lock:
-            self.requests_total += 1
-
-    def on_shed(self):
-        with self._lock:
-            self.shed_total += 1
-
-    def on_timeout(self, n: int = 1):
-        with self._lock:
-            self.timeouts_total += n
-
-    def on_error(self, n: int = 1):
-        with self._lock:
-            self.errors_total += n
-
-    def on_compile(self):
-        with self._lock:
-            self.compiles_total += 1
-
-    def set_queue_depth(self, depth: int):
-        with self._lock:
-            self.queue_depth = depth
-
-    def on_batch(
-        self,
-        bucket: int,
-        num_requests: int,
-        real_nodes: int,
-        padded_nodes: int,
-        batch_seconds: float,
-        fallbacks: int = 0,
-    ):
-        with self._lock:
-            self.batches_total += 1
-            self.responses_total += num_requests
-            self.bucket_hits[bucket] = (
-                self.bucket_hits.get(bucket, 0) + num_requests
-            )
-            self.bucket_fallbacks += fallbacks
-            self.real_node_rows += real_nodes
-            self.padded_node_rows += padded_nodes
-            self.batch_latency.observe(batch_seconds)
-
-    def on_response_latency(self, seconds: float):
-        with self._lock:
-            self.request_latency.observe(seconds)
-
-    # ---- reading -------------------------------------------------------
-    def padding_waste_ratio(self) -> float:
-        """Fraction of padded node rows that carried no real node — 0 is
-        a perfectly full batch, 1-ish means the padding dominates."""
-        with self._lock:
-            if self.padded_node_rows == 0:
-                return 0.0
-            return 1.0 - self.real_node_rows / self.padded_node_rows
-
-    def snapshot(self) -> Dict:
-        with self._lock:
-            return {
-                "requests_total": self.requests_total,
-                "responses_total": self.responses_total,
-                "shed_total": self.shed_total,
-                "timeouts_total": self.timeouts_total,
-                "errors_total": self.errors_total,
-                "batches_total": self.batches_total,
-                "compiles_total": self.compiles_total,
-                "bucket_hits": dict(self.bucket_hits),
-                "bucket_fallbacks": self.bucket_fallbacks,
-                "queue_depth": self.queue_depth,
-                "padding_waste_ratio": round(
-                    0.0
-                    if self.padded_node_rows == 0
-                    else 1.0 - self.real_node_rows / self.padded_node_rows,
-                    6,
-                ),
-                "request_latency": self.request_latency.state(),
-                "batch_latency": self.batch_latency.state(),
-            }
-
-    def render_prometheus(self, prefix: str = "hydragnn_serve") -> str:
-        """Prometheus text exposition of :meth:`snapshot`."""
-        s = self.snapshot()
-        lines = []
-
-        def counter(name, value, help_text):
-            lines.append(f"# HELP {prefix}_{name} {help_text}")
-            kind = "gauge" if name.endswith(("_depth", "_ratio")) else "counter"
-            lines.append(f"# TYPE {prefix}_{name} {kind}")
-            lines.append(f"{prefix}_{name} {value}")
-
-        counter("requests_total", s["requests_total"], "Accepted requests")
-        counter("responses_total", s["responses_total"], "Completed requests")
-        counter("shed_total", s["shed_total"], "Queue-full rejections")
-        counter("timeouts_total", s["timeouts_total"], "Deadline expiries")
-        counter("errors_total", s["errors_total"], "Failed requests")
-        counter("batches_total", s["batches_total"], "Dispatched micro-batches")
-        counter("compiles_total", s["compiles_total"], "Novel-shape compiles")
-        counter(
-            "bucket_fallbacks_total",
-            s["bucket_fallbacks"],
-            "Requests served by a larger bucket than their node count",
-        )
-        counter("queue_depth", s["queue_depth"], "Requests waiting")
-        counter(
-            "padding_waste_ratio",
-            s["padding_waste_ratio"],
-            "Padded node rows carrying no real node",
-        )
-        for b, hits in sorted(s["bucket_hits"].items()):
-            lines.append(
-                f'{prefix}_bucket_hits_total{{bucket="{b}"}} {hits}'
-            )
-        for name, hist in (
-            ("request_latency_seconds", s["request_latency"]),
-            ("batch_latency_seconds", s["batch_latency"]),
-        ):
-            lines.append(f"# TYPE {prefix}_{name} summary")
-            lines.append(
-                f'{prefix}_{name}{{quantile="0.5"}} {hist["p50"]}'
-            )
-            lines.append(
-                f'{prefix}_{name}{{quantile="0.99"}} {hist["p99"]}'
-            )
-            lines.append(f"{prefix}_{name}_sum {hist['sum']}")
-            lines.append(f"{prefix}_{name}_count {hist['count']}")
-        return "\n".join(lines) + "\n"
+__all__ = [
+    "DEFAULT_LATENCY_BOUNDS",
+    "LatencyHistogram",
+    "MetricsRegistry",
+    "ServeMetrics",
+]

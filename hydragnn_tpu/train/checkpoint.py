@@ -173,6 +173,14 @@ def save_model(
     keep = _resolve_keep_last(keep_last)
     if keep > 0:
         _retain_rolling(out_dir, name, header + blob, keep)
+    from hydragnn_tpu.obs import runtime as obs
+
+    obs.checkpoint_saved(
+        name,
+        kind="best" if name.endswith("-best") else "primary",
+        resumable=train_meta is not None,
+        bytes=len(header) + len(blob),
+    )
     faults.corrupt_checkpoint(final)
 
 
@@ -218,11 +226,15 @@ def load_state_dict(
     from-the-future format version is always refused — silently resuming
     older weights in that situation would not be an accident, it would be
     a downgrade."""
+    from hydragnn_tpu.obs import runtime as obs
+
     fname = os.path.join(path, name, name + ".pk")
     try:
         with open(fname, "rb") as f:
             raw = f.read()
-        return _parse_checkpoint_bytes(raw, fname)
+        restored = _parse_checkpoint_bytes(raw, fname)
+        obs.checkpoint_restored(name, source="primary")
+        return restored
     except (ValueError, OSError) as primary_err:
         is_version_refusal = (
             isinstance(primary_err, ValueError)
@@ -242,6 +254,9 @@ def load_state_dict(
             warnings.warn(
                 f"checkpoint {fname} unreadable ({primary_err}); restored "
                 f"last-good rolling checkpoint {os.path.basename(roll)}"
+            )
+            obs.checkpoint_restored(
+                name, source=f"rolling:{os.path.basename(roll)}"
             )
             return restored
         raise

@@ -24,6 +24,7 @@ from hydragnn_tpu.train.checkpoint import (
     rolling_checkpoints,
     save_model,
 )
+from hydragnn_tpu.obs import runtime as obs
 from hydragnn_tpu.train.trainer import Trainer, train_validate_test
 from hydragnn_tpu.utils import tracer as tr
 from hydragnn_tpu.utils.config import (
@@ -45,17 +46,14 @@ def _arch_for_factory(config) -> dict:
 
 
 def _get_summary_writer(log_name):
-    from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+    """Rank-0 scalar writer. Historically this returned a bare TensorBoard
+    ``SummaryWriter`` — or silently None when torch was missing, i.e. no
+    scalars at all. Now it is the :class:`~hydragnn_tpu.obs.scalars.
+    ScalarWriter` fan-out: an always-on JSONL/CSV backend plus TensorBoard
+    when importable (its absence warned exactly once, on rank 0)."""
+    from hydragnn_tpu.obs.scalars import ScalarWriter
 
-    _, rank = get_comm_size_and_rank()
-    if rank != 0:
-        return None
-    try:
-        from torch.utils.tensorboard import SummaryWriter
-
-        return SummaryWriter("./logs/" + log_name)
-    except Exception:
-        return None
+    return ScalarWriter.for_run(log_name)
 
 
 def _build_model_and_trainer(config, train_loader, verbosity):
@@ -167,57 +165,93 @@ def run_training_impl(config):
     log_name = get_log_name_config(config)
     setup_log(log_name)
     save_config(config, log_name)
+    # unified telemetry (rank 0): events.jsonl + training metrics, plus the
+    # live /metrics+/healthz endpoint when HYDRAGNN_OBS_PORT or
+    # config["Telemetry"]["port"] opts in; HYDRAGNN_TELEMETRY=0 disables
+    telemetry = obs.init_run_telemetry(config, log_name)
 
-    model, trainer, state = _build_model_and_trainer(
-        config, train_loader, verbosity
-    )
-
-    training = config["NeuralNetwork"]["Training"]
-    resume_meta = None
-    if "continue" in training and training["continue"]:
-        model_name = training.get("startfrom", log_name)
-        # a lost/deleted primary with intact rolling copies is still
-        # resumable — load_state_dict walks back to the newest good one
-        if checkpoint_exists(model_name) or rolling_checkpoints(model_name):
-            restored = load_state_dict(model_name)
-            # v2 checkpoints carry the training-loop state — honored ONLY
-            # when continuing THIS run (preemption resume). A 'startfrom'
-            # of some other run is a warm start: its epoch counter must
-            # not eat this run's training budget, so the meta is stripped
-            # and training runs from epoch 0 on the restored weights.
-            meta = pop_train_meta(restored)
-            if model_name == log_name:
-                resume_meta = meta
-            state = trainer.place_state(restore_into(state, restored))
-
-    writer = _get_summary_writer(log_name)
-    vis_cfg = config.get("Visualization", {})
-    state = train_validate_test(
-        trainer,
-        state,
-        train_loader,
-        val_loader,
-        test_loader,
-        config["NeuralNetwork"],
-        log_name,
-        verbosity,
-        writer=writer,
-        create_plots=vis_cfg.get("create_plots", False),
-        plot_init_solution=vis_cfg.get("plot_init_solution", False),
-        resume_meta=resume_meta,
-    )
-    # the epoch driver saves a resumable checkpoint at the final epoch on
-    # its own; repeating the (collective-heavy) consolidation here would
-    # only rewrite identical bytes
-    if not getattr(trainer, "final_state_saved", False):
-        save_model(
-            state,
-            log_name,
-            train_meta=getattr(trainer, "final_train_meta", None),
+    writer = None
+    try:
+        model, trainer, state = _build_model_and_trainer(
+            config, train_loader, verbosity
         )
-    timer.stop()
-    print_timers(verbosity)
-    tr.save(f"./logs/{log_name}/trace")
+
+        training = config["NeuralNetwork"]["Training"]
+        resume_meta = None
+        if "continue" in training and training["continue"]:
+            model_name = training.get("startfrom", log_name)
+            # a lost/deleted primary with intact rolling copies is still
+            # resumable — load_state_dict walks back to the newest good one
+            if checkpoint_exists(model_name) or rolling_checkpoints(model_name):
+                restored = load_state_dict(model_name)
+                # v2 checkpoints carry the training-loop state — honored ONLY
+                # when continuing THIS run (preemption resume). A 'startfrom'
+                # of some other run is a warm start: its epoch counter must
+                # not eat this run's training budget, so the meta is stripped
+                # and training runs from epoch 0 on the restored weights.
+                meta = pop_train_meta(restored)
+                if model_name == log_name:
+                    resume_meta = meta
+                state = trainer.place_state(restore_into(state, restored))
+
+        writer = _get_summary_writer(log_name)
+        vis_cfg = config.get("Visualization", {})
+        state = train_validate_test(
+            trainer,
+            state,
+            train_loader,
+            val_loader,
+            test_loader,
+            config["NeuralNetwork"],
+            log_name,
+            verbosity,
+            writer=writer,
+            create_plots=vis_cfg.get("create_plots", False),
+            plot_init_solution=vis_cfg.get("plot_init_solution", False),
+            resume_meta=resume_meta,
+        )
+        # the epoch driver saves a resumable checkpoint at the final epoch
+        # on its own; repeating the (collective-heavy) consolidation here
+        # would only rewrite identical bytes
+        if not getattr(trainer, "final_state_saved", False):
+            save_model(
+                state,
+                log_name,
+                train_meta=getattr(trainer, "final_train_meta", None),
+            )
+        timer.stop()
+        print_timers(verbosity)
+        tr.save(f"./logs/{log_name}/trace")
+        # end-of-run region attribution: the scalar fan-out is ALWAYS-ON
+        # (it must not depend on the event/metrics telemetry being
+        # enabled), the event-stream copy rides along when telemetry is on
+        regions = tr.totals()
+        if regions:
+            if writer is not None:
+                num_epoch = config["NeuralNetwork"]["Training"]["num_epoch"]
+                writer.add_regions(regions, step=num_epoch)
+            if telemetry is not None:
+                telemetry.emit(
+                    "tracer_totals",
+                    regions={k: round(v, 6) for k, v in regions.items()},
+                )
+    except BaseException:
+        # the event stream must record that the run died — a log that only
+        # ever says "complete" is useless for postmortems. The whole
+        # post-init span is covered: a failure in the final save / tracer
+        # dump must not leave /healthz reporting ok with no run_end.
+        try:
+            if writer is not None:
+                writer.close()
+        finally:
+            obs.deactivate(status="failed")
+        raise
+    try:
+        if writer is not None:
+            writer.close()
+    finally:
+        # run_end must land even if a scalar backend fails to close
+        obs.deactivate(status="complete")
     return state
 
 

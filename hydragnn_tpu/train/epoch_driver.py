@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hydragnn_tpu.obs import runtime as obs
 from hydragnn_tpu.train.checkpoint import save_model
 from hydragnn_tpu.train.common import SchedState, TrainState, _env_flag, _is_oom
 from hydragnn_tpu.train.optimizer import (
@@ -163,6 +164,9 @@ def train_validate_test(
             f"Resuming training at epoch {start_epoch} "
             f"(lr {scheduler.lr:.3e})",
         )
+        obs.emit(
+            "resume", start_epoch=int(start_epoch), lr=float(scheduler.lr)
+        )
         # nothing left to train -> the just-restored state IS the
         # checkpoint content; the driver need not rewrite it
         trainer.final_state_saved = start_epoch >= num_epoch
@@ -233,7 +237,7 @@ def train_validate_test(
     )
 
     def _log_epoch(ep, train_loss, val_loss, test_loss, train_tasks,
-                   t_train=None):
+                   t_train=None, mode="stream"):
         total_loss_train[ep] = train_loss
         total_loss_val[ep] = val_loss
         total_loss_test[ep] = test_loss
@@ -259,6 +263,35 @@ def train_validate_test(
             writer.add_scalar("test error", test_loss, ep)
             for itask, tl in enumerate(np.atleast_1d(train_tasks)):
                 writer.add_scalar(f"train error of task {itask}", float(tl), ep)
+        if obs.active() is not None:
+            # throughput + padding-waste accounting only when telemetry is
+            # live — the stats walk the loader's epoch plan. Both rates
+            # are PER-HOST (this process's shard), so graphs/s and nodes/s
+            # stay mutually consistent under multi-host sharding.
+            graphs_per_sec = nodes_per_sec = waste = None
+            stats = None
+            if hasattr(train_loader, "epoch_padding_stats"):
+                try:
+                    stats = train_loader.epoch_padding_stats()
+                except Exception:
+                    stats = None
+            if stats is not None and stats[1]:
+                waste = 1.0 - stats[0] / stats[1]
+            if t_train:
+                try:
+                    n = len(train_loader.dataset)
+                except TypeError:
+                    n = 0
+                shards = getattr(train_loader, "num_shards", 1) or 1
+                if n:
+                    graphs_per_sec = -(-n // shards) / t_train
+                if stats is not None:
+                    nodes_per_sec = stats[0] / t_train
+            obs.epoch_complete(
+                ep, train_loss, val_loss, test_loss, seconds=t_train,
+                graphs_per_sec=graphs_per_sec, nodes_per_sec=nodes_per_sec,
+                padding_waste=waste, mode=mode,
+            )
 
     ran_fit = staged is not None and fit_chunk > 0
     if ran_fit:
@@ -322,15 +355,26 @@ def train_validate_test(
                 pad_to=fit_chunk,
             )
             chunk_time = time.time() - t0
+            obs.emit(
+                "fit_chunk",
+                epoch_start=int(epoch0),
+                epochs=int(n),
+                wall_time_s=round(chunk_time, 6),
+            )
             for i in range(n):
                 if np.isnan(series["train_loss"][i]):
                     continue
+                # the chunk is ONE dispatch; chunk_time / n is the honest
+                # per-epoch attribution (and the only one available — the
+                # fit path used to report no train time or graphs/sec)
                 _log_epoch(
                     epoch0 + i,
                     series["train_loss"][i],
                     series["val_loss"][i],
                     series["test_loss"][i],
                     series["train_tasks"][i],
+                    t_train=chunk_time / n,
+                    mode="fit",
                 )
             if guard is not None:
                 # chunk-granular divergence guard: trailing NaN rows with
@@ -394,6 +438,7 @@ def train_validate_test(
                 print_distributed(
                     verbosity, f"Early stopping at epoch {ep_stop}"
                 )
+                obs.emit("early_stop", epoch=int(ep_stop))
                 break
             # the next unit of work is an indivisible fit_chunk-epoch
             # dispatch — reserve a whole chunk's wall time, not one epoch's
@@ -401,6 +446,7 @@ def train_validate_test(
                 print_distributed(
                     verbosity, "Stopping: not enough job wall-clock time left"
                 )
+                obs.emit("wallclock_stop", epoch=int(epoch0 - 1))
                 break
 
     epoch_time = 0.0
@@ -496,6 +542,7 @@ def train_validate_test(
         _log_epoch(
             epoch, train_loss, val_loss, test_loss, train_tasks,
             t_train=t_train,
+            mode="staged" if staged is not None else "stream",
         )
 
         if visualizer is not None and visualizer.plot_hist_solution:
@@ -525,6 +572,7 @@ def train_validate_test(
             trainer.final_state_saved = True
         if stopping:
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
+            obs.emit("early_stop", epoch=int(epoch))
             break
 
         epoch_time = time.time() - t0
@@ -546,6 +594,7 @@ def train_validate_test(
             print_distributed(
                 verbosity, "Stopping: not enough job wall-clock time left"
             )
+            obs.emit("wallclock_stop", epoch=int(epoch))
             break
 
     if visualizer is not None:

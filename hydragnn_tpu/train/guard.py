@@ -27,6 +27,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from hydragnn_tpu.obs import runtime as obs
 from hydragnn_tpu.train.optimizer import get_learning_rate, set_learning_rate
 
 
@@ -93,6 +94,7 @@ class DivergenceGuard:
         the LR halved. Raises ``RuntimeError`` past the restore bound."""
         self.bad_streak += 1
         self.skipped += 1
+        obs.guard_skip("step", self.skipped, streak=self.bad_streak)
         if self.bad_streak < self.max_bad_steps or self.last_good is None:
             return prev_state
         return self._restore()
@@ -104,6 +106,7 @@ class DivergenceGuard:
         but still COUNTS against the restore bound — an unbounded silent
         NaN run must be impossible regardless of call order."""
         self.skipped += 1
+        obs.guard_skip("epoch", self.skipped)
         if self.last_good is None:
             self.restores += 1
             if self.restores > self.max_restores:
@@ -133,6 +136,7 @@ class DivergenceGuard:
         )
         # keep halving across successive restores, not oscillating back up
         self.last_good = self._copy(restored)
+        obs.guard_restore(self.restores, lr)
         return restored
 
     def state_dict(self) -> dict:

@@ -17,6 +17,7 @@ module re-exports the public names so existing imports keep working.
 """
 
 import os
+import time
 from typing import Optional
 
 import jax
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hydragnn_tpu.graph.batch import GraphBatch
+from hydragnn_tpu.obs import runtime as obs
 from hydragnn_tpu.models.create import init_model_params
 from hydragnn_tpu.train.common import (  # noqa: F401  (re-exported API)
     SchedState,
@@ -366,7 +368,9 @@ class Trainer(PredictMixin):
         loop entirely, which otherwise bound small-graph workloads."""
         from hydragnn_tpu.graph.batch import stack_batches
 
-        return self.put_batch_stacked(stack_batches(list(batches)))
+        batches = list(batches)
+        obs.emit("staged", num_batches=len(batches))
+        return self.put_batch_stacked(stack_batches(batches))
 
     def train_epoch_staged(self, state, staged, rng, shuffle=True):
         """One epoch over an HBM-staged dataset in a single dispatch.
@@ -629,6 +633,9 @@ class Trainer(PredictMixin):
         if guard is not None and guard.last_good is None:
             guard.commit(state)
         tr.start("train")
+        # resolved once per epoch: the per-step telemetry hooks must cost
+        # one global read when observability is off
+        _telemetry = obs.active()
         plan = self._group_plan(loader, nbatch, K)
         for dev, count in self._prefetch_put(
             plan, float("inf"), self.device_prefetch, put=self._put_group
@@ -637,7 +644,12 @@ class Trainer(PredictMixin):
                 subs = jax.random.split(rng, count + 1)
                 rng = subs[0]
                 tr.start("train_step")
+                t0 = time.perf_counter() if _telemetry is not None else 0.0
                 state, metrics = self._train_multi(state, dev, subs[1:])
+                if _telemetry is not None:
+                    _telemetry.metrics.on_step(
+                        time.perf_counter() - t0, count
+                    )
                 tr.stop("train_step")
                 acc = self._acc_add(acc, metrics, multi=True)
                 first = self._host_step
@@ -650,7 +662,10 @@ class Trainer(PredictMixin):
                 prev = None if guard is None else guard.snapshot(state)
                 rng, sub = jax.random.split(rng)
                 tr.start("train_step")
+                t0 = time.perf_counter() if _telemetry is not None else 0.0
                 state, metrics = self._train_step(state, dev, sub)
+                if _telemetry is not None:
+                    _telemetry.metrics.on_step(time.perf_counter() - t0)
                 tr.stop("train_step")
                 if guard is not None and not bool(
                     np.asarray(metrics["finite"])

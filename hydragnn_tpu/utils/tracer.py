@@ -119,12 +119,27 @@ def reset():
         t.reset()
 
 
+_sync_fn = None
+
+
 def _sync():
+    """Block until in-flight device computation finishes (trace level 1's
+    "honest attribution" contract). ``jax.effects_barrier()`` is NOT that —
+    it only waits for ordered side effects and returns immediately with
+    async compute still in flight; ``jax.device_put(...)`` doesn't help
+    either, transfers bypass the execution stream. Dispatching a trivial
+    jitted program and blocking on it does: executions are ordered per
+    device, so its completion implies everything enqueued before it ran."""
     if os.getenv("HYDRAGNN_TRACE_LEVEL", "0") == "1":
+        global _sync_fn
         try:
             import jax
 
-            jax.effects_barrier()
+            if _sync_fn is None:
+                import jax.numpy as jnp
+
+                _sync_fn = jax.jit(lambda: jnp.zeros(()))
+            _sync_fn().block_until_ready()
         except Exception:
             pass
 
@@ -160,6 +175,29 @@ def profile(name):
         return wrapper
 
     return deco
+
+
+def totals() -> Dict[str, float]:
+    """Accumulated seconds per region from ONE accumulating backend —
+    preferring native over the Python timer (the jax backend only
+    annotates device traces). Every registered backend times the same
+    region boundaries, so summing across them would double-count; native
+    regions additionally come back as call-tree paths
+    ("train/train_step"). Feeds the telemetry layer's
+    ``ScalarWriter.add_regions`` / ``tracer_totals`` run event."""
+    for name in ("native", "timer"):
+        t = _tracers.get(name)
+        if t is None:
+            continue
+        if hasattr(t, "totals"):
+            try:
+                return {k: float(v) for k, v in t.totals().items()}
+            except Exception:
+                continue  # an old cached .so without the export
+        acc = getattr(t, "acc", None)
+        if acc:
+            return {k: float(v) for k, v in acc.items()}
+    return {}
 
 
 def save(prefix: str = "./logs/trace"):

@@ -1,0 +1,215 @@
+"""utils/timers.py + utils/profile.py — previously untested.
+
+Timers: re-registration accumulation semantics (a new ``Timer(name)``
+inherits the accumulated elapsed of its predecessor) and the
+``print_timers`` cross-host min/max/avg reduction, proven against a FAKE
+world (monkeypatched rank/world + host_allreduce) rather than hope.
+
+Profiler: the wait/warmup/active step schedule and the target-epoch gate,
+against a recording fake of ``jax.profiler``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.utils.profile import Profiler
+from hydragnn_tpu.utils.timers import Timer, print_timers, reset_timers
+
+
+# ---- Timer accumulation --------------------------------------------------
+
+
+def pytest_timer_reregistration_accumulates():
+    reset_timers()
+    a = Timer("phase")
+    a.start()
+    time.sleep(0.01)
+    a.stop()
+    first = a.elapsed
+    assert first > 0
+    # a NEW Timer of the same name picks up the accumulated total — the
+    # class-level aggregation the reference's time_utils relies on
+    b = Timer("phase")
+    assert b.elapsed == first
+    b.start()
+    time.sleep(0.01)
+    b.stop()
+    assert b.elapsed > first
+    # a different name starts from zero
+    assert Timer("other").elapsed == 0.0
+    reset_timers()
+    assert Timer("phase").elapsed == 0.0
+    reset_timers()
+
+
+def pytest_timer_stop_without_start_is_noop():
+    reset_timers()
+    t = Timer("idle")
+    t.stop()  # must not raise or accumulate
+    assert t.elapsed == 0.0
+    reset_timers()
+
+
+# ---- print_timers cross-host reduction -----------------------------------
+
+
+class _FakeWorld:
+    """Two hosts: rank 0 measured ``base``, rank 1 measured ``base + skew``
+    per timer — so min/max/avg have known closed forms."""
+
+    def __init__(self, world=2, rank=0, skew=2.0):
+        self.world = world
+        self.rank = rank
+        self.skew = skew
+
+    def get_comm_size_and_rank(self):
+        return self.world, self.rank
+
+    def host_allreduce(self, values, op="sum"):
+        values = np.asarray(values, np.float64)
+        others = [values + self.skew * r for r in range(1, self.world)]
+        stack = np.stack([values] + others)
+        return {
+            "min": stack.min(axis=0),
+            "max": stack.max(axis=0),
+            "sum": stack.sum(axis=0),
+        }[op]
+
+
+def _patch_world(monkeypatch, fake):
+    import hydragnn_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(
+        dist, "get_comm_size_and_rank", fake.get_comm_size_and_rank
+    )
+    monkeypatch.setattr(dist, "host_allreduce", fake.host_allreduce)
+
+
+def pytest_print_timers_reduces_across_fake_world(monkeypatch, capsys):
+    reset_timers()
+    t = Timer("epoch")
+    t.elapsed = 10.0
+    u = Timer("load")
+    u.elapsed = 4.0
+    _patch_world(monkeypatch, _FakeWorld(world=2, rank=0, skew=2.0))
+    print_timers(verbosity=0)
+    out = capsys.readouterr().out
+    lines = [ln.split() for ln in out.strip().splitlines()]
+    assert lines[0] == ["timer", "min_s", "max_s", "avg_s"]
+    # sorted by name: epoch then load; rank1 = rank0 + 2.0
+    assert lines[1] == ["epoch", "10.0000", "12.0000", "11.0000"]
+    assert lines[2] == ["load", "4.0000", "6.0000", "5.0000"]
+    reset_timers()
+
+
+def pytest_print_timers_silent_off_rank_zero(monkeypatch, capsys):
+    reset_timers()
+    Timer("epoch").elapsed = 1.0
+    _patch_world(monkeypatch, _FakeWorld(world=2, rank=1))
+    print_timers(verbosity=0)
+    assert capsys.readouterr().out == ""
+    reset_timers()
+
+
+def pytest_print_timers_no_timers_is_noop(capsys):
+    reset_timers()
+    print_timers(verbosity=0)
+    assert capsys.readouterr().out == ""
+
+
+# ---- Profiler schedule ---------------------------------------------------
+
+
+class _FakeJaxProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, trace_dir):
+        self.calls.append(("start", trace_dir))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch, tmp_path):
+    import jax.profiler
+
+    fake = _FakeJaxProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    return fake
+
+
+def pytest_profiler_wait_warmup_active_schedule(fake_profiler, tmp_path):
+    prof = Profiler(
+        str(tmp_path / "trace"), wait=2, warmup=1, active=2, target_epoch=1
+    )
+    prof.setup({"enable": 1})
+    prof.set_current_epoch(1)
+    with prof:
+        for step in range(1, 8):
+            prof.step()
+            if step <= 2:  # wait window: nothing traced yet
+                assert fake_profiler.calls == []
+            elif step < 5:  # warmup+active: tracing
+                assert fake_profiler.calls == [
+                    ("start", str(tmp_path / "trace"))
+                ]
+    # stopped exactly once, at wait+warmup+active+1 (step 6), not at exit
+    assert fake_profiler.calls == [
+        ("start", str(tmp_path / "trace")), ("stop",)
+    ]
+
+
+def pytest_profiler_target_epoch_gates(fake_profiler, tmp_path):
+    prof = Profiler(str(tmp_path / "t"), wait=0, warmup=1, active=1,
+                    target_epoch=3)
+    prof.setup({"enable": 1})
+    prof.set_current_epoch(2)  # wrong epoch: schedule must not arm
+    with prof:
+        for _ in range(5):
+            prof.step()
+    assert fake_profiler.calls == []
+    prof.set_current_epoch(3)
+    with prof:
+        for _ in range(3):
+            prof.step()
+    assert fake_profiler.calls == [("start", str(tmp_path / "t")), ("stop",)]
+
+
+def pytest_profiler_disabled_never_traces(fake_profiler, tmp_path):
+    prof = Profiler(str(tmp_path / "t"), wait=0, warmup=0, active=1)
+    prof.setup({})  # no config -> stays disabled
+    assert not prof.enabled
+    prof.set_current_epoch(1)
+    with prof:
+        for _ in range(4):
+            prof.step()
+    assert fake_profiler.calls == []
+
+
+def pytest_profiler_exit_stops_open_trace(fake_profiler, tmp_path):
+    # active window still open when the epoch ends: __exit__ must close it
+    prof = Profiler(str(tmp_path / "t"), wait=0, warmup=2, active=10,
+                    target_epoch=None)
+    prof.setup({"enable": 1, "wait": 0, "warmup": 2, "active": 10})
+    prof.set_current_epoch(0)
+    with prof:
+        for _ in range(3):
+            prof.step()
+    assert fake_profiler.calls == [("start", str(tmp_path / "t")), ("stop",)]
+
+
+def pytest_profiler_setup_reads_config(tmp_path):
+    prof = Profiler(str(tmp_path / "default"))
+    prof.setup(
+        {"enable": 1, "trace_dir": str(tmp_path / "cfg"), "wait": 7,
+         "warmup": 2, "active": 4, "target_epoch": 5}
+    )
+    assert prof.enabled
+    assert prof.trace_dir == str(tmp_path / "cfg")
+    assert (prof.wait, prof.warmup, prof.active) == (7, 2, 4)
+    assert prof.target_epoch == 5
