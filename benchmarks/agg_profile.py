@@ -95,8 +95,10 @@ def body_wbwd(i, z):
     g = jax.grad(lambda zz: (windowed_agg(zz).astype(jnp.float32) ** 2).sum())(z)
     return 0.5 * z + 0.5 * g.astype(dtype)
 
-ok = np.allclose(np.asarray(jax.jit(windowed_agg)(z0), np.float32),
-                 np.asarray(jax.jit(agg)(z0), np.float32), atol=2e-2)
+windowed_jit = jax.jit(windowed_agg)
+agg_jit = jax.jit(agg)
+ok = np.allclose(np.asarray(windowed_jit(z0), np.float32),
+                 np.asarray(agg_jit(z0), np.float32), atol=2e-2)
 print("windowed == gather parity:", ok)
 print("windowed fwd (+mix) ms/iter:", round(timeloop(body_wfwd, z0), 3))
 print("windowed fwd+bwd ms/iter:", round(timeloop(body_wbwd, z0), 3))

@@ -188,7 +188,8 @@ def main():
     from hydragnn_tpu.utils.sync import fence
 
     rng = jax.random.PRNGKey(0)
-    state, metrics = step(state, pbatch, rng)  # compile
+    rng, warm = jax.random.split(rng)
+    state, metrics = step(state, pbatch, warm)  # compile
     loss0 = metrics["loss"]
     for _ in range(2):  # settle any backend warmup
         rng, sub = jax.random.split(rng)

@@ -1,0 +1,37 @@
+"""jaxlint: JAX/TPU anti-pattern static analysis + runtime guards.
+
+Static pass (``python -m hydragnn_tpu.analysis``): an AST-based rule
+engine targeting the failure modes this stack actually has — per-batch
+host syncs in step loops, jit wrappers rebuilt per call, state-threading
+jits missing ``donate_argnums``, PRNG key reuse, recompile-hazard static
+args, and general hygiene. See ``docs/static-analysis.md`` for the rule
+catalog, suppression syntax, and the baseline ratchet.
+
+Runtime guards (``hydragnn_tpu.analysis.guards``): what the static pass
+cannot prove — a :class:`CompileSentinel` asserting the XLA compile
+counter stays flat after warmup, and :func:`no_host_syncs`, a
+``jax.transfer_guard`` harness that turns implicit device->host
+transfers into hard errors inside tests.
+"""
+
+from hydragnn_tpu.analysis.core import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    Rule,
+    all_rules,
+    analyze_paths,
+    register,
+)
+
+# importing the rule modules populates the registry
+from hydragnn_tpu.analysis import (  # noqa: F401  (registration side effect)
+    rules_host_sync,
+    rules_hygiene,
+    rules_jit,
+    rules_prng,
+)
+from hydragnn_tpu.analysis.guards import (  # noqa: F401
+    CompileSentinel,
+    no_host_syncs,
+    no_implicit_transfers,
+)

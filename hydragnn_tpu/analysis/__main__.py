@@ -1,0 +1,156 @@
+"""``python -m hydragnn_tpu.analysis`` — the jaxlint CLI.
+
+Exit status: 0 when every finding is baselined or suppressed, 1 when new
+findings exist, 2 on usage/configuration errors. The CI gate runs::
+
+    python -m hydragnn_tpu.analysis --format=github \
+        --baseline .jaxlint-baseline.json --stats
+"""
+
+import argparse
+import os
+import sys
+
+from hydragnn_tpu.analysis import baseline as baseline_mod
+from hydragnn_tpu.analysis.core import all_rules, analyze_paths
+from hydragnn_tpu.analysis.report import (
+    render_github,
+    render_json,
+    render_stats,
+    render_text,
+)
+
+DEFAULT_PATHS = ("hydragnn_tpu", "examples", "benchmarks")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.analysis",
+        description=(
+            "jaxlint: JAX/TPU anti-pattern static analysis "
+            "(docs/static-analysis.md)"
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/dirs to analyze (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json", "github"),
+        default="text",
+        help="output format (github = Actions annotations)",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="JSON baseline of pre-existing findings that do not fail "
+        "the gate",
+    )
+    p.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    p.add_argument(
+        "--stats",
+        action="store_true",
+        help="print per-rule counts (the ratchet numbers)",
+    )
+    p.add_argument(
+        "--select",
+        metavar="RULES",
+        help="comma-separated rule names to run (default: all)",
+    )
+    p.add_argument(
+        "--ignore",
+        metavar="RULES",
+        help="comma-separated rule names to skip",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name}: {rule.description}")
+        return 0
+
+    paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
+    if not paths:
+        print(
+            "jaxlint: no paths given and none of the default paths "
+            f"({', '.join(DEFAULT_PATHS)}) exist here",
+            file=sys.stderr,
+        )
+        return 2
+
+    select = (
+        {r.strip() for r in args.select.split(",")} if args.select else None
+    )
+    ignore = (
+        {r.strip() for r in args.ignore.split(",")} if args.ignore else None
+    )
+    known = set(all_rules())
+    for given in (select or set()) | (ignore or set()):
+        if given not in known:
+            print(f"jaxlint: unknown rule {given!r}", file=sys.stderr)
+            return 2
+
+    result = analyze_paths(paths, select=select, ignore=ignore)
+
+    if args.write_baseline:
+        baseline_mod.save_baseline(args.write_baseline, result.findings)
+        print(
+            f"jaxlint: wrote {len(result.findings)} finding(s) to "
+            f"{args.write_baseline}"
+        )
+        return 0
+
+    baselined = []
+    new = result.findings
+    if args.baseline:
+        try:
+            bl = baseline_mod.load_baseline(args.baseline)
+        except FileNotFoundError:
+            print(
+                f"jaxlint: baseline {args.baseline} not found (treating "
+                "as empty)",
+                file=sys.stderr,
+            )
+            bl = baseline_mod.Counter()
+        except ValueError as e:
+            print(f"jaxlint: {e}", file=sys.stderr)
+            return 2
+        new, baselined, stale = baseline_mod.apply_baseline(
+            result.findings, bl
+        )
+        if stale:
+            print(
+                f"jaxlint: {stale} baseline entr(ies) no longer match "
+                "anything — prune them (the ratchet only tightens)",
+                file=sys.stderr,
+            )
+
+    renderer = {
+        "text": render_text,
+        "json": render_json,
+        "github": render_github,
+    }[args.format]
+    print(renderer(new, baselined, result))
+    if args.stats:
+        print(render_stats(new, baselined, result))
+
+    if result.parse_errors:
+        return 1
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Baseline handling: the ratchet.
+
+A committed ``.jaxlint-baseline.json`` lists findings that predate the
+gate; CI fails only on findings NOT in the baseline, so the count can
+only go down. Fingerprints are (path, rule, stripped source line) — no
+line numbers, so edits elsewhere in a file don't rot the baseline.
+
+Each baseline entry is matched at most ``count`` times; fixing one of two
+identical lines still surfaces nothing until someone reintroduces a
+third.
+"""
+
+import json
+from collections import Counter
+from typing import Iterable, List, Tuple
+
+from hydragnn_tpu.analysis.core import Finding
+
+BASELINE_VERSION = 1
+
+
+def save_baseline(path: str, findings: Iterable[Finding]):
+    counts = Counter(f.fingerprint for f in findings)
+    payload = {
+        "version": BASELINE_VERSION,
+        "findings": [
+            {"path": p, "rule": r, "snippet": s, "count": c}
+            for (p, r, s), c in sorted(counts.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> Counter:
+    with open(path, "r", encoding="utf-8") as f:
+        payload = json.load(f)
+    version = payload.get("version")
+    if version != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {version!r}; this analyzer "
+            f"writes version {BASELINE_VERSION} — regenerate with "
+            "--write-baseline"
+        )
+    counts: Counter = Counter()
+    for entry in payload.get("findings", []):
+        key = (entry["path"], entry["rule"], entry["snippet"])
+        counts[key] += int(entry.get("count", 1))
+    return counts
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Counter
+) -> Tuple[List[Finding], List[Finding], int]:
+    """Split into (new, baselined) and count stale baseline entries
+    (entries that no longer match anything — candidates for deletion,
+    reported so the baseline shrinks instead of fossilizing)."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.fingerprint, 0) > 0:
+            remaining[f.fingerprint] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = sum(c for c in remaining.values() if c > 0)
+    return new, baselined, stale

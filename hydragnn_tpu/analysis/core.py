@@ -1,0 +1,296 @@
+"""jaxlint core: findings, suppressions, the rule registry, and the runner.
+
+The analyzer is a plain-``ast`` pass — no imports of the analyzed code, so
+it runs in milliseconds on the whole tree and can never be broken by a
+module whose import requires an accelerator. Each rule is an object with a
+``name``, a ``description`` and a ``check(module) -> Iterable[Finding]``;
+rules register themselves via :func:`register` at import time
+(``hydragnn_tpu.analysis`` imports every ``rules_*`` module).
+
+Suppression: a finding is dropped when its line (or the line directly
+above it, for black-wrapped statements) carries::
+
+    # jaxlint: disable=rule-name[,other-rule]
+    # jaxlint: disable            (all rules on that line)
+
+Suppressions are meant to carry a justification comment — the CI gate
+diffs are reviewed, a bare disable is a smell.
+"""
+
+import ast
+import fnmatch
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    snippet: str = ""  # stripped source line — the baseline fingerprint
+
+    @property
+    def fingerprint(self):
+        """Line-number-free identity: findings survive unrelated edits
+        above them, so a committed baseline does not rot on every rebase."""
+        return (self.path, self.rule, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+
+class ModuleInfo:
+    """One parsed source file plus the per-line suppression table."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._suppressions = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> Dict[int, tuple]:
+        """line (1-based) -> (rules-or-None-for-all, standalone_comment).
+        Only STANDALONE comment directives also cover the next line — a
+        trailing directive scopes to its own statement alone."""
+        table: Dict[int, tuple] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = m.group("rules")
+            parsed = (
+                None
+                if rules is None
+                else {r.strip() for r in rules.split(",") if r.strip()}
+            )
+            table[i] = (parsed, line.lstrip().startswith("#"))
+        return table
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        # the flagged line itself, or a standalone comment directive
+        # directly above it (multi-line calls anchor past the comment
+        # otherwise)
+        for ln, need_standalone in ((line, False), (line - 1, True)):
+            entry = self._suppressions.get(ln)
+            if entry is None:
+                continue
+            rules, standalone = entry
+            if need_standalone and not standalone:
+                continue
+            if rules is None or rule in rules:
+                return True
+        return False
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.rel_path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=self.line_text(getattr(node, "lineno", 0)),
+        )
+
+
+# ---- rule registry --------------------------------------------------------
+
+_RULES: Dict[str, "Rule"] = {}
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``description`` and implement
+    ``check``. ``hot_path_patterns`` narrows a rule to specific files."""
+
+    name = ""
+    description = ""
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def applies_to(self, module: ModuleInfo) -> bool:
+        return True
+
+
+def register(cls):
+    """Class decorator: instantiate and register the rule by name."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    _RULES[inst.name] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    return dict(_RULES)
+
+
+# ---- AST helpers shared by the rule modules -------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.random.split' for an Attribute/Name chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def walk_no_nested_functions(node: ast.AST):
+    """Walk a statement body without descending into nested def/class
+    bodies (lambdas ARE descended — they execute where they appear when
+    called per-iteration, e.g. inside ``tree_map``)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def function_defs(module: ModuleInfo):
+    """Every (possibly nested / method) FunctionDef in the module."""
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Names (re)bound by an assignment-like statement, incl. tuple
+    targets and for-loop targets."""
+    out: Set[str] = set()
+
+    def collect(target):
+        if isinstance(target, ast.Name):
+            out.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                collect(elt)
+        elif isinstance(target, ast.Starred):
+            collect(target.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            collect(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    return out
+
+
+# ---- runner ---------------------------------------------------------------
+
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules", "logs"}
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in SKIP_DIRS and not d.startswith(".")
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+    files_checked: int = 0
+
+    def stats(self) -> Dict[str, int]:
+        per_rule: Dict[str, int] = {r: 0 for r in sorted(_RULES)}
+        for f in self.findings:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        return per_rule
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+    root: Optional[str] = None,
+) -> AnalysisResult:
+    """Run every registered rule over every ``.py`` under ``paths``.
+
+    ``root`` anchors the repo-relative paths used for suppression-stable
+    baselines (defaults to the common CWD)."""
+    root = os.path.abspath(root or os.getcwd())
+    rules = [
+        r
+        for name, r in sorted(all_rules().items())
+        if (select is None or name in select)
+        and (ignore is None or name not in ignore)
+    ]
+    result = AnalysisResult()
+    for path in iter_python_files(paths):
+        abspath = os.path.abspath(path)
+        rel = os.path.relpath(abspath, root)
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                source = f.read()
+            module = ModuleInfo(abspath, rel, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            result.parse_errors.append(f"{rel}: {e}")
+            continue
+        result.files_checked += 1
+        for rule in rules:
+            if not rule.applies_to(module):
+                continue
+            for finding in rule.check(module):
+                if module.suppressed(finding.rule, finding.line):
+                    result.suppressed += 1
+                else:
+                    result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result
+
+
+def matches_any(rel_path: str, patterns: Sequence[str]) -> bool:
+    p = rel_path.replace(os.sep, "/")
+    return any(
+        fnmatch.fnmatch(p, pat) or fnmatch.fnmatch("/" + p, pat)
+        for pat in patterns
+    )

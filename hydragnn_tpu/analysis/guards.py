@@ -1,0 +1,153 @@
+"""Runtime correctness guards — what the static pass cannot prove.
+
+Two harnesses, both designed for tests (cheap, no-op-safe, CPU-friendly):
+
+- :class:`CompileSentinel` asserts the XLA compile counter stays FLAT
+  across a region: warm a step function up, enter the sentinel, run an
+  epoch (or a serve burst) — any recompile means a shape leaked past the
+  bucketing/layout machinery, which is this stack's #1 silent perf
+  regression. Counts come from the same ``jax.monitoring``
+  backend-compile events the ``/metrics`` endpoint exports
+  (``obs/runtime.py``), plus each tracked jitted function's own cache
+  size as a second, API-stable signal.
+
+- :func:`no_host_syncs` turns IMPLICIT device->host transfers into hard
+  errors via ``jax.transfer_guard_device_to_host("disallow")``. The hot
+  paths fetch results exactly once per epoch through explicit
+  ``jax.device_get`` — which the guard permits — so a reintroduced
+  per-batch ``float(metrics[...])`` fails the wrapped test instead of
+  silently serializing the dispatch pipeline. :func:`no_implicit_transfers`
+  is the stricter all-directions variant for regions that should move no
+  data implicitly at all (a fully staged dispatch, a serve batch whose
+  inputs are packed host-side).
+"""
+
+import contextlib
+from typing import Dict, Iterable, Optional
+
+from hydragnn_tpu.obs import runtime as _obs_runtime
+
+
+class RecompileError(AssertionError):
+    """A tracked region compiled after its warmup promised it would not."""
+
+
+class CompileSentinel:
+    """Assert zero new XLA compilations across a ``with`` region.
+
+    ``fns``: optional jitted callables; their jit-cache entry counts are
+    snapshotted too, catching re-traces even where the monitoring API is
+    unavailable (a re-trace that hits the persistent compile cache never
+    reaches the backend, but it still inserts a fresh cache entry).
+
+    Usage::
+
+        warmup()                      # compile everything first
+        with CompileSentinel(fns=[trainer._train_step]) as sentinel:
+            run_two_epochs()
+        # exiting asserts flatness; or call sentinel.assert_flat() to
+        # check mid-region
+    """
+
+    def __init__(self, fns: Iterable = (), check_on_exit: bool = True):
+        self.fns = list(fns)
+        self.check_on_exit = check_on_exit
+        self._events0: Optional[int] = None
+        self._cache0: Dict[int, int] = {}
+
+    # ---- signals -------------------------------------------------------
+    @staticmethod
+    def _cache_size(fn) -> Optional[int]:
+        get = getattr(fn, "_cache_size", None)
+        if callable(get):
+            try:
+                return int(get())
+            except Exception:
+                return None
+        return None
+
+    def __enter__(self):
+        _obs_runtime.install_compile_listener()
+        self._events0 = _obs_runtime.compile_events()
+        self._cache0 = {}
+        for i, fn in enumerate(self.fns):
+            size = self._cache_size(fn)
+            if size is not None:
+                self._cache0[i] = size
+        return self
+
+    def new_compiles(self) -> int:
+        """Backend compilations observed since ``__enter__``."""
+        if self._events0 is None:
+            raise RuntimeError("CompileSentinel used outside its context")
+        return _obs_runtime.compile_events() - self._events0
+
+    def new_cache_entries(self) -> int:
+        """Fresh jit-cache entries on the tracked fns since entry."""
+        grown = 0
+        for i, fn in enumerate(self.fns):
+            if i not in self._cache0:
+                continue
+            size = self._cache_size(fn)
+            if size is not None:
+                grown += max(0, size - self._cache0[i])
+        return grown
+
+    def assert_flat(self, what: str = "region"):
+        compiles = self.new_compiles()
+        entries = self.new_cache_entries()
+        if compiles or entries:
+            raise RecompileError(
+                f"{what}: expected zero recompiles after warmup, saw "
+                f"{compiles} backend compilation(s) and {entries} new "
+                "jit-cache entr(ies) — a shape or function identity "
+                "leaked past setup"
+            )
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self.check_on_exit:
+            self.assert_flat()
+        return False
+
+
+# ---- transfer guards ------------------------------------------------------
+
+def transfer_guard_available() -> bool:
+    import jax
+
+    return hasattr(jax, "transfer_guard_device_to_host") and hasattr(
+        jax, "transfer_guard"
+    )
+
+
+@contextlib.contextmanager
+def no_host_syncs():
+    """Hard-error any IMPLICIT device->host transfer in the region.
+
+    Explicit fetches (``jax.device_get``) pass — they are the documented
+    once-per-epoch readback. Host->device input transfers are unaffected,
+    so a whole ``train_epoch`` (puts included) runs under this guard.
+    Degrades to a no-op on jax builds without the transfer-guard API
+    (tests should skip via :func:`transfer_guard_available`).
+    """
+    import jax
+
+    if not transfer_guard_available():
+        yield
+        return
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """Hard-error implicit transfers in EVERY direction — for regions
+    whose inputs are already device-resident (staged epochs) or packed
+    host-side (a serve dispatch)."""
+    import jax
+
+    if not transfer_guard_available():
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield

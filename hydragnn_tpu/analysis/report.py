@@ -1,0 +1,104 @@
+"""Output formats: human text, machine JSON, GitHub annotations, --stats.
+
+``github`` emits workflow commands (``::error file=...``) that the Actions
+runner renders as inline PR annotations — the lint gate's findings land on
+the diff line that introduced them, not in a log nobody scrolls.
+"""
+
+import json
+from typing import Dict, List
+
+from hydragnn_tpu.analysis.core import AnalysisResult, Finding, all_rules
+
+
+def render_text(
+    new: List[Finding], baselined: List[Finding], result: AnalysisResult
+) -> str:
+    lines: List[str] = []
+    for f in new:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+    if baselined:
+        lines.append(
+            f"({len(baselined)} pre-existing finding(s) carried in the "
+            "baseline — fix and remove, never add)"
+        )
+    if result.suppressed:
+        lines.append(
+            f"({result.suppressed} finding(s) suppressed inline)"
+        )
+    for err in result.parse_errors:
+        lines.append(f"parse error: {err}")
+    summary = (
+        f"jaxlint: {len(new)} new finding(s), "
+        f"{result.files_checked} file(s) checked"
+    )
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(
+    new: List[Finding], baselined: List[Finding], result: AnalysisResult
+) -> str:
+    return json.dumps(
+        {
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "suppressed": result.suppressed,
+            "files_checked": result.files_checked,
+            "parse_errors": result.parse_errors,
+        },
+        indent=2,
+    )
+
+
+def render_github(
+    new: List[Finding], baselined: List[Finding], result: AnalysisResult
+) -> str:
+    """GitHub Actions workflow-command annotations, one per new finding
+    (and one per unparseable file — a syntax error fails the gate and
+    must say so on the PR, not exit 1 claiming zero findings)."""
+    lines: List[str] = []
+    for f in new:
+        # workflow commands terminate at newline; messages are single-line
+        msg = f.message.replace("\n", " ")
+        lines.append(
+            f"::error file={f.path},line={f.line},col={f.col},"
+            f"title=jaxlint {f.rule}::{msg}"
+        )
+    for err in result.parse_errors:
+        path = err.split(":", 1)[0]
+        lines.append(
+            f"::error file={path},title=jaxlint parse-error::"
+            f"{err.replace(chr(10), ' ')}"
+        )
+    lines.append(
+        f"jaxlint: {len(new)} new finding(s) "
+        f"({len(baselined)} baselined, {result.suppressed} suppressed, "
+        f"{len(result.parse_errors)} parse error(s), "
+        f"{result.files_checked} files)"
+    )
+    return "\n".join(lines)
+
+
+def render_stats(
+    new: List[Finding], baselined: List[Finding], result: AnalysisResult
+) -> str:
+    """Per-rule counts — the ratchet numbers CHANGES.md and CI logs cite."""
+    per_rule: Dict[str, Dict[str, int]] = {
+        name: {"new": 0, "baselined": 0} for name in sorted(all_rules())
+    }
+    for f in new:
+        per_rule.setdefault(f.rule, {"new": 0, "baselined": 0})["new"] += 1
+    for f in baselined:
+        per_rule.setdefault(f.rule, {"new": 0, "baselined": 0})[
+            "baselined"
+        ] += 1
+    width = max((len(n) for n in per_rule), default=10) + 2
+    lines = [f"{'rule':<{width}}{'new':>6}{'baselined':>11}"]
+    for name, c in per_rule.items():
+        lines.append(f"{name:<{width}}{c['new']:>6}{c['baselined']:>11}")
+    lines.append(
+        f"{'total':<{width}}{len(new):>6}{len(baselined):>11}"
+        f"   (suppressed inline: {result.suppressed})"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,116 @@
+"""Hygiene rules: mutable-default-arg and float64-literal.
+
+- **mutable-default-arg**: the classic Python footgun, with a JAX twist —
+  a mutable default on a collate/config function is shared across calls,
+  and a dict default that ends up in a jit closure is an unhashable
+  recompile hazard.
+- **float64-literal**: ``jnp`` calls with an explicit float64 dtype. On
+  TPU the stack runs x32 (``jax_enable_x64`` off): the literal silently
+  downcasts to f32 — the author THINKS they bought precision and did not.
+  With x64 on it doubles memory traffic on the hot path instead. Host-side
+  ``np.float64`` accumulation (the repo's exact-epoch-sum idiom) is
+  untouched; only device-bound ``jnp``/``jax.numpy`` spellings flag.
+"""
+
+import ast
+from typing import Iterable, List
+
+from hydragnn_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+
+@register
+class MutableDefaultArg(Rule):
+    name = "mutable-default-arg"
+    description = (
+        "Mutable default argument (list/dict/set literal) — shared "
+        "across calls, and unhashable if it reaches a jit static arg"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and dotted_name(d.func) in ("list", "dict", "set")
+                ):
+                    name = getattr(node, "name", "<lambda>")
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            d,
+                            f"mutable default in `{name}` is evaluated "
+                            "once and shared across every call — default "
+                            "to None and construct inside the body",
+                        )
+                    )
+        return findings
+
+
+_F64_DTYPES = {
+    "np.float64",
+    "numpy.float64",
+    "jnp.float64",
+    "jax.numpy.float64",
+}
+
+
+@register
+class Float64Literal(Rule):
+    name = "float64-literal"
+    description = (
+        "Explicit float64 dtype on a jnp call — silently downcast to f32 "
+        "under the stack's x32 config (or doubles HBM traffic with x64 "
+        "on); use f32, or np.* for host-side exact accumulation"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee in ("jnp.float64", "jax.numpy.float64"):
+                findings.append(
+                    module.finding(
+                        self.name,
+                        node,
+                        "jnp.float64(...) literal — x32 mode silently "
+                        "downcasts this to f32",
+                    )
+                )
+                continue
+            if not callee.startswith(("jnp.", "jax.numpy.")):
+                continue
+            for arg in [*node.args, *[k.value for k in node.keywords]]:
+                if self._is_f64(arg):
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            node,
+                            f"float64 dtype passed to {callee} — device "
+                            "arrays run x32; this either downcasts "
+                            "silently or doubles memory traffic",
+                        )
+                    )
+                    break
+        return findings
+
+    @staticmethod
+    def _is_f64(arg: ast.AST) -> bool:
+        if isinstance(arg, ast.Constant) and arg.value == "float64":
+            return True
+        return dotted_name(arg) in _F64_DTYPES

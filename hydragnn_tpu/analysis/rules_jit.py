@@ -1,0 +1,235 @@
+"""``jax.jit`` lifecycle rules: jit-in-loop, missing-donate,
+recompile-hazard.
+
+The persistent XLA compile cache (``utils/compile_cache.py``) makes
+*repeat* compilations of the SAME program cheap across processes — but it
+keys on the traced program, and none of the bugs below ever reach it with
+a stable key:
+
+- a fresh ``jax.jit(lambda ...)`` wrapper per call re-traces every time
+  (the jit-level cache keys on function object identity);
+- a jit missing ``donate_argnums`` on a state-threading step doubles the
+  optimizer-state HBM footprint and costs a device-to-device copy per
+  step;
+- ``static_argnums`` pointing at per-batch data recompiles per batch.
+"""
+
+import ast
+from typing import Iterable, List, Optional
+
+from hydragnn_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+    walk_no_nested_functions,
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES
+
+
+def _jit_kwarg_names(call: ast.Call):
+    return {kw.arg for kw in call.keywords if kw.arg}
+
+
+@register
+class JitInLoop(Rule):
+    name = "jit-in-loop"
+    description = (
+        "jax.jit created inside a loop or invoked immediately "
+        "(jax.jit(f)(x)) — the wrapper must be cached at setup or the "
+        "jit-level cache misses on every call and re-traces"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # (a) jit constructed inside a loop body
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            for stmt in node.body + node.orelse:
+                for sub in [stmt, *walk_no_nested_functions(stmt)]:
+                    if _is_jit_call(sub):
+                        findings.append(
+                            module.finding(
+                                self.name,
+                                sub,
+                                "jax.jit inside a loop builds a fresh "
+                                "wrapper per iteration — hoist it to "
+                                "setup (the persistent compile cache in "
+                                "utils/compile_cache.py cannot rescue an "
+                                "unstable function identity)",
+                            )
+                        )
+        # (b) immediate invocation: jax.jit(f)(args)
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _is_jit_call(node.func)
+            ):
+                findings.append(
+                    module.finding(
+                        self.name,
+                        node,
+                        "jax.jit(f)(...) builds and discards the wrapper "
+                        "in one expression — every evaluation re-traces; "
+                        "bind the jitted callable once at setup",
+                    )
+                )
+        # dedupe (a loop-hosted immediate call matches both patterns)
+        uniq, out = set(), []
+        for f in findings:
+            key = (f.line, f.col, f.message)
+            if key not in uniq:
+                uniq.add(key)
+                out.append(f)
+        return out
+
+
+# names that clearly do NOT thread donated state back out
+_EXEMPT_SUBSTRINGS = ("eval", "predict", "infer", "loss", "forward", "copy")
+# names that look like state-threading compiled programs
+_STATEFUL_SUBSTRINGS = ("train", "fit", "update")
+_STATEFUL_EXACT = {"step", "epoch_scan"}
+_STATEFUL_SUFFIXES = ("_scan",)
+
+
+def _wrapped_fn_name(call: ast.Call) -> Optional[str]:
+    if not call.args:
+        return None
+    first = call.args[0]
+    if isinstance(first, ast.Name):
+        return first.id
+    if isinstance(first, ast.Attribute):
+        return first.attr
+    return None
+
+
+def _looks_stateful(name: str) -> bool:
+    low = name.lower()
+    if any(s in low for s in _EXEMPT_SUBSTRINGS):
+        return False
+    if any(s in low for s in _STATEFUL_SUBSTRINGS):
+        return True
+    if low in _STATEFUL_EXACT:
+        return True
+    return any(low.endswith(s) for s in _STATEFUL_SUFFIXES)
+
+
+@register
+class MissingDonate(Rule):
+    name = "missing-donate"
+    description = (
+        "jax.jit of a state-threading step (train*/fit*/update*/step/"
+        "*_scan) without donate_argnums — the un-donated input state "
+        "doubles its HBM footprint and copies every step"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not _is_jit_call(node):
+                continue
+            fn_name = _wrapped_fn_name(node)
+            if fn_name is None or not _looks_stateful(fn_name):
+                continue
+            kwargs = _jit_kwarg_names(node)
+            if kwargs & {"donate_argnums", "donate_argnames"}:
+                continue
+            findings.append(
+                module.finding(
+                    self.name,
+                    node,
+                    f"jax.jit({fn_name}) threads state but does not "
+                    "donate it — pass donate_argnums for the state "
+                    "argument (see train/steps.py:train_step) so XLA "
+                    "reuses the input buffers in place",
+                )
+            )
+        return findings
+
+
+# parameter names that are per-batch data: marking them static recompiles
+# once per novel value (and unhashable values fail outright)
+_DATA_PARAM_NAMES = {
+    "batch",
+    "batches",
+    "data",
+    "x",
+    "inputs",
+    "arr",
+    "array",
+    "graph",
+    "graphs",
+    "state",
+    "params",
+}
+
+
+@register
+class RecompileHazard(Rule):
+    name = "recompile-hazard"
+    description = (
+        "static_argnums/static_argnames pointing at per-batch data (or a "
+        "parameter with an unhashable default) — every novel value "
+        "compiles a fresh executable"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not _is_jit_call(node):
+                continue
+            static_names = self._static_param_names(node, defs)
+            for pname in static_names:
+                if pname in _DATA_PARAM_NAMES:
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            node,
+                            f"static arg `{pname}` looks like per-batch "
+                            "data — every distinct value recompiles; "
+                            "static args must be small, hashable "
+                            "configuration",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _static_param_names(call: ast.Call, defs) -> List[str]:
+        """Resolve static_argnums positions / static_argnames strings to
+        parameter names where possible (same-file function or lambda)."""
+        params: List[str] = []
+        fn = call.args[0] if call.args else None
+        if isinstance(fn, ast.Lambda):
+            params = [a.arg for a in fn.args.args]
+        elif isinstance(fn, ast.Name) and fn.id in defs:
+            params = [a.arg for a in defs[fn.id].args.args]
+        out: List[str] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for v in ast.walk(kw.value):
+                    if isinstance(v, ast.Constant) and isinstance(
+                        v.value, str
+                    ):
+                        out.append(v.value)
+            elif kw.arg == "static_argnums" and params:
+                nums: List[int] = [
+                    v.value
+                    for v in ast.walk(kw.value)
+                    if isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)
+                ]
+                for n in nums:
+                    if 0 <= n < len(params):
+                        out.append(params[n])
+        return out

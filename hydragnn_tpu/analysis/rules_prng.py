@@ -1,0 +1,251 @@
+"""prng-key-reuse: a JAX PRNG key consumed twice.
+
+JAX's functional RNG makes correlated randomness a *silent* bug: passing
+the same key to two consumers (or using a key again after splitting it)
+yields identical draws — correlated dropout masks, identical shuffles —
+with no error anywhere. The hand-threaded ``rng, sub = jax.random.split
+(rng)`` chains in the trainer are one typo away from exactly this.
+
+The analysis is intraprocedural and linear: per function it tracks which
+names hold keys (assigned from ``jax.random.PRNGKey``/``split``/
+``fold_in`` or derived from a key by subscript/reshape, plus parameters
+named like keys) and marks a key *consumed* when it is passed to any
+call. A consumed key passed to another call before being rebound is
+flagged. Control flow is approximated: branches union their consumed
+sets; a loop body is analyzed once, and a key consumed in the body but
+never rebound anywhere in it is flagged as reused across iterations.
+"""
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from hydragnn_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    assigned_names,
+    dotted_name,
+    register,
+)
+
+_KEY_SOURCES = {
+    "jax.random.PRNGKey",
+    "jax.random.key",
+    "jax.random.split",
+    "jax.random.fold_in",
+    "random.PRNGKey",
+    "random.split",
+    "random.fold_in",
+}
+_KEY_PARAM_NAMES = {"rng", "key", "prng", "subkey", "rng_key", "prng_key"}
+
+# callees that READ a key (serialize, move, inspect) without drawing from
+# it — checkpoint meta building and asarray round-trips pass keys around
+# legitimately
+_NON_CONSUMING = re.compile(
+    r"(asarray|array|device_put|device_get|tree_map|save|meta|state_dict"
+    r"|emit|print|log|debug|repr|str|len|append|copy|shape)"
+)
+
+
+def _is_key_source(call: ast.Call) -> bool:
+    return dotted_name(call.func) in _KEY_SOURCES
+
+
+class _FunctionScan:
+    def __init__(self, module: ModuleInfo, rule_name: str,
+                 fn: ast.FunctionDef):
+        self.module = module
+        self.rule_name = rule_name
+        self.fn = fn
+        self.keys: Set[str] = {
+            a.arg
+            for a in [*fn.args.args, *fn.args.kwonlyargs]
+            if a.arg.lower() in _KEY_PARAM_NAMES
+        }
+        self.consumed: Set[str] = set()
+        self.findings: List[Finding] = []
+        self._loop_consumptions: Optional[
+            List[Tuple[ast.Call, str]]
+        ] = None
+
+    # ---- statement interpreter ----------------------------------------
+    def run(self):
+        self.block(self.fn.body)
+        return self.findings
+
+    def block(self, stmts):
+        for stmt in stmts:
+            self.statement(stmt)
+
+    def statement(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs get their own scan
+        if isinstance(stmt, ast.If):
+            self.expression(stmt.test)
+            self._branch([stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._loop(stmt, header_exprs=[stmt.iter],
+                       bound=assigned_names(stmt))
+            return
+        if isinstance(stmt, ast.While):
+            self._loop(stmt, header_exprs=[stmt.test], bound=set())
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.expression(item.context_expr)
+            self.block(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            branches = [stmt.body]
+            branches.extend(h.body for h in stmt.handlers)
+            if stmt.orelse:
+                branches.append(stmt.orelse)
+            self._branch(branches)
+            if stmt.finalbody:
+                self.block(stmt.finalbody)
+            return
+        # plain statement: evaluate RHS expressions (consumption), then
+        # apply bindings — `rng, sub = split(rng)` consumes and rebinds
+        # in one step, which is the CORRECT chain pattern
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self.call(node)
+        bound = assigned_names(stmt)
+        if bound:
+            self.bind(bound, getattr(stmt, "value", None))
+
+    def _branch(self, bodies):
+        before = set(self.consumed)
+        before_keys = set(self.keys)
+        after: Set[str] = set()
+        for body in bodies:
+            self.consumed = set(before)
+            self.block(body)
+            after |= self.consumed
+        self.keys |= before_keys
+        self.consumed = after  # union: consumed on ANY path counts
+
+    def _loop(self, stmt, header_exprs, bound: Set[str]):
+        for e in header_exprs:
+            self.expression(e)
+        if bound:
+            self.bind(bound, getattr(stmt, "iter", None))
+        body_consumed: List[Tuple[ast.Call, str]] = []
+        outer = self._loop_consumptions
+        self._loop_consumptions = body_consumed
+        self.block(stmt.body)
+        if stmt.orelse:
+            self.block(stmt.orelse)
+        self._loop_consumptions = outer
+        # keys consumed in the body and never rebound in it: iteration 2
+        # reuses the spent key
+        rebound: Set[str] = set()
+        for s in ast.walk(stmt):
+            if isinstance(s, ast.stmt):
+                rebound |= assigned_names(s)
+        reported: Set[str] = set()
+        for call, name in body_consumed:
+            if name in rebound or name in reported:
+                continue
+            reported.add(name)
+            self.findings.append(
+                self.module.finding(
+                    self.rule_name,
+                    call,
+                    f"key `{name}` is consumed inside the loop in "
+                    f"`{self.fn.name}` but never re-split/rebound in the "
+                    "body — every iteration reuses the same randomness",
+                )
+            )
+
+    def expression(self, expr: Optional[ast.AST]):
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self.call(node)
+
+    def call(self, call: ast.Call):
+        """Record consumption of key-typed names passed to this call."""
+        callee = dotted_name(call.func)
+        if not _is_key_source(call) and _NON_CONSUMING.search(callee or ""):
+            return
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in args:
+            if not isinstance(arg, ast.Name) or arg.id not in self.keys:
+                continue
+            name = arg.id
+            if name in self.consumed:
+                self.findings.append(
+                    self.module.finding(
+                        self.rule_name,
+                        call,
+                        f"key `{name}` was already consumed (split or "
+                        "passed to a consumer) and is used again here — "
+                        "split first and pass the fresh subkey",
+                    )
+                )
+            self.consumed.add(name)
+            if self._loop_consumptions is not None:
+                self._loop_consumptions.append((call, name))
+
+    def _derives_key(self, node: ast.AST) -> bool:
+        """RHS shapes that yield key values: a key-source call, a key
+        name, or a subscript / method chain hanging off one
+        (``subs[0]``, ``subs[1:].reshape(...)``) — NOT any expression
+        that merely mentions a key somewhere (a step call taking `sub`
+        returns state, not keys)."""
+        if isinstance(node, ast.Call):
+            if _is_key_source(node):
+                return True
+            if isinstance(node.func, ast.Attribute):
+                return self._derives_key(node.func.value)
+            return False
+        if isinstance(node, (ast.Subscript, ast.Attribute)):
+            return self._derives_key(node.value)
+        if isinstance(node, ast.Name):
+            return node.id in self.keys
+        if isinstance(node, ast.IfExp):
+            return self._derives_key(node.body) or self._derives_key(
+                node.orelse
+            )
+        return False
+
+    def bind(self, names: Set[str], value: Optional[ast.AST]):
+        derives = value is not None and self._derives_key(value)
+        for n in names:
+            if derives:
+                self.keys.add(n)
+            self.consumed.discard(n)
+
+
+@register
+class PrngKeyReuse(Rule):
+    name = "prng-key-reuse"
+    description = (
+        "A JAX PRNG key consumed twice (passed to two consumers, or used "
+        "after being split) — correlated randomness, silently"
+    )
+
+    def applies_to(self, module: ModuleInfo) -> bool:
+        # cheap pre-filter: no jax.random anywhere -> nothing to track
+        return "random" in module.source
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _FunctionScan(module, self.name, node)
+                # only bother when the function touches jax.random or has
+                # key-named params — keeps noise out of numpy-random code
+                touches = bool(scan.keys) or any(
+                    isinstance(n, ast.Call) and _is_key_source(n)
+                    for n in ast.walk(node)
+                )
+                if touches:
+                    findings.extend(scan.run())
+        return findings

@@ -166,7 +166,9 @@ def init_model_params(model: HydraBase, example_batch, seed: int = 0):
     threshold — so the cost recurred every process. One program compiles
     once, persists, and PRNG values are bit-identical either way."""
     rngs = {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(1)}
-    variables = jax.jit(functools.partial(model.init, train=False))(
+    # one-shot by design: init runs ONCE per process/model, and jitting it
+    # is the whole point (one fused program instead of 148 eager dispatches)
+    variables = jax.jit(functools.partial(model.init, train=False))(  # jaxlint: disable=jit-in-loop
         rngs, example_batch
     )
     return variables

@@ -126,6 +126,10 @@ class TrainingMetrics:
 
 
 _compile_listener_registered = False
+# process-global backend-compile count: always bumped once the listener is
+# installed, whether or not a telemetry run is active. The recompile
+# sentinel (analysis/guards.py) diffs it around a warmed-up region.
+_compile_events = 0
 
 
 def _register_compile_listener():
@@ -143,15 +147,31 @@ def _register_compile_listener():
         def _on_duration(event: str, duration: float = 0.0, **kwargs):
             # '/jax/core/compile/backend_compile_duration' fires once per
             # actual XLA compilation (cache hits don't reach the backend)
-            t = _active
-            if t is not None and "backend_compile" in event:
-                t.metrics.registry.inc("compiles_total")
+            global _compile_events
+            if "backend_compile" in event:
+                _compile_events += 1
+                t = _active
+                if t is not None:
+                    t.metrics.registry.inc("compiles_total")
 
         if hasattr(monitoring, "register_event_duration_secs_listener"):
             monitoring.register_event_duration_secs_listener(_on_duration)
             _compile_listener_registered = True
     except Exception:
         pass
+
+
+def install_compile_listener() -> bool:
+    """Public idempotent installer (the sentinel's entry point). Returns
+    whether the listener is live — False means the monitoring API is
+    unavailable and :func:`compile_events` will stay at 0."""
+    _register_compile_listener()
+    return _compile_listener_registered
+
+
+def compile_events() -> int:
+    """Backend compilations observed since the listener was installed."""
+    return _compile_events
 
 
 def _config_hash(config: dict) -> str:

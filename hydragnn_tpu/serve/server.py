@@ -446,7 +446,14 @@ class InferenceServer:
                 [r.graph for r in requests], bucket
             )
             outputs = self._dispatch_compiled(entry, bucket, batch)
-            outputs = [np.asarray(o) for o in outputs]
+            # ONE explicit bulk fetch for the whole batch's heads — the
+            # per-head np.asarray() it replaces was an implicit transfer
+            # per head, which the transfer-guard test now hard-errors
+            import jax
+
+            outputs = [
+                np.asarray(o) for o in jax.device_get(list(outputs))
+            ]
         except Exception as e:  # fail the batch, keep the server alive
             self.metrics.on_error(len(requests))
             for req in requests:

@@ -64,7 +64,10 @@ def _consolidate(leaf):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            leaf = jax.jit(
+            # cold path (checkpoint consolidation, multi-host only) and the
+            # out_shardings target varies with each leaf's mesh — caching a
+            # wrapper here would key on a dead closure
+            leaf = jax.jit(  # jaxlint: disable=jit-in-loop
                 lambda x: x, out_shardings=NamedSharding(mesh, P())
             )(leaf)
     return jax.device_get(leaf)

@@ -112,7 +112,16 @@ def train_validate_test(
         else None
     )
     scheduler = ReduceLROnPlateau(lr=get_learning_rate(state.opt_state))
-    rng = jax.random.PRNGKey(1337)
+    # configured seed (env > config > the historical 1337 default) — two
+    # runs differing only in ``Training.random_seed`` get independent
+    # shuffles/dropout; a resume below still restores the SAVED key, so
+    # the seed only ever picks the trajectory of a fresh run
+    seed = int(
+        os.getenv(
+            "HYDRAGNN_SEED", str(training.get("random_seed", 1337))
+        )
+    )
+    rng = jax.random.PRNGKey(seed)
     guard = getattr(trainer, "guard", None)
 
     # preemption-resume cadence: save a resumable (weights + loop state)

@@ -18,7 +18,7 @@ import numpy as np
 
 from hydragnn_tpu.models.create import init_model_params
 from hydragnn_tpu.train.optimizer import select_optimizer
-from hydragnn_tpu.train.trainer import TrainState, _nbatch
+from hydragnn_tpu.train.trainer import Trainer, TrainState, _nbatch
 from hydragnn_tpu.utils import tracer as tr
 
 
@@ -241,16 +241,10 @@ class PartitionedTrainer:
         acc.append(part)
         return acc
 
-    @staticmethod
-    def _acc_read(acc):
-        if not acc:
-            return 0.0, np.zeros(0)
-        if isinstance(acc[0], np.ndarray):
-            a = np.stack(acc).astype(np.float64).sum(axis=0)
-        else:
-            a = np.asarray(jnp.stack(acc), np.float64).sum(axis=0)
-        n = max(a[1], 1.0)
-        return a[0] / n, a[2:] / n
+    # identical readback contract (stack, ONE explicit device_get, float64
+    # host sum) — shared with the data-parallel trainer so the two cannot
+    # drift apart
+    _acc_read = staticmethod(Trainer._acc_read)
 
     def train_epoch(self, state, loader, rng):
         acc = None
@@ -282,24 +276,26 @@ class PartitionedTrainer:
         """Per-sample outputs gathered back to global node order."""
         num_heads = self.model.num_heads
         head_types = self.model.output_type
-        tot = 0.0
-        tasks = None
-        n = 0.0
+        acc = None
         true_values = [[] for _ in range(num_heads)]
         predicted_values = [[] for _ in range(num_heads)]
         infos = getattr(loader, "infos", None)
         order = (
             loader._order() if hasattr(loader, "_order") else range(len(loader))
         )
-        for pos, i in enumerate(order):
-            batch = loader._batches[int(i)]
-            info = infos[int(i)]
+        for i in (int(j) for j in order):
+            batch = loader._batches[i]
+            info = infos[i]
             dev = self.put_batch(batch)
             metrics = self._eval_step(state.params, state.batch_stats, dev)
-            tot += float(metrics["loss"])
-            t = np.asarray(metrics["tasks"])
-            tasks = t if tasks is None else tasks + t
-            n += 1.0
+            # loss/tasks accumulate on device, ONE readback at the end —
+            # the per-sample float()/np.asarray() this replaces cost a
+            # host round trip per giant graph (jaxlint:
+            # host-sync-in-hot-loop)
+            acc = self._acc_add(acc, metrics)
+            # sample collection needs the outputs on host: one EXPLICIT
+            # bulk fetch (device_get is transfer-guard-sanctioned), then
+            # pure numpy below — targets/gather tables are host data
             outputs = jax.device_get(metrics["outputs"])
             for ihead in range(num_heads):
                 # NLL mode appends a log-variance channel to every head's
@@ -307,27 +303,22 @@ class PartitionedTrainer:
                 d = self.model.output_dim[ihead]
                 if head_types[ihead] == "graph":
                     # replicated: shard 0's real-graph row
-                    pred = np.asarray(outputs[ihead]).reshape(
+                    pred = outputs[ihead].reshape(
                         info.num_parts, 2, -1
                     )[0, 0][:d].reshape(-1, 1)
-                    true = np.asarray(batch.targets[ihead]).reshape(
+                    true = batch.targets[ihead].reshape(
                         info.num_parts, 2, -1
                     )[0, 0].reshape(-1, 1)
                 else:
                     pred = info.gather_nodes(
-                        np.asarray(outputs[ihead])
+                        outputs[ihead]
                     )[..., :d].reshape(-1, 1)
                     true = info.gather_nodes(
-                        np.asarray(batch.targets[ihead])
+                        batch.targets[ihead]
                     ).reshape(-1, 1)
                 predicted_values[ihead].append(pred)
                 true_values[ihead].append(true)
-        n = max(n, 1.0)
+        loss, tasks = self._acc_read(acc)
         true_values = [np.concatenate(v, axis=0) for v in true_values]
         predicted_values = [np.concatenate(v, axis=0) for v in predicted_values]
-        return (
-            tot / n,
-            (tasks / n if tasks is not None else np.zeros(0)),
-            true_values,
-            predicted_values,
-        )
+        return (loss, np.atleast_1d(tasks), true_values, predicted_values)

@@ -48,9 +48,7 @@ class PredictMixin:
         (avg loss, per-task avg, true_values, predicted_values) with per-head
         flattened [num_values, 1] arrays."""
         num_heads = self.model.num_heads
-        tot = 0.0
-        tasks = None
-        n = 0.0
+        acc = None
         true_values = [[] for _ in range(num_heads)]
         predicted_values = [[] for _ in range(num_heads)]
         nbatch = _nbatch(loader)
@@ -112,11 +110,12 @@ class PredictMixin:
             metrics = self._eval_step(
                 state.params, state.batch_stats, dev_batch
             )
-            g = float(metrics["num_graphs"])
-            tot += float(metrics["loss"]) * g
-            t = np.asarray(metrics["tasks"]) * g
-            tasks = t if tasks is None else tasks + t
-            n += g
+            # loss/tasks/num_graphs accumulate ON DEVICE as one packed
+            # vector per batch (Trainer._acc_add) — the per-batch
+            # float()/np.asarray() fetches this replaces each cost a full
+            # host round trip and serialized the dispatch pipeline
+            # (jaxlint: host-sync-in-hot-loop)
+            acc = self._acc_add(acc, metrics, multi=False)
             outputs = metrics["outputs"]
             if self.mesh is not None and jax.process_count() > 1:
                 # global data-sharded arrays span non-addressable devices;
@@ -135,7 +134,8 @@ class PredictMixin:
             self._collect_head_values(
                 batch, outputs, true_values, predicted_values
             )
-        return self._predict_finish(tot, tasks, n, true_values, predicted_values)
+        loss, tasks = self._acc_read(acc)  # the pass's ONE metric readback
+        return self._predict_finish(loss, tasks, true_values, predicted_values)
 
     def _collect_head_values(
         self, batch, outputs, true_values, predicted_values
@@ -200,9 +200,9 @@ class PredictMixin:
             self._predict_scan(state.params, state.batch_stats, staged)
         )
         g_arr = np.asarray(g_b, np.float64)
-        tot = float(np.asarray(loss_b, np.float64) @ g_arr)
-        tasks = (np.asarray(tasks_b, np.float64) * g_arr[:, None]).sum(0)
-        n = float(g_arr.sum())
+        n = max(float(g_arr.sum()), 1.0)
+        loss = float(np.asarray(loss_b, np.float64) @ g_arr) / n
+        tasks = (np.asarray(tasks_b, np.float64) * g_arr[:, None]).sum(0) / n
         true_values = [[] for _ in range(num_heads)]
         predicted_values = [[] for _ in range(num_heads)]
         for ib, batch in enumerate(host_batches):
@@ -212,12 +212,11 @@ class PredictMixin:
                 true_values,
                 predicted_values,
             )
-        return self._predict_finish(tot, tasks, n, true_values, predicted_values)
+        return self._predict_finish(loss, tasks, true_values, predicted_values)
 
-    def _predict_finish(self, tot, tasks, n, true_values, predicted_values):
+    def _predict_finish(self, loss, tasks, true_values, predicted_values):
         """Shared tail of both predict paths: concat, optional test-data
-        dump, averaged metrics."""
-        n = max(n, 1.0)
+        dump, already-averaged metrics."""
         true_values = [np.concatenate(v, axis=0) for v in true_values]
         predicted_values = [np.concatenate(v, axis=0) for v in predicted_values]
         dump = os.getenv("HYDRAGNN_DUMP_TESTDATA")
@@ -238,9 +237,4 @@ class PredictMixin:
                 **{f"true_{i}": v for i, v in enumerate(true_values)},
                 **{f"pred_{i}": v for i, v in enumerate(predicted_values)},
             )
-        return (
-            tot / n,
-            (tasks / n if tasks is not None else np.zeros(0)),
-            true_values,
-            predicted_values,
-        )
+        return (loss, np.atleast_1d(tasks), true_values, predicted_values)

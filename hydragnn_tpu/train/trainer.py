@@ -46,6 +46,11 @@ from hydragnn_tpu.train.transfer import (  # noqa: F401  (re-exported API)
 )
 from hydragnn_tpu.utils import tracer as tr
 
+# cached at module scope: a fresh ``jax.jit(lambda ...)`` built at the call
+# site re-traces on EVERY invocation (the jit cache keys on function object
+# identity) — one deep-copy program serves every fit_staged best-state seed
+_copy_tree = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t))
+
 
 class Trainer(PredictMixin):
     def __init__(
@@ -460,9 +465,7 @@ class Trainer(PredictMixin):
             # explicit copy: ``state`` is donated, the snapshot must not
             # alias its buffers. One jitted dispatch — eager per-leaf copies
             # would cost ~a hundred dispatches on high-latency backends.
-            best_state = jax.jit(
-                lambda t: jax.tree_util.tree_map(jnp.copy, t)
-            )(state)
+            best_state = _copy_tree(state)
         tr.start("train")
         state, best_state, sched, series = self._fit_scan(
             state, best_state, sched, staged_train, staged_val,
@@ -527,9 +530,13 @@ class Trainer(PredictMixin):
         if isinstance(acc[0], np.ndarray):
             a = np.stack(acc).astype(np.float64).sum(axis=0)
         else:
-            a = (
-                np.asarray(jnp.stack(acc), np.float64).sum(axis=0)
-            )  # the epoch's single readback
+            # the epoch's single readback — EXPLICIT device_get, so the
+            # transfer-guard harness (analysis/guards.py no_host_syncs)
+            # can hard-error every implicit fetch in the epoch loop while
+            # this one sanctioned transfer passes
+            a = np.asarray(jax.device_get(jnp.stack(acc)), np.float64).sum(
+                axis=0
+            )
         n = max(a[1], 1.0)
         return a[0] / n, a[2:] / n
 
@@ -667,8 +674,11 @@ class Trainer(PredictMixin):
                 if _telemetry is not None:
                     _telemetry.metrics.on_step(time.perf_counter() - t0)
                 tr.stop("train_step")
+                # the guard's documented cost: ONE scalar fetch per step to
+                # learn whether the update was finite — opt-in, and there is
+                # no async way to branch host control flow on a device value
                 if guard is not None and not bool(
-                    np.asarray(metrics["finite"])
+                    np.asarray(metrics["finite"])  # jaxlint: disable=host-sync-in-hot-loop
                 ):
                     # poisoned update: discard it (or restore last-good
                     # with halved LR after a streak) and keep the batch's

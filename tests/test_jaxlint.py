@@ -1,0 +1,382 @@
+"""jaxlint (hydragnn_tpu/analysis): the static-analysis gate.
+
+Per rule: a bad snippet that must flag and a good snippet that must not;
+plus the suppression/baseline machinery, the CLI exit-code contract, and
+the two acceptance regressions — the merged tree is clean, and
+reintroducing a per-batch ``float()`` in a trainer hot loop or dropping
+``donate_argnums`` from a train step fails the gate.
+
+Everything here is pure-AST: no jax execution, so the whole file runs in
+well under a second.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from hydragnn_tpu.analysis import all_rules, analyze_paths
+from hydragnn_tpu.analysis.__main__ import main as jaxlint_main
+from hydragnn_tpu.analysis.baseline import (
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(tmp_path, files, **kw):
+    """Write {relpath: source} under tmp_path, analyze, return findings."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return analyze_paths([str(tmp_path)], root=str(tmp_path), **kw).findings
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---- host-sync-in-hot-loop ------------------------------------------------
+
+_HOT_BAD = """
+    import numpy as np
+
+    class Trainer:
+        def train_epoch(self, state, loader, rng):
+            tot = 0.0
+            for batch in loader:
+                state, metrics = self._train_step(state, batch, rng)
+                tot += float(metrics["loss"])
+                np.asarray(metrics["tasks"])
+                metrics["loss"].item()
+            return tot
+"""
+
+_HOT_GOOD = """
+    import numpy as np
+
+    class Trainer:
+        def train_epoch(self, state, loader, rng):
+            acc = None
+            for batch in loader:
+                state, metrics = self._train_step(state, batch, rng)
+                acc = self._acc_add(acc, metrics)
+            return self._acc_read(acc)
+"""
+
+
+def pytest_host_sync_flags_per_batch_conversions(tmp_path):
+    findings = _lint(tmp_path, {"train/trainer.py": _HOT_BAD})
+    hs = [f for f in findings if f.rule == "host-sync-in-hot-loop"]
+    assert len(hs) == 3, findings  # float, np.asarray, .item()
+
+
+def pytest_host_sync_clean_on_device_accumulation(tmp_path):
+    findings = _lint(tmp_path, {"train/trainer.py": _HOT_GOOD})
+    assert not [f for f in findings if f.rule == "host-sync-in-hot-loop"]
+
+
+def pytest_host_sync_ignores_non_dispatching_loops(tmp_path):
+    # a host-side collection loop (no step dispatch) converts freely
+    src = """
+        import numpy as np
+
+        class Trainer:
+            def collect(self, batches):
+                out = []
+                for b in batches:
+                    out.append(np.asarray(b.targets))
+                return out
+    """
+    findings = _lint(tmp_path, {"train/trainer.py": src})
+    assert not findings, findings
+
+
+def pytest_host_sync_scoped_to_hot_files(tmp_path):
+    # the same bad loop outside the hot set is not this rule's business
+    findings = _lint(tmp_path, {"data/loaders.py": _HOT_BAD})
+    assert not [f for f in findings if f.rule == "host-sync-in-hot-loop"]
+
+
+def pytest_host_sync_reaches_same_file_helpers(tmp_path):
+    src = """
+        class Trainer:
+            def _acc(self, acc, metrics):
+                return acc + metrics["loss"].item()
+
+            def train_epoch(self, state, loader):
+                acc = 0.0
+                for batch in loader:
+                    m = self._eval_step(state, batch)
+                    acc = self._acc(acc, m)
+                return acc
+    """
+    findings = _lint(tmp_path, {"serve/server.py": src})
+    hs = [f for f in findings if f.rule == "host-sync-in-hot-loop"]
+    assert len(hs) == 1 and "_acc" in hs[0].message, findings
+
+
+# ---- jit rules ------------------------------------------------------------
+
+
+def pytest_jit_in_loop_and_immediate_invocation(tmp_path):
+    src = """
+        import jax
+
+        def bad_loop(fns, x):
+            for f in fns:
+                g = jax.jit(f)
+                g(x)
+
+        def bad_immediate(f, x):
+            return jax.jit(f)(x)
+
+        def good(f):
+            return jax.jit(f)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    ji = [f for f in findings if f.rule == "jit-in-loop"]
+    assert len(ji) == 2, findings
+
+
+def pytest_missing_donate_flags_train_steps_only(tmp_path):
+    src = """
+        import jax
+
+        def train_step(state, batch, rng):
+            return state
+
+        def eval_step(params, batch):
+            return params
+
+        bad = jax.jit(train_step)
+        good = jax.jit(train_step, donate_argnums=(0,))
+        fine = jax.jit(eval_step)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    md = [f for f in findings if f.rule == "missing-donate"]
+    assert len(md) == 1 and "train_step" in md[0].message, findings
+
+
+def pytest_recompile_hazard_static_data_arg(tmp_path):
+    src = """
+        import jax
+
+        def step(state, batch):
+            return state
+
+        bad = jax.jit(step, static_argnums=(1,))
+        good = jax.jit(step)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    rh = [f for f in findings if f.rule == "recompile-hazard"]
+    assert len(rh) == 1 and "batch" in rh[0].message, findings
+
+
+# ---- prng-key-reuse -------------------------------------------------------
+
+
+def pytest_prng_sequential_reuse_flags(tmp_path):
+    src = """
+        import jax
+
+        def bad(rng):
+            a = jax.random.normal(rng, (3,))
+            b = jax.random.uniform(rng, (3,))
+            return a + b
+
+        def good(rng):
+            rng, k1 = jax.random.split(rng)
+            a = jax.random.normal(k1, (3,))
+            rng, k2 = jax.random.split(rng)
+            b = jax.random.uniform(k2, (3,))
+            return a + b
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    pr = [f for f in findings if f.rule == "prng-key-reuse"]
+    assert len(pr) == 1, findings
+
+
+def pytest_prng_use_after_split_flags(tmp_path):
+    src = """
+        import jax
+
+        def bad(rng):
+            k1, k2 = jax.random.split(rng)
+            return jax.random.normal(rng, (3,))
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    assert _rules_of(findings) == ["prng-key-reuse"], findings
+
+
+def pytest_prng_loop_reuse_flags_and_chain_is_clean(tmp_path):
+    src = """
+        import jax
+
+        def bad(rng, batches, step, state):
+            for b in batches:
+                state, m = step(state, b, rng)
+            return state
+
+        def good(rng, batches, step, state):
+            for b in batches:
+                rng, sub = jax.random.split(rng)
+                state, m = step(state, b, sub)
+            return state
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    pr = [f for f in findings if f.rule == "prng-key-reuse"]
+    assert len(pr) == 1 and "bad" in pr[0].message, findings
+
+
+# ---- hygiene --------------------------------------------------------------
+
+
+def pytest_mutable_default_and_float64(tmp_path):
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def bad_default(x, acc=[]):
+            acc.append(x)
+            return acc
+
+        def bad_dtype(x):
+            return jnp.asarray(x, dtype=jnp.float64)
+
+        def host_accumulation_is_fine(x):
+            return np.asarray(x, np.float64)
+
+        def good_default(x, acc=None):
+            return [x] if acc is None else acc + [x]
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    assert _rules_of(findings) == ["float64-literal", "mutable-default-arg"]
+    assert len(findings) == 2, findings
+
+
+# ---- suppressions / baseline / CLI ---------------------------------------
+
+
+def pytest_inline_suppression_same_line_and_line_above(tmp_path):
+    src = """
+        import jax
+
+        def train_step(state):
+            return state
+
+        a = jax.jit(train_step)  # jaxlint: disable=missing-donate
+        # jaxlint: disable=missing-donate
+        b = jax.jit(train_step)
+        c = jax.jit(train_step)  # jaxlint: disable
+        d = jax.jit(train_step)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    md = [f for f in findings if f.rule == "missing-donate"]
+    assert len(md) == 1, findings  # only `d` survives
+
+
+def pytest_baseline_ratchets(tmp_path):
+    src = """
+        import jax
+
+        def train_step(state):
+            return state
+
+        a = jax.jit(train_step)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    assert len(findings) == 1
+    bl_path = tmp_path / "baseline.json"
+    save_baseline(str(bl_path), findings)
+    bl = load_baseline(str(bl_path))
+    new, baselined, stale = apply_baseline(findings, bl)
+    assert not new and len(baselined) == 1 and stale == 0
+    # a SECOND identical finding is new — the baseline caps at its count
+    new, baselined, _ = apply_baseline(findings * 2, bl)
+    assert len(new) == 1 and len(baselined) == 1
+    # fixing the finding leaves a stale entry the gate reports for pruning
+    new, baselined, stale = apply_baseline([], bl)
+    assert not new and not baselined and stale == 1
+
+
+def pytest_cli_exit_codes_and_formats(tmp_path, capsys):
+    bad = tmp_path / "m.py"
+    bad.write_text(
+        "import jax\n\ndef train_step(s):\n    return s\n\n"
+        "a = jax.jit(train_step)\n"
+    )
+    # findings -> exit 1
+    assert jaxlint_main([str(bad), "--format=json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["new"] and out["new"][0]["rule"] == "missing-donate"
+    # github format -> workflow command annotations
+    assert jaxlint_main([str(bad), "--format=github"]) == 1
+    assert "::error file=" in capsys.readouterr().out
+    # write baseline -> exit 0, then gate passes against it
+    bl = tmp_path / "bl.json"
+    assert jaxlint_main([str(bad), f"--write-baseline={bl}"]) == 0
+    capsys.readouterr()
+    assert jaxlint_main([str(bad), f"--baseline={bl}"]) == 0
+    capsys.readouterr()
+    # unknown rule -> usage error
+    assert jaxlint_main([str(bad), "--select=no-such-rule"]) == 2
+    capsys.readouterr()
+    # --list-rules mentions every registered rule
+    assert jaxlint_main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for name in all_rules():
+        assert name in listed
+
+
+def pytest_select_and_ignore(tmp_path):
+    files = {"m.py": "def f(x, a=[]):\n    return a\n"}
+    assert _lint(tmp_path, files, select={"mutable-default-arg"})
+    assert not _lint(tmp_path, files, ignore={"mutable-default-arg"})
+
+
+def pytest_syntax_error_reported_not_crashed(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    result = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    assert result.parse_errors and not result.findings
+
+
+# ---- acceptance -----------------------------------------------------------
+
+
+def pytest_merged_tree_is_clean():
+    """`python -m hydragnn_tpu.analysis` exits 0 on the committed tree —
+    every true positive fixed or suppressed with a justification."""
+    paths = [
+        os.path.join(REPO_ROOT, d)
+        for d in ("hydragnn_tpu", "examples", "benchmarks")
+    ]
+    result = analyze_paths(paths, root=REPO_ROOT)
+    assert not result.findings, [
+        f"{f.path}:{f.line}: {f.rule}" for f in result.findings
+    ]
+    assert not result.parse_errors, result.parse_errors
+
+
+def pytest_reintroduced_regressions_fail_the_gate(tmp_path):
+    """The ISSUE acceptance pair: a per-batch float() back in a trainer
+    epoch loop, and steps.train_step without donate_argnums."""
+    findings = _lint(
+        tmp_path,
+        {
+            "train/trainer.py": _HOT_BAD,
+            "train/steps.py": (
+                "import jax\n\n"
+                "def train_step(state, batch, rng):\n"
+                "    return state\n\n"
+                "compiled = jax.jit(train_step)\n"
+            ),
+        },
+    )
+    rules = _rules_of(findings)
+    assert "host-sync-in-hot-loop" in rules, findings
+    assert "missing-donate" in rules, findings
