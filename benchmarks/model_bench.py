@@ -238,13 +238,18 @@ def bench_model(
     dbatch = trainer.put_batch(batch)
     rng = jax.random.PRNGKey(0)
 
-    # XLA's own FLOP count for the exact compiled program
+    # XLA's own FLOP count for the exact compiled program, through the
+    # obs layer's normalizer (list-vs-dict spellings vary by jax version)
+    from hydragnn_tpu.obs.introspect import normalize_cost_analysis
+
     flops = None
     try:
-        cost = trainer._train_step.lower(state, dbatch, rng).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0)) or None
+        cost = normalize_cost_analysis(
+            trainer._train_step.lower(state, dbatch, rng)
+            .compile()
+            .cost_analysis()
+        )
+        flops = cost.get("flops") or None
     except Exception as e:  # cost model availability varies by backend
         print(f"cost_analysis unavailable: {e}", file=sys.stderr)
 

@@ -13,8 +13,15 @@ One coherent observability layer for training AND serving:
 - :mod:`~hydragnn_tpu.obs.http` — the stdlib ``/healthz`` + ``/metrics``
   listener, shared by the predict server and live training runs.
 - :mod:`~hydragnn_tpu.obs.runtime` — per-run glue: ``RunTelemetry``,
-  ``TrainingMetrics``, and the no-op-when-inactive module hooks the
-  training code calls.
+  ``TrainingMetrics``, the step-time ``FlightRecorder`` (stall alerts),
+  and the no-op-when-inactive module hooks the training code calls.
+- :mod:`~hydragnn_tpu.obs.introspect` — XLA introspection: compiled
+  cost/memory accounting per (program, bucket), on-demand
+  ``/profile?steps=N`` trace capture, the reference-parity ``Profiler``
+  schedule.
+- :mod:`~hydragnn_tpu.obs.report` — post-mortem run reports from
+  ``events.jsonl`` + the perf-budget ratchet
+  (``python -m hydragnn_tpu.obs report``).
 
 Quick start (training side)::
 
@@ -36,7 +43,14 @@ from hydragnn_tpu.obs.metrics import (
     MetricsRegistry,
     ServeMetrics,
 )
+from hydragnn_tpu.obs.introspect import (
+    InstrumentedJit,
+    Profiler,
+    TraceCapture,
+    instrument,
+)
 from hydragnn_tpu.obs.runtime import (
+    FlightRecorder,
     RunTelemetry,
     TrainingMetrics,
     activate,
@@ -50,18 +64,23 @@ __all__ = [
     "DEFAULT_LATENCY_BOUNDS",
     "EPOCH_LATENCY_BOUNDS",
     "EVENT_FIELDS",
+    "FlightRecorder",
+    "InstrumentedJit",
     "LatencyHistogram",
     "MetricsRegistry",
     "ObservabilityServer",
+    "Profiler",
     "RunEventLog",
     "RunTelemetry",
     "SCHEMA_VERSION",
     "ScalarWriter",
     "ServeMetrics",
+    "TraceCapture",
     "TrainingMetrics",
     "activate",
     "active",
     "deactivate",
     "init_run_telemetry",
+    "instrument",
     "validate_events",
 ]

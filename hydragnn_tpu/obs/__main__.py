@@ -1,0 +1,169 @@
+"""``python -m hydragnn_tpu.obs`` — observability CLI.
+
+Subcommands::
+
+    report <logs/run | events.jsonl>
+        [--format text|markdown|json]
+        [--check-budget .perf-baseline.json] [--tolerance F]
+        [--write-budget .perf-baseline.json]
+
+Exit status: 0 clean, 1 when ``--check-budget`` finds a figure over
+budget, 2 on usage errors (missing stream, malformed budget). The CI
+gate runs the smoke training, then::
+
+    python -m hydragnn_tpu.obs report <run> --check-budget \
+        .perf-baseline.json
+"""
+
+import argparse
+import os
+import sys
+
+from hydragnn_tpu.obs import report as report_mod
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.obs",
+        description=(
+            "post-mortem run reports + perf-budget ratchet "
+            "(docs/observability.md)"
+        ),
+    )
+    sub = p.add_subparsers(dest="command")
+    rep = sub.add_parser(
+        "report",
+        help="render a run report from its events.jsonl",
+    )
+    rep.add_argument(
+        "run", help="run directory (containing events.jsonl) or the "
+        "stream itself",
+    )
+    rep.add_argument(
+        "--format",
+        choices=sorted(report_mod.RENDERERS),
+        default="text",
+        help="output format (default: text)",
+    )
+    rep.add_argument(
+        "--check-budget",
+        metavar="FILE",
+        help="compare per-bucket compiled FLOPs/HBM against this "
+        "baseline; exit 1 on any figure beyond tolerance",
+    )
+    rep.add_argument(
+        "--tolerance",
+        type=float,
+        default=None,
+        help="override the budget file's tolerance (fraction, e.g. 0.1)",
+    )
+    rep.add_argument(
+        "--write-budget",
+        metavar="FILE",
+        help="write this run's compiled-cost figures as the new baseline",
+    )
+    return p
+
+
+def _run_report(args) -> int:
+    events_path = report_mod.resolve_events_path(args.run)
+    if not os.path.exists(events_path):
+        print(f"obs report: no event stream at {events_path}",
+              file=sys.stderr)
+        return 2
+    report = report_mod.build_report(report_mod.load_events(events_path))
+    print(report_mod.RENDERERS[args.format](report), end="")
+
+    if args.write_budget:
+        budget = report_mod.budget_from_report(
+            report,
+            tolerance=(
+                args.tolerance
+                if args.tolerance is not None
+                else report_mod.DEFAULT_TOLERANCE
+            ),
+        )
+        if not budget["programs"]:
+            print(
+                "obs report: no compile events in the stream — nothing "
+                "to budget (was introspection enabled?)",
+                file=sys.stderr,
+            )
+            return 2
+        import json
+
+        with open(args.write_budget, "w") as f:
+            json.dump(budget, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(
+            f"obs report: wrote {len(budget['programs'])} program "
+            f"budget(s) to {args.write_budget}",
+            file=sys.stderr,
+        )
+
+    if args.check_budget:
+        try:
+            budget = report_mod.load_budget(args.check_budget)
+        except FileNotFoundError:
+            print(
+                f"obs report: budget {args.check_budget} not found",
+                file=sys.stderr,
+            )
+            return 2
+        except ValueError as e:
+            print(f"obs report: {e}", file=sys.stderr)
+            return 2
+        if budget["programs"] and not report["programs"]:
+            # every baseline entry would degrade to a non-fatal 'stale'
+            # note and the gate would pass having checked NOTHING —
+            # a run with no compile events cannot satisfy a non-empty
+            # budget (introspection off? telemetry never active?)
+            print(
+                "obs report: stream has no compile events but the "
+                f"budget expects {len(budget['programs'])} program(s) — "
+                "was introspection enabled for this run?",
+                file=sys.stderr,
+            )
+            return 2
+        violations, unbudgeted, stale = report_mod.check_budget(
+            report, budget, tolerance=args.tolerance
+        )
+        for name in unbudgeted:
+            print(
+                f"obs report: note: {name} has no budget entry "
+                "(new bucket? --write-budget to adopt it)",
+                file=sys.stderr,
+            )
+        for name in stale:
+            print(
+                f"obs report: note: budget entry {name} matched no "
+                "compiled program in this run",
+                file=sys.stderr,
+            )
+        for v in violations:
+            print(
+                f"obs report: OVER BUDGET: {v['bucket']} {v['metric']} "
+                f"{v['current']:.6g} > limit {v['limit']:.6g} "
+                f"(baseline {v['baseline']:.6g}, x{v['ratio']:.3f})",
+                file=sys.stderr,
+            )
+        if violations:
+            return 1
+        print(
+            f"obs report: budget ok ({len(budget['programs'])} "
+            f"program(s) checked)",
+            file=sys.stderr,
+        )
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command != "report":
+        build_parser().print_help(sys.stderr)
+        return 2
+    return _run_report(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,18 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "wallclock_stop": ("epoch",),
     "tracer_totals": ("regions",),
     "run_end": ("status",),
+    # XLA introspection (obs/introspect.py): one per novel compiled
+    # (program, shape-signature); cost/memory are the normalized
+    # cost_analysis()/memory_analysis() dicts ({} on backends without the
+    # respective model)
+    "compile": ("name", "bucket", "cost", "memory"),
+    # flight recorder: a step dispatch exceeded stall_factor x the rolling
+    # median of the last K steps
+    "stall": ("step", "seconds", "median", "factor"),
+    # on-demand trace capture lifecycle (armed -> started -> done)
+    "profile": ("status",),
+    # device memory report (parallel.distributed.print_peak_memory)
+    "device_memory": ("devices",),
 }
 
 _ENVELOPE = ("event", "ts", "seq")

@@ -11,7 +11,10 @@ re-exports this class unchanged.
 ``GET /healthz`` — JSON liveness/readiness; non-2xx when the provider
 reports a non-ok status, so a load balancer can eject the replica (or an
 operator can spot a wedged training job). ``GET /metrics`` — Prometheus
-text exposition.
+text exposition. ``GET /profile?steps=N`` — arm ``jax.profiler`` device
+trace capture for the next N steps of the live run, when the provider
+implements ``profile(steps) -> dict`` (training's ``RunTelemetry`` does;
+providers without it answer 501).
 
 ``http.server`` only (the container bakes in no web framework); the
 listener runs on a daemon thread and ``port=0`` binds an ephemeral port
@@ -54,8 +57,34 @@ class ObservabilityServer:
                     body = provider.metrics.render_prometheus().encode()
                     code = 200
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.split("?", 1)[0] == "/profile":
+                    profile = getattr(provider, "profile", None)
+                    if profile is None:
+                        body = (
+                            b"this provider does not support on-demand "
+                            b"profiling\n"
+                        )
+                        code = 501
+                        ctype = "text/plain"
+                    else:
+                        from urllib.parse import parse_qs, urlsplit
+
+                        qs = parse_qs(urlsplit(self.path).query)
+                        try:
+                            steps = int(qs.get("steps", ["3"])[0])
+                        except ValueError:
+                            steps = -1  # profile() rejects with an error
+                        result = profile(steps)
+                        body = json.dumps(result).encode()
+                        code = 200 if result.get("status") in (
+                            "armed", "busy"
+                        ) else 400
+                        ctype = "application/json"
                 else:
-                    body = b"not found: serve exposes /healthz and /metrics\n"
+                    body = (
+                        b"not found: this endpoint exposes /healthz, "
+                        b"/metrics and /profile\n"
+                    )
                     code = 404
                     ctype = "text/plain"
                 self.send_response(code)

@@ -134,6 +134,12 @@ class MetricsRegistry:
             name, "histogram", help_text, LatencyHistogram(bounds)
         )
 
+    def labeled_gauge(self, name: str, help_text: str = ""):
+        """A gauge family keyed by label sets (e.g. per-bucket compiled
+        FLOPs): one declaration, one exposition line per distinct label
+        combination recorded via :meth:`set_labeled`."""
+        return self._declare(name, "labeled_gauge", help_text, {})
+
     def inc(self, name: str, value: int = 1):
         with self._lock:
             self._values[name] += value
@@ -142,19 +148,37 @@ class MetricsRegistry:
         with self._lock:
             self._values[name] = value
 
+    def set_labeled(self, name: str, value: float, **labels):
+        """Record one label-set's value on a :meth:`labeled_gauge`.
+        Label RENDER order is the sorted key order — deterministic
+        exposition regardless of call-site kwarg order."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._values[name][key] = value
+
     def observe(self, name: str, seconds: float):
         with self._lock:
             self._values[name].observe(seconds)
 
     def get(self, name: str):
         with self._lock:
-            v = self._values[name]
-            return v.state() if isinstance(v, LatencyHistogram) else v
+            return self._freeze(self._kinds[name], self._values[name])
+
+    @staticmethod
+    def _freeze(kind, value):
+        if kind == "histogram":
+            return value.state()
+        if kind == "labeled_gauge":
+            return {
+                ",".join(f"{k}={v}" for k, v in key): val
+                for key, val in value.items()
+            }
+        return value
 
     def snapshot(self) -> Dict:
         with self._lock:
             return {
-                n: (v.state() if isinstance(v, LatencyHistogram) else v)
+                n: self._freeze(self._kinds[n], v)
                 for n, v in self._values.items()
             }
 
@@ -165,7 +189,8 @@ class MetricsRegistry:
             kinds = dict(self._kinds)
             helps = dict(self._help)
             values = {
-                n: (v.state() if isinstance(v, LatencyHistogram) else v)
+                n: (dict(v) if isinstance(v, dict) else
+                    v.state() if isinstance(v, LatencyHistogram) else v)
                 for n, v in self._values.items()
             }
         lines = []
@@ -173,6 +198,19 @@ class MetricsRegistry:
             kind = kinds[name]
             if kind == "histogram":
                 lines.extend(render_summary(prefix, name, values[name]))
+                continue
+            if kind == "labeled_gauge":
+                series = values[name]
+                if not series:  # no label sets yet: no exposition lines
+                    continue
+                lines.append(f"# HELP {prefix}_{name} {helps[name]}")
+                lines.append(f"# TYPE {prefix}_{name} gauge")
+                for key in sorted(series):
+                    labels = ",".join(f'{k}="{v}"' for k, v in key)
+                    v = series[key]
+                    if isinstance(v, float):
+                        v = round(v, 6)
+                    lines.append(f"{prefix}_{name}{{{labels}}} {v}")
                 continue
             lines.append(f"# HELP {prefix}_{name} {helps[name]}")
             lines.append(f"# TYPE {prefix}_{name} {kind}")

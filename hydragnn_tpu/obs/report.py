@@ -1,0 +1,462 @@
+"""Post-mortem run reports from ``events.jsonl`` + the perf-budget ratchet.
+
+``python -m hydragnn_tpu.obs report <logs/run>`` renders what a finished
+(or crashed) run did — epoch table, throughput trend, padding waste,
+guard/checkpoint/compile/stall timeline, per-bucket compiled cost — from
+the structured event stream alone, so a post-mortem needs no access to
+the machine the run died on.
+
+The budget ratchet (``--check-budget .perf-baseline.json``) compares the
+run's per-bucket compiled FLOPs / peak-HBM figures against a committed
+baseline with tolerances — the same pattern as ``.jaxlint-baseline.json``:
+CI fails when a hot program got measurably more expensive, and the
+baseline only moves by an explicit ``--write-budget`` commit.
+
+Unlike :func:`~hydragnn_tpu.obs.events.validate_events` (the strict CI
+schema gate), loading here is TOLERANT: a torn stream from a crashed run
+is exactly when a post-mortem matters, so unparseable lines are skipped,
+not fatal.
+"""
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+BUDGET_VERSION = 1
+DEFAULT_TOLERANCE = 0.10
+# the per-program figures the ratchet tracks (report key -> budget key)
+BUDGET_METRICS = ("flops", "bytes_accessed", "peak_bytes")
+
+
+def resolve_events_path(path: str) -> str:
+    """Accept a run directory or the ``events.jsonl`` itself."""
+    if os.path.isdir(path):
+        return os.path.join(path, "events.jsonl")
+    return path
+
+
+def load_events(path: str) -> List[Dict]:
+    """Tolerantly parse an event stream (run dir or file path)."""
+    path = resolve_events_path(path)
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail / partial write: skip, don't die
+            if isinstance(rec, dict) and "event" in rec:
+                records.append(rec)
+    return records
+
+
+def _num(value) -> Optional[float]:
+    """Numeric field or None (nulled NaNs stay None)."""
+    return float(value) if isinstance(value, (int, float)) else None
+
+
+def build_report(records: List[Dict]) -> Dict:
+    """Fold the event stream into the report structure all three
+    renderers (and the budget check) consume."""
+    manifest = next(
+        (r for r in records if r["event"] == "run_manifest"), {}
+    )
+    run_end = next(
+        (r for r in reversed(records) if r["event"] == "run_end"), None
+    )
+    ts = [r["ts"] for r in records if isinstance(r.get("ts"), (int, float))]
+
+    epochs = []
+    for r in records:
+        if r["event"] != "epoch":
+            continue
+        epochs.append(
+            {
+                "epoch": r.get("epoch"),
+                "train_loss": _num(r.get("train_loss")),
+                "val_loss": _num(r.get("val_loss")),
+                "test_loss": _num(r.get("test_loss")),
+                "wall_time_s": _num(r.get("wall_time_s")),
+                "graphs_per_sec": _num(r.get("graphs_per_sec")),
+                "nodes_per_sec": _num(r.get("nodes_per_sec")),
+                "padding_waste": _num(r.get("padding_waste")),
+                "mode": r.get("mode"),
+            }
+        )
+
+    gps = [e["graphs_per_sec"] for e in epochs if e["graphs_per_sec"]]
+    waste = [
+        e["padding_waste"] for e in epochs if e["padding_waste"] is not None
+    ]
+    throughput = {}
+    if gps:
+        throughput = {
+            "first_graphs_per_sec": gps[0],
+            "last_graphs_per_sec": gps[-1],
+            "best_graphs_per_sec": max(gps),
+            "mean_graphs_per_sec": sum(gps) / len(gps),
+        }
+    if waste:
+        throughput["mean_padding_waste"] = sum(waste) / len(waste)
+
+    # per-bucket compiled cost: LAST capture wins (a resumed run's
+    # recompile re-reports the same bucket)
+    programs: Dict[str, Dict] = {}
+    for r in records:
+        if r["event"] != "compile":
+            continue
+        cost = r.get("cost") or {}
+        mem = r.get("memory") or {}
+        programs[r["bucket"]] = {
+            "name": r.get("name"),
+            "bucket": r["bucket"],
+            "flops": _num(cost.get("flops")),
+            "bytes_accessed": _num(cost.get("bytes_accessed")),
+            "peak_bytes": _num(mem.get("peak_bytes")),
+            "argument_bytes": _num(mem.get("argument_bytes")),
+            "output_bytes": _num(mem.get("output_bytes")),
+            "temp_bytes": _num(mem.get("temp_bytes")),
+        }
+
+    counts = {
+        key: sum(1 for r in records if r["event"] == key)
+        for key in (
+            "compile", "stall", "checkpoint_saved", "checkpoint_restored",
+            "guard_skip", "guard_restore", "resume", "staged", "fit_chunk",
+        )
+    }
+    counts["profile_done"] = sum(
+        1
+        for r in records
+        if r["event"] == "profile" and r.get("status") == "done"
+    )
+
+    timeline = []
+    t0 = ts[0] if ts else 0.0
+    for r in records:
+        ev = r["event"]
+        if ev == "compile":
+            c, m = r.get("cost") or {}, r.get("memory") or {}
+            desc = (
+                f"{r.get('name')} [{r.get('bucket')}] "
+                f"flops={_fmt_num(c.get('flops'))} "
+                f"peak={_fmt_bytes(m.get('peak_bytes'))}"
+            )
+        elif ev == "stall":
+            desc = (
+                f"step {r.get('step')}: {r.get('seconds')}s vs median "
+                f"{r.get('median')}s (x{r.get('factor')})"
+            )
+        elif ev == "checkpoint_saved":
+            desc = f"{r.get('name')} ({r.get('kind')})"
+        elif ev == "checkpoint_restored":
+            desc = f"{r.get('name')} from {r.get('source')}"
+        elif ev == "guard_skip":
+            desc = f"scope={r.get('scope')} skipped={r.get('skipped')}"
+        elif ev == "guard_restore":
+            desc = f"restores={r.get('restores')} lr={r.get('lr')}"
+        elif ev == "resume":
+            desc = f"start_epoch={r.get('start_epoch')}"
+        elif ev in ("early_stop", "wallclock_stop"):
+            desc = f"epoch={r.get('epoch')}"
+        elif ev == "profile":
+            desc = f"{r.get('status')} ({r.get('trace_dir', '')})"
+        else:
+            continue
+        timeline.append(
+            {
+                "t": round(float(r.get("ts", t0)) - t0, 3),
+                "event": ev,
+                "detail": desc,
+            }
+        )
+
+    return {
+        "run": {
+            "run": manifest.get("run"),
+            "config_hash": manifest.get("config_hash"),
+            "git_rev": manifest.get("git_rev"),
+            "world_size": manifest.get("world_size"),
+            "device_kind": manifest.get("device_kind"),
+            "device_count": manifest.get("device_count"),
+            "num_epoch": manifest.get("num_epoch"),
+            "status": run_end["status"] if run_end else "incomplete",
+            "duration_s": round(ts[-1] - ts[0], 3) if len(ts) > 1 else None,
+            "events": len(records),
+        },
+        "epochs": epochs,
+        "throughput": throughput,
+        "programs": programs,
+        "counts": counts,
+        "timeline": timeline,
+    }
+
+
+# ---- rendering -----------------------------------------------------------
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suffix}"
+    return f"{v:.6g}"
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for scale, suffix in ((2**30, "GiB"), (2**20, "MiB"), (2**10, "KiB")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suffix}"
+    return f"{v:.0f}B"
+
+
+def _fmt(v, digits=6) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+_EPOCH_COLS = (
+    ("epoch", "epoch"),
+    ("train", "train_loss"),
+    ("val", "val_loss"),
+    ("test", "test_loss"),
+    ("wall_s", "wall_time_s"),
+    ("graphs/s", "graphs_per_sec"),
+    ("waste", "padding_waste"),
+    ("mode", "mode"),
+)
+
+_PROGRAM_COLS = (
+    ("program", "name"),
+    ("bucket", "bucket"),
+    ("flops", "flops"),
+    ("bytes_accessed", "bytes_accessed"),
+    ("peak_hbm", "peak_bytes"),
+    ("args", "argument_bytes"),
+    ("out", "output_bytes"),
+    ("temp", "temp_bytes"),
+)
+
+
+def _program_rows(report) -> List[List[str]]:
+    rows = []
+    for key in sorted(report["programs"]):
+        p = report["programs"][key]
+        rows.append(
+            [
+                str(p.get("name") or "-"),
+                key.split("/", 1)[1] if "/" in key else key,
+                _fmt_num(p.get("flops")),
+                _fmt_num(p.get("bytes_accessed")),
+                _fmt_bytes(p.get("peak_bytes")),
+                _fmt_bytes(p.get("argument_bytes")),
+                _fmt_bytes(p.get("output_bytes")),
+                _fmt_bytes(p.get("temp_bytes")),
+            ]
+        )
+    return rows
+
+
+def _epoch_rows(report) -> List[List[str]]:
+    return [
+        [_fmt(e[field], 4) for _, field in _EPOCH_COLS]
+        for e in report["epochs"]
+    ]
+
+
+def _text_table(headers, rows) -> List[str]:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()]
+    for r in rows:
+        out.append(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        )
+    return out
+
+
+def _md_table(headers, rows) -> List[str]:
+    out = ["| " + " | ".join(headers) + " |"]
+    out.append("|" + "|".join(" --- " for _ in headers) + "|")
+    for r in rows:
+        out.append("| " + " | ".join(r) + " |")
+    return out
+
+
+def _summary_lines(report) -> List[str]:
+    run = report["run"]
+    c = report["counts"]
+    lines = [
+        f"run: {run['run']}  status: {run['status']}  "
+        f"git: {run['git_rev']}  config: {run['config_hash']}",
+        f"world: {run['world_size']} process(es) x "
+        f"{run['device_count']} {run['device_kind']} device(s)  "
+        f"epochs: {len(report['epochs'])}/{run['num_epoch']}  "
+        f"duration: {_fmt(run['duration_s'], 5)}s",
+        "counts: "
+        + "  ".join(f"{k}={v}" for k, v in sorted(c.items()) if v),
+    ]
+    t = report["throughput"]
+    if t:
+        lines.append(
+            "throughput: "
+            f"first {_fmt(t.get('first_graphs_per_sec'), 4)} -> "
+            f"last {_fmt(t.get('last_graphs_per_sec'), 4)} graphs/s "
+            f"(best {_fmt(t.get('best_graphs_per_sec'), 4)}, "
+            f"mean {_fmt(t.get('mean_graphs_per_sec'), 4)})"
+            + (
+                f", mean padding waste "
+                f"{_fmt(t.get('mean_padding_waste'), 3)}"
+                if t.get("mean_padding_waste") is not None
+                else ""
+            )
+        )
+    return lines
+
+
+def render_text(report: Dict) -> str:
+    lines = ["== run report =="]
+    lines += _summary_lines(report)
+    if report["epochs"]:
+        lines += ["", "-- epochs --"]
+        lines += _text_table(
+            [h for h, _ in _EPOCH_COLS], _epoch_rows(report)
+        )
+    if report["programs"]:
+        lines += ["", "-- compiled programs (XLA cost/memory) --"]
+        lines += _text_table(
+            [h for h, _ in _PROGRAM_COLS], _program_rows(report)
+        )
+    if report["timeline"]:
+        lines += ["", "-- timeline (s after first event) --"]
+        for item in report["timeline"]:
+            lines.append(
+                f"{item['t']:>10.3f}  {item['event']:<20} {item['detail']}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def render_markdown(report: Dict) -> str:
+    lines = [f"# Run report: {report['run']['run']}", ""]
+    lines += [line + "  " for line in _summary_lines(report)]
+    if report["epochs"]:
+        lines += ["", "## Epochs", ""]
+        lines += _md_table([h for h, _ in _EPOCH_COLS], _epoch_rows(report))
+    if report["programs"]:
+        lines += ["", "## Compiled programs (XLA cost/memory)", ""]
+        lines += _md_table(
+            [h for h, _ in _PROGRAM_COLS], _program_rows(report)
+        )
+    if report["timeline"]:
+        lines += ["", "## Timeline", ""]
+        lines += _md_table(
+            ["t (s)", "event", "detail"],
+            [
+                [f"{i['t']:.3f}", i["event"], i["detail"]]
+                for i in report["timeline"]
+            ],
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_json(report: Dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=True) + "\n"
+
+
+RENDERERS = {
+    "text": render_text,
+    "markdown": render_markdown,
+    "json": render_json,
+}
+
+
+# ---- perf-budget ratchet -------------------------------------------------
+
+
+def budget_from_report(report: Dict,
+                       tolerance: float = DEFAULT_TOLERANCE) -> Dict:
+    """The committed-baseline content for this run's compiled programs."""
+    programs = {}
+    for key, p in sorted(report["programs"].items()):
+        entry = {
+            m: p[m] for m in BUDGET_METRICS if p.get(m) is not None
+        }
+        if entry:
+            programs[key] = entry
+    return {
+        "version": BUDGET_VERSION,
+        "tolerance": tolerance,
+        "programs": programs,
+    }
+
+
+def load_budget(path: str) -> Dict:
+    with open(path) as f:
+        budget = json.load(f)
+    if not isinstance(budget, dict) or "programs" not in budget:
+        raise ValueError(f"{path}: not a perf-budget file (no 'programs')")
+    if budget.get("version", BUDGET_VERSION) != BUDGET_VERSION:
+        raise ValueError(
+            f"{path}: budget version {budget.get('version')} != "
+            f"{BUDGET_VERSION}"
+        )
+    return budget
+
+
+def check_budget(
+    report: Dict, budget: Dict, tolerance: Optional[float] = None
+) -> Tuple[List[Dict], List[str], List[str]]:
+    """(violations, unbudgeted, stale).
+
+    A VIOLATION is a budgeted figure the run exceeded beyond tolerance —
+    the gate's exit-1 condition. ``unbudgeted`` programs (in the run, not
+    the baseline) and ``stale`` entries (in the baseline, not the run)
+    are surfaced for the operator but do not fail: new buckets appear
+    legitimately, and the ratchet only tightens by an explicit
+    ``--write-budget`` commit."""
+    tol = (
+        float(tolerance)
+        if tolerance is not None
+        else float(budget.get("tolerance", DEFAULT_TOLERANCE))
+    )
+    violations = []
+    for key, baseline in sorted(budget["programs"].items()):
+        current = report["programs"].get(key)
+        if current is None:
+            continue
+        for metric, base in baseline.items():
+            cur = current.get(metric)
+            if cur is None or base is None:
+                continue
+            limit = float(base) * (1.0 + tol)
+            if float(cur) > limit:
+                violations.append(
+                    {
+                        "bucket": key,
+                        "metric": metric,
+                        "baseline": float(base),
+                        "limit": limit,
+                        "current": float(cur),
+                        "ratio": float(cur) / float(base)
+                        if base
+                        else float("inf"),
+                    }
+                )
+    unbudgeted = sorted(
+        set(report["programs"]) - set(budget["programs"])
+    )
+    stale = sorted(set(budget["programs"]) - set(report["programs"]))
+    return violations, unbudgeted, stale

@@ -27,7 +27,7 @@ import hashlib
 import json
 import os
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from hydragnn_tpu.obs.events import SCHEMA_VERSION, RunEventLog
 from hydragnn_tpu.obs.metrics import (
@@ -37,6 +37,73 @@ from hydragnn_tpu.obs.metrics import (
 )
 
 _active: Optional["RunTelemetry"] = None
+
+
+class FlightRecorder:
+    """Ring buffer of the last K step-dispatch times + stall detection.
+
+    A step counts as a STALL when its dispatch time strictly exceeds
+    ``stall_factor`` x the rolling median of the buffered window (median,
+    not mean — one earlier stall must not drag the threshold up). No
+    stall can fire until ``min_fill`` steps are buffered, so warmup and
+    first-epoch compile steps never alert; the caller additionally skips
+    recording steps that contained an XLA compile (their wall time IS
+    compile time). Not thread-safe by design — one training thread owns
+    it; ``snapshot()`` from other threads reads a consistent-enough copy
+    for diagnostics.
+    """
+
+    def __init__(self, capacity: int = 64, stall_factor: float = 8.0,
+                 min_fill: int = 8):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.stall_factor = float(stall_factor)
+        # clamped into [1, capacity]: a window smaller than min_fill
+        # could otherwise never satisfy the fill gate, silently disabling
+        # detection for the operator who SHRANK it to react faster
+        self.min_fill = max(min(int(min_fill), self.capacity), 1)
+        self._buf: List[float] = [0.0] * self.capacity
+        self._count = 0  # total steps ever recorded
+
+    def record(self, seconds: float) -> Optional[Dict]:
+        """Add one step time; returns the stall payload (step/seconds/
+        median/factor) when the step stalled, else None. The check runs
+        against the window BEFORE this step enters it — a stalled step is
+        judged by its predecessors, then buffered so a genuine regime
+        change re-baselines the median within a window."""
+        stall = None
+        filled = min(self._count, self.capacity)
+        if filled >= self.min_fill:
+            window = sorted(self._buf[:filled] if self._count < self.capacity
+                            else self._buf)
+            mid = filled // 2
+            median = (
+                window[mid]
+                if filled % 2
+                else 0.5 * (window[mid - 1] + window[mid])
+            )
+            if seconds > self.stall_factor * median:
+                stall = {
+                    "step": self._count,
+                    "seconds": seconds,
+                    "median": median,
+                    "factor": self.stall_factor,
+                }
+        self._buf[self._count % self.capacity] = float(seconds)
+        self._count += 1
+        return stall
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def snapshot(self) -> List[float]:
+        """Buffered step times, oldest first."""
+        if self._count < self.capacity:
+            return self._buf[: self._count]
+        i = self._count % self.capacity
+        return self._buf[i:] + self._buf[:i]
 
 
 class TrainingMetrics:
@@ -66,6 +133,22 @@ class TrainingMetrics:
         r.gauge(
             "heartbeat_age_seconds",
             "Seconds since the training loop last reported progress",
+        )
+        r.counter("stalls_total", "Steps exceeding the stall threshold")
+        # compiled-program accounting (obs/introspect.py): one label set
+        # per (program, shape-signature) bucket
+        r.labeled_gauge(
+            "flops_per_step", "Compiled-program FLOPs (XLA cost model)"
+        )
+        r.labeled_gauge(
+            "hbm_peak_bytes",
+            "Compiled-program peak memory (arg+out+temp-aliased)",
+        )
+        # live device memory, polled from device 0's memory_stats() at
+        # scrape time (stays 0 on backends that report none, e.g. CPU)
+        r.gauge("device_bytes_in_use", "Live device memory in use")
+        r.gauge(
+            "device_peak_bytes_in_use", "Peak device memory since start"
         )
         r.histogram(
             "epoch_seconds", "Epoch wall time", bounds=EPOCH_LATENCY_BOUNDS
@@ -115,10 +198,30 @@ class TrainingMetrics:
             r.set("padding_waste_ratio", float(padding_waste))
         self.beat()
 
+    def poll_device_memory(self):
+        """Refresh the live-memory gauges from device 0 (the heartbeat's
+        companion poll — runs at scrape time, never in the step loop)."""
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            return
+        if not stats:
+            return
+        self.registry.set(
+            "device_bytes_in_use", float(stats.get("bytes_in_use", 0))
+        )
+        self.registry.set(
+            "device_peak_bytes_in_use",
+            float(stats.get("peak_bytes_in_use", 0)),
+        )
+
     def render_prometheus(self) -> str:
         self.registry.set(
             "heartbeat_age_seconds", max(time.time() - self.last_beat, 0.0)
         )
+        self.poll_device_memory()
         return self.registry.render_prometheus()
 
     def snapshot(self) -> Dict:
@@ -212,6 +315,11 @@ class RunTelemetry:
         port: Optional[int] = None,
         events: bool = True,
     ):
+        from hydragnn_tpu.obs.introspect import (
+            TraceCapture,
+            parse_profile_at_step,
+        )
+
         self.run_name = run_name
         self.log_dir = log_dir
         self.metrics = TrainingMetrics()
@@ -222,6 +330,20 @@ class RunTelemetry:
         )
         self.server = None
         self._closed = False
+        # step-time flight recorder + on-demand trace capture — both
+        # driven from on_step() on the training thread
+        self.flight = FlightRecorder(
+            capacity=int(os.getenv("HYDRAGNN_FLIGHT_STEPS", "64")),
+            stall_factor=float(os.getenv("HYDRAGNN_STALL_FACTOR", "8.0")),
+        )
+        self.trace = TraceCapture(os.path.join(log_dir, "profile"))
+        self._profile_at = parse_profile_at_step(
+            os.getenv("HYDRAGNN_PROFILE_AT_STEP")
+        )
+        self._profile_steps = int(os.getenv("HYDRAGNN_PROFILE_STEPS", "3"))
+        self.current_epoch = 0
+        self._step_in_epoch = 0
+        self._compile_events_at_step = _compile_events
         _register_compile_listener()
         if port is not None:
             from hydragnn_tpu.obs.http import ObservabilityServer
@@ -244,6 +366,97 @@ class RunTelemetry:
     @property
     def address(self):
         return None if self.server is None else self.server.address
+
+    # ---- per-step instrumentation --------------------------------------
+    def on_step(self, seconds: float, count: int = 1):
+        """One training-step dispatch completed: metrics, flight
+        recorder / stall detection, trace-capture tick, env-armed
+        profiling. Called from the training thread only."""
+        self.metrics.on_step(seconds, count)
+        # a step whose dispatch included an XLA compile is compile time,
+        # not a stall — keep it out of the ring so it neither alerts nor
+        # skews the rolling median (warmup is additionally covered by the
+        # recorder's min_fill). Without compile visibility (no
+        # jax.monitoring listener on this jax version) stalls are
+        # recorded but never ALERTED: a guaranteed false alarm on every
+        # mid-run novel-bucket compile is worse than no alarm.
+        compiled_now = _compile_events != self._compile_events_at_step
+        self._compile_events_at_step = _compile_events
+        if not compiled_now:
+            # per-step time: K-step scan dispatches must compare against
+            # single-step dispatches on the same scale, or bucketed runs
+            # mixing the two alert on every full group
+            stall = self.flight.record(seconds / max(int(count), 1))
+            if stall is not None and _compile_listener_registered:
+                self.metrics.registry.inc("stalls_total")
+                self.emit(
+                    "stall",
+                    step=int(stall["step"]),
+                    seconds=round(float(stall["seconds"]), 6),
+                    median=round(float(stall["median"]), 6),
+                    factor=float(stall["factor"]),
+                    epoch=int(self.current_epoch),
+                )
+        self._step_in_epoch += count
+        if (
+            self._profile_at is not None
+            and self.current_epoch == self._profile_at[0]
+            and self._step_in_epoch >= self._profile_at[1]
+        ):
+            self._profile_at = None
+            self.profile(self._profile_steps)
+        transition = self.trace.tick()
+        if transition is not None:
+            self.emit("profile", **transition)
+
+    def on_epoch_start(self, epoch: int):
+        self.current_epoch = int(epoch)
+        self._step_in_epoch = 0
+
+    def on_dispatch_boundary(self):
+        """Fit-path granularity: whole-training chunks dispatch as ONE
+        XLA program with no per-step hook, so trace capture ticks (and
+        HYDRAGNN_PROFILE_AT_STEP arming, resolved against the chunk's
+        starting epoch — the step part is unsatisfiable here) advance at
+        chunk boundaries instead. A ``/profile`` "step" on this path is
+        one chunk; without this hook an arm request would wedge the
+        endpoint in 'busy' forever."""
+        if (
+            self._profile_at is not None
+            and self.current_epoch >= self._profile_at[0]
+        ):
+            self._profile_at = None
+            self.profile(self._profile_steps)
+        transition = self.trace.tick()
+        if transition is not None:
+            self.emit("profile", **transition)
+
+    def record_compile(self, rec: Dict):
+        """One novel (program, shape signature) was compiled: event +
+        per-bucket cost/memory gauges (obs/introspect.py calls this)."""
+        cost = rec.get("cost") or {}
+        mem = rec.get("memory") or {}
+        bucket = rec["bucket"]
+        if cost.get("flops"):
+            self.metrics.registry.set_labeled(
+                "flops_per_step", float(cost["flops"]), bucket=bucket
+            )
+        if mem.get("peak_bytes"):
+            self.metrics.registry.set_labeled(
+                "hbm_peak_bytes", float(mem["peak_bytes"]), bucket=bucket
+            )
+        self.emit(
+            "compile", name=rec["name"], bucket=bucket, cost=cost,
+            memory=mem,
+        )
+
+    def profile(self, steps: int) -> Dict:
+        """Arm device-trace capture for the next ``steps`` steps — the
+        ``/profile?steps=N`` provider hook (any thread)."""
+        result = self.trace.arm(steps)
+        if result.get("status") == "armed":
+            self.emit("profile", **result)
+        return result
 
     # ---- lifecycle -----------------------------------------------------
     def emit(self, event: str, **fields):
@@ -274,6 +487,10 @@ class RunTelemetry:
         if self._closed:
             return
         self._closed = True
+        # a run dying mid-capture must still flush a loadable trace
+        flushed = self.trace.close()
+        if flushed is not None:
+            self.emit("profile", **flushed)
         self.emit("run_end", status=status)
         if self.events is not None:
             self.events.close()
@@ -312,6 +529,22 @@ def emit(event: str, **fields):
     t = _active
     if t is not None:
         t.emit(event, **fields)
+
+
+def epoch_start(epoch: int):
+    """The epoch driver announces each epoch (resets the per-epoch step
+    counter behind HYDRAGNN_PROFILE_AT_STEP's <epoch>:<step> target)."""
+    t = _active
+    if t is not None:
+        t.on_epoch_start(epoch)
+
+
+def dispatch_boundary():
+    """The fit path announces each whole-chunk dispatch completing (see
+    :meth:`RunTelemetry.on_dispatch_boundary`)."""
+    t = _active
+    if t is not None:
+        t.on_dispatch_boundary()
 
 
 def epoch_complete(

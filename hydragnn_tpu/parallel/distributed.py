@@ -174,14 +174,39 @@ def host_allgather_int(value: int):
 
 def print_peak_memory(verbosity: int = 0, prefix: str = ""):
     """Device-memory report (analog of ``print_peak_memory``,
-    ``distributed.py:277-284``)."""
+    ``distributed.py:277-284``).
+
+    One device lacking ``memory_stats()`` must not hide the rest
+    (``continue``, not ``return`` — the old early-return skipped every
+    remaining device). Output goes through the obs layer: a
+    ``device_memory`` event when telemetry is live, plus the rank-0
+    console line (always — a diagnostic named print_* must not be a
+    silent no-op at the default verbosity; non-zero ranks report via the
+    event stream only)."""
     import jax
 
+    from hydragnn_tpu.obs import runtime as obs
+    from hydragnn_tpu.utils.print_utils import print_master
+
+    devices = []
     for d in jax.local_devices():
         try:
             stats = d.memory_stats()
         except Exception:
-            return
-        if stats:
-            peak = stats.get("peak_bytes_in_use", 0)
-            print(f"{prefix} {d}: peak {peak / 2**20:.1f} MB")
+            continue
+        if not stats:
+            continue
+        peak = int(stats.get("peak_bytes_in_use", 0))
+        devices.append(
+            {
+                "device": str(d),
+                "peak_bytes_in_use": peak,
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            }
+        )
+        print_master(
+            f"{prefix} {d}: peak {peak / 2**20:.1f} MB",
+            verbosity_level=verbosity,
+        )
+    if devices:
+        obs.emit("device_memory", prefix=prefix, devices=devices)

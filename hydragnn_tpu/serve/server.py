@@ -484,6 +484,8 @@ class InferenceServer:
         if fn is None:
             import jax
 
+            from hydragnn_tpu.obs.introspect import instrument
+
             model = entry.model
 
             def _apply(params, batch_stats, batch):
@@ -492,7 +494,15 @@ class InferenceServer:
                     variables["batch_stats"] = batch_stats
                 return model.apply(variables, batch, train=False)
 
-            fn = jax.jit(_apply)
+            # introspection-wrapped (obs/introspect.py): when enabled
+            # (live telemetry or HYDRAGNN_INTROSPECT=1), every serving
+            # bucket's compiled cost/memory analysis is captured at
+            # warmup — introspect.captured() carries it even without a
+            # telemetry run. Pure passthrough otherwise.
+            fn = instrument(
+                f"serve_predict:{entry.name}:v{entry.version}",
+                jax.jit(_apply),
+            )
             self._predict_fns[entry.key] = fn
         return fn
 

@@ -343,6 +343,10 @@ def train_validate_test(
             guard.commit(state)  # chunk-granular last-good seed
         while epoch0 < num_epoch:
             n = min(fit_chunk, num_epoch - epoch0)
+            # chunk-granular epoch announcement: the fit path dispatches
+            # whole chunks, so HYDRAGNN_PROFILE_AT_STEP resolves against
+            # the chunk's starting epoch here
+            obs.epoch_start(epoch0)
             if restage and epoch0 > 0:
                 train_loader.set_epoch(epoch0)
                 # release the old stack FIRST — holding it through the
@@ -370,6 +374,9 @@ def train_validate_test(
                 epochs=int(n),
                 wall_time_s=round(chunk_time, 6),
             )
+            # whole-chunk dispatches have no per-step hook: trace-capture
+            # ticks (and env-armed profiling) advance per chunk here
+            obs.dispatch_boundary()
             for i in range(n):
                 if np.isnan(series["train_loss"][i]):
                     continue
@@ -469,6 +476,9 @@ def train_validate_test(
     for epoch in host_epochs:
         t0 = time.time()
         trainer.final_state_saved = False  # state is about to change
+        # resets the telemetry step-in-epoch counter (the anchor for
+        # HYDRAGNN_PROFILE_AT_STEP=<epoch>:<step> trace arming)
+        obs.epoch_start(epoch)
         train_loader.set_epoch(epoch)
         if staged is not None:
             state, rng, train_loss, train_tasks = trainer.train_epoch_staged(

@@ -12,11 +12,15 @@ No reference counterpart: HydraGNN's ``run_training`` can only scale over
 many small graphs.
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from hydragnn_tpu.models.create import init_model_params
+from hydragnn_tpu.obs import runtime as obs
+from hydragnn_tpu.obs.introspect import instrument
 from hydragnn_tpu.train.optimizer import select_optimizer
 from hydragnn_tpu.train.trainer import Trainer, TrainState, _nbatch
 from hydragnn_tpu.utils import tracer as tr
@@ -191,11 +195,17 @@ class PartitionedTrainer:
             step=jnp.zeros((), jnp.int32),
         )
         state = put_partitioned_state(state, self.mesh)
-        self._train_step = make_partitioned_train_step(
-            self.model, self.tx, self.mesh, self.axis
+        # same XLA introspection as the data-parallel steps (steps.py):
+        # per-bucket compiled cost/memory lands in the compile events
+        self._train_step = instrument(
+            "partitioned_train_step",
+            make_partitioned_train_step(
+                self.model, self.tx, self.mesh, self.axis
+            ),
         )
-        self._eval_step = make_partitioned_eval_step(
-            self.model, self.mesh, self.axis
+        self._eval_step = instrument(
+            "partitioned_eval_step",
+            make_partitioned_eval_step(self.model, self.mesh, self.axis),
         )
         return state
 
@@ -250,12 +260,18 @@ class PartitionedTrainer:
         acc = None
         nbatch = _nbatch(loader)
         tr.start("train")
+        # one global read per epoch, per-step hooks only when live — the
+        # same contract as Trainer.train_epoch
+        _telemetry = obs.active()
         for ibatch, batch in enumerate(loader):
             if ibatch >= nbatch:
                 break
             batch = self.put_batch(batch)
             rng, sub = jax.random.split(rng)
+            t0 = time.perf_counter() if _telemetry is not None else 0.0
             state, metrics = self._train_step(state, batch, sub)
+            if _telemetry is not None:
+                _telemetry.on_step(time.perf_counter() - t0)
             acc = self._acc_add(acc, metrics)
         loss, tasks = self._acc_read(acc)
         tr.stop("train")

@@ -10,6 +10,7 @@ import os
 import jax
 import numpy as np
 
+from hydragnn_tpu.obs.introspect import record_function
 from hydragnn_tpu.train.common import _env_flag, _is_oom, _nbatch
 
 
@@ -107,9 +108,13 @@ class PredictMixin:
             if ibatch >= nbatch:
                 break
             dev_batch = self.put_batch(batch)
-            metrics = self._eval_step(
-                state.params, state.batch_stats, dev_batch
-            )
+            # annotated so an on-demand device trace (/profile?steps=N)
+            # shows predict dispatches as a named region, not anonymous
+            # XLA launches
+            with record_function("hydragnn.predict_batch"):
+                metrics = self._eval_step(
+                    state.params, state.batch_stats, dev_batch
+                )
             # loss/tasks/num_graphs accumulate ON DEVICE as one packed
             # vector per batch (Trainer._acc_add) — the per-batch
             # float()/np.asarray() fetches this replaces each cost a full
@@ -196,9 +201,10 @@ class PredictMixin:
         """One-scan, one-readback predict over a staged test set."""
         num_heads = self.model.num_heads
         staged = self.put_batch_stacked(stacked)
-        loss_b, tasks_b, g_b, outputs_b = jax.device_get(
-            self._predict_scan(state.params, state.batch_stats, staged)
-        )
+        with record_function("hydragnn.predict_scan"):
+            loss_b, tasks_b, g_b, outputs_b = jax.device_get(
+                self._predict_scan(state.params, state.batch_stats, staged)
+            )
         g_arr = np.asarray(g_b, np.float64)
         n = max(float(g_arr.sum()), 1.0)
         loss = float(np.asarray(loss_b, np.float64) @ g_arr) / n

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from hydragnn_tpu.obs.introspect import instrument
 from hydragnn_tpu.train.common import SchedState
 from hydragnn_tpu.train.transfer import _decompact_traced
 
@@ -372,15 +373,29 @@ def build_steps(model, tx, training_config: dict) -> CompiledSteps:
         nb = jax.tree_util.tree_leaves(data)[0].shape[0]
         return jax.lax.scan(body, None, jnp.arange(nb))[1]
 
+    # every hot-path program is wrapped for XLA introspection
+    # (obs/introspect.py): when telemetry is live, each novel compiled
+    # shape signature has its cost_analysis()/memory_analysis() captured
+    # once as a `compile` event + per-bucket gauges; otherwise the
+    # wrappers are pure passthroughs (.lower() etc. still forward, so
+    # benchmarks and the recompile sentinel see the jit they always saw)
     steps = CompiledSteps()
-    steps.train_step = jax.jit(train_step, donate_argnums=(0,))
-    steps.train_multi = jax.jit(multi_train_step, donate_argnums=(0,))
-    steps.epoch_scan = jax.jit(epoch_scan, donate_argnums=(0,))
-    steps.eval_epoch = jax.jit(eval_epoch)
-    steps.predict_scan = jax.jit(predict_scan)
+    steps.train_step = instrument(
+        "train_step", jax.jit(train_step, donate_argnums=(0,))
+    )
+    steps.train_multi = instrument(
+        "train_multi", jax.jit(multi_train_step, donate_argnums=(0,))
+    )
+    steps.epoch_scan = instrument(
+        "epoch_scan", jax.jit(epoch_scan, donate_argnums=(0,))
+    )
+    steps.eval_epoch = instrument("eval_epoch", jax.jit(eval_epoch))
+    steps.predict_scan = instrument("predict_scan", jax.jit(predict_scan))
     # donate state + sched; best_state is NOT donated (its initial value
     # may alias state's buffers)
-    steps.fit_scan = jax.jit(fit_scan, donate_argnums=(0, 2))
-    steps.eval_step = jax.jit(eval_step)
-    steps.eval_multi = jax.jit(eval_multi)
+    steps.fit_scan = instrument(
+        "fit_scan", jax.jit(fit_scan, donate_argnums=(0, 2))
+    )
+    steps.eval_step = instrument("eval_step", jax.jit(eval_step))
+    steps.eval_multi = instrument("eval_multi", jax.jit(eval_multi))
     return steps

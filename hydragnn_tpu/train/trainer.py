@@ -401,6 +401,9 @@ class Trainer(PredictMixin):
         tot = float(np.asarray(metrics["loss"], np.float64) @ g)
         tasks = (np.asarray(metrics["tasks"], np.float64) * g[:, None]).sum(0)
         tr.stop("train")
+        # the staged epoch is ONE dispatch with no per-step hook: trace
+        # capture (/profile, HYDRAGNN_PROFILE_AT_STEP) ticks per epoch
+        obs.dispatch_boundary()
         n = max(float(g.sum()), 1.0)
         return state, rng, tot / n, tasks / n
 
@@ -654,9 +657,9 @@ class Trainer(PredictMixin):
                 t0 = time.perf_counter() if _telemetry is not None else 0.0
                 state, metrics = self._train_multi(state, dev, subs[1:])
                 if _telemetry is not None:
-                    _telemetry.metrics.on_step(
-                        time.perf_counter() - t0, count
-                    )
+                    # the full per-step hook: metrics + flight recorder
+                    # (stall alerts) + on-demand trace-capture ticks
+                    _telemetry.on_step(time.perf_counter() - t0, count)
                 tr.stop("train_step")
                 acc = self._acc_add(acc, metrics, multi=True)
                 first = self._host_step
@@ -672,7 +675,7 @@ class Trainer(PredictMixin):
                 t0 = time.perf_counter() if _telemetry is not None else 0.0
                 state, metrics = self._train_step(state, dev, sub)
                 if _telemetry is not None:
-                    _telemetry.metrics.on_step(time.perf_counter() - t0)
+                    _telemetry.on_step(time.perf_counter() - t0)
                 tr.stop("train_step")
                 # the guard's documented cost: ONE scalar fetch per step to
                 # learn whether the update was finite — opt-in, and there is

@@ -1,109 +1,26 @@
-"""Profiler — step-scheduled device tracing for TensorBoard.
+"""Deprecated shim — the profiler moved to ``hydragnn_tpu.obs.introspect``.
 
-Parity with the reference's ``Profiler(torch.profiler.profile)``
-(``hydragnn/utils/profile.py:9-70``): a wait/warmup/active step schedule, a
-target-epoch gate, TensorBoard-consumable output, and a no-op object when
-disabled so call sites stay unconditional. The backend is ``jax.profiler``
-(XLA device traces, viewable in TensorBoard's profile plugin or perfetto)
-instead of torch.profiler/kineto.
-
-Usage (same call pattern as the reference train loop,
-``train_validate_test.py:155-169``):
-
-    prof = Profiler("./logs/run")
-    prof.setup(config["Visualization"].get("Profile", {}))
-    prof.set_current_epoch(epoch)
-    with prof:
-        for batch in loader:
-            ...
-            prof.step()
+``Profiler`` (the reference-parity wait/warmup/active step schedule over
+``jax.profiler``) and ``record_function`` now live in the observability
+layer next to the on-demand trace capture that superseded them
+(``/profile?steps=N`` on the observability endpoint,
+``HYDRAGNN_PROFILE_AT_STEP`` — see docs/observability.md). This module
+re-exports them so the reference-parity import path keeps working; new
+code should import from :mod:`hydragnn_tpu.obs.introspect`.
 """
 
-import os
-from typing import Optional
+import warnings
 
+from hydragnn_tpu.obs.introspect import (  # noqa: F401  (re-exported API)
+    Profiler,
+    record_function,
+)
 
-class Profiler:
-    def __init__(
-        self,
-        trace_dir: str = "./logs/profile",
-        wait: int = 5,
-        warmup: int = 3,
-        active: int = 3,
-        target_epoch: Optional[int] = 1,
-    ):
-        self.trace_dir = trace_dir
-        self.wait = wait
-        self.warmup = warmup
-        self.active = active
-        self.target_epoch = target_epoch
-        self.enabled = False
-        self._epoch = None
-        self._step = 0
-        self._tracing = False
-
-    def setup(self, config: dict):
-        """Config section ``{"Profile": {"enable": 1, "trace_dir": ...}}``
-        (reference reads ``config["Profile"]``, ``profile.py:22-29``)."""
-        if not config:
-            return
-        self.enabled = bool(config.get("enable", 0))
-        self.trace_dir = config.get("trace_dir", self.trace_dir)
-        self.wait = int(config.get("wait", self.wait))
-        self.warmup = int(config.get("warmup", self.warmup))
-        self.active = int(config.get("active", self.active))
-        self.target_epoch = config.get("target_epoch", self.target_epoch)
-
-    def set_current_epoch(self, epoch: int):
-        self._epoch = epoch
-
-    def _armed(self) -> bool:
-        if not self.enabled:
-            return False
-        return self.target_epoch is None or self._epoch == self.target_epoch
-
-    # -- context manager --------------------------------------------------
-    def __enter__(self):
-        self._step = 0
-        return self
-
-    def __exit__(self, *exc):
-        self._stop_trace()
-        return False
-
-    def step(self):
-        """Advance the schedule; starts/stops the device trace at the
-        wait→warmup→active window boundaries."""
-        if not self._armed():
-            return
-        self._step += 1
-        # trace through warmup+active, discard-by-convention the warmup part
-        if self._step == self.wait + 1:
-            self._start_trace()
-        elif self._step == self.wait + self.warmup + self.active + 1:
-            self._stop_trace()
-
-    def _start_trace(self):
-        if self._tracing:
-            return
-        import jax.profiler
-
-        os.makedirs(self.trace_dir, exist_ok=True)
-        jax.profiler.start_trace(self.trace_dir)
-        self._tracing = True
-
-    def _stop_trace(self):
-        if not self._tracing:
-            return
-        import jax.profiler
-
-        jax.profiler.stop_trace()
-        self._tracing = False
-
-
-def record_function(name: str):
-    """Annotation context (torch.profiler.record_function analog) — shows up
-    inside the XLA trace timeline."""
-    import jax.profiler
-
-    return jax.profiler.TraceAnnotation(name)
+# warn once per process, at first import — the module body runs once
+warnings.warn(
+    "hydragnn_tpu.utils.profile is deprecated: Profiler/record_function "
+    "moved to hydragnn_tpu.obs.introspect (on-demand trace capture lives "
+    "on the observability endpoint, /profile?steps=N)",
+    DeprecationWarning,
+    stacklevel=2,
+)

@@ -1,0 +1,152 @@
+"""CI observability smoke driver: a tiny live CPU training with the
+introspection surface exercised end to end.
+
+Usage: ``python tests/_obs_smoke.py <outdir>``
+
+Trains 2 epochs with telemetry active and the /healthz+/metrics+/profile
+endpoint live, hits ``/profile?steps=1`` from a mid-run hook, then
+asserts the run left behind: compile events with non-empty cost/memory
+analysis, a completed profile capture with a loadable trace dir, and a
+schema-valid ``events.jsonl`` at ``<outdir>/events.jsonl`` — which the CI
+step then feeds to ``python -m hydragnn_tpu.obs report --check-budget
+.perf-baseline.json``. Exits non-zero on any missing piece.
+
+(Underscore-prefixed: a driver script, not a collected test file. The
+pytest twin is tests/test_xla_introspect.py's e2e.)
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from _resilience_worker import make_samples  # noqa: E402
+
+
+class _ProfileOnEpochWriter:
+    def __init__(self, url):
+        self.url = url
+        self.response = None
+
+    def add_scalar(self, tag, value, step):
+        # arm at the FIRST epoch's scalar: the remaining epoch's steps
+        # drive the capture to completion before the run ends
+        if self.response is None and step >= 0:
+            self.response = json.loads(
+                urllib.request.urlopen(self.url, timeout=30).read()
+            )
+
+    def close(self):
+        pass
+
+
+def main(outdir: str) -> int:
+    from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.obs import runtime as obs_rt
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.train.epoch_driver import train_validate_test
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {"num_headlayers": 1, "dim_headlayers": [8],
+                     "type": "mlp"},
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {
+        "num_epoch": 2,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "resume_every": 0,
+    }
+    samples = make_samples()
+    layout = compute_layout([samples], batch_size=4)
+    loaders = (
+        GraphLoader(samples[:16], 4, layout, shuffle=True, seed=7),
+        GraphLoader(samples[16:20], 4, layout, shuffle=False),
+        GraphLoader(samples[20:], 4, layout, shuffle=False),
+    )
+    model = create_model_config(arch)
+    trainer = Trainer(model, training)
+    state = trainer.init_state(next(iter(loaders[0])), seed=0)
+
+    telem = obs_rt.activate(
+        obs_rt.RunTelemetry("obs-smoke", outdir, port=0)
+    )
+    try:
+        telem.emit_manifest(
+            {"NeuralNetwork": {"Training": training}}, "obs-smoke"
+        )
+        host, port = telem.address
+        writer = _ProfileOnEpochWriter(
+            f"http://{host}:{port}/profile?steps=1"
+        )
+        config_nn = {
+            "Training": training,
+            "Variables_of_interest": {"output_names": ["sum", "x"]},
+        }
+        train_validate_test(
+            trainer, state, *loaders, config_nn, "obs-smoke",
+            verbosity=0, writer=writer,
+        )
+        assert writer.response is not None, "mid-run /profile never hit"
+        assert writer.response["status"] == "armed", writer.response
+    finally:
+        obs_rt.deactivate()
+
+    recs = validate_events(
+        os.path.join(outdir, "events.jsonl"),
+        require=["run_manifest", "compile", "profile", "epoch", "run_end"],
+    )
+    compiles = [r for r in recs if r["event"] == "compile"]
+    bad = [
+        r for r in compiles
+        if not (r["cost"].get("flops") and r["memory"].get("peak_bytes"))
+    ]
+    assert compiles and not bad, (
+        f"compile events missing cost/memory analysis: {bad or 'none'}"
+    )
+    done = [
+        r for r in recs
+        if r["event"] == "profile" and r.get("status") == "done"
+    ]
+    assert done, "profile capture never completed"
+    trace_dir = done[-1]["trace_dir"]
+    trace_files = [
+        f
+        for _, _, files in os.walk(trace_dir)
+        for f in files
+    ]
+    assert any(f.endswith(".xplane.pb") for f in trace_files), (
+        f"no loadable trace under {trace_dir}: {trace_files}"
+    )
+    print(
+        f"obs smoke ok: {len(compiles)} compile event(s), trace in "
+        f"{trace_dir}, events at {os.path.join(outdir, 'events.jsonl')}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print("usage: python tests/_obs_smoke.py <outdir>", file=sys.stderr)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1]))

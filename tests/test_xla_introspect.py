@@ -1,0 +1,673 @@
+"""XLA introspection (hydragnn_tpu/obs/introspect + report): compiled
+cost/memory capture per bucket, the step-time flight recorder + stall
+detector, on-demand /profile trace capture, the post-mortem report CLI in
+all three formats, and the perf-budget ratchet — plus the acceptance e2e:
+a CPU training run whose compile events carry non-empty cost/memory
+analysis, a live /profile?steps=1 that writes a loadable trace dir, and a
+--check-budget that exits non-zero on an exceeded figure.
+
+(Named test_xla_* so it collects AFTER the established suite — the tier-1
+budget on slow hosts reaches the legacy files first.)
+"""
+
+import json
+import os
+import sys
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.obs import introspect as it
+from hydragnn_tpu.obs import report as rep
+from hydragnn_tpu.obs import runtime as obs_rt
+from hydragnn_tpu.obs.__main__ import main as obs_main
+from hydragnn_tpu.obs.events import validate_events
+from hydragnn_tpu.obs.runtime import FlightRecorder
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _resilience_worker import make_samples  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_introspection(monkeypatch):
+    """Every test starts with no active telemetry, no forced
+    introspection, and an empty capture store."""
+    monkeypatch.delenv("HYDRAGNN_INTROSPECT", raising=False)
+    obs_rt.deactivate()
+    it.reset_captured()
+    yield
+    obs_rt.deactivate()
+    it.reset_captured()
+
+
+# ---- flight recorder -----------------------------------------------------
+
+
+def pytest_flight_recorder_ring_wraparound():
+    fr = FlightRecorder(capacity=4, stall_factor=100.0, min_fill=1)
+    for i in range(10):
+        fr.record(float(i))
+    assert fr.count == 10
+    assert fr.snapshot() == [6.0, 7.0, 8.0, 9.0]
+    # before wrapping, snapshot is the partial prefix in order
+    fr2 = FlightRecorder(capacity=8, stall_factor=100.0, min_fill=1)
+    fr2.record(1.0)
+    fr2.record(2.0)
+    assert fr2.snapshot() == [1.0, 2.0]
+
+
+def pytest_flight_recorder_stall_threshold_edge():
+    fr = FlightRecorder(capacity=16, stall_factor=4.0, min_fill=4)
+    for _ in range(8):
+        assert fr.record(0.01) is None
+    # EXACTLY at factor x median must NOT fire (strictly-greater contract)
+    assert fr.record(0.04) is None
+    # a hair beyond does, judged against the window BEFORE the stalled
+    # step enters it
+    stall = fr.record(0.0401)
+    assert stall is not None
+    assert stall["median"] == pytest.approx(0.01)
+    assert stall["factor"] == 4.0
+    assert stall["seconds"] == pytest.approx(0.0401)
+    assert stall["step"] == 9
+
+
+def pytest_flight_recorder_min_fill_clamped_to_capacity():
+    # a 4-deep window with the default min_fill=8 must still detect —
+    # min_fill clamps to capacity instead of silently disabling stalls
+    fr = FlightRecorder(capacity=4, stall_factor=2.0)
+    assert fr.min_fill == 4
+    for _ in range(4):
+        fr.record(0.01)
+    assert fr.record(1.0) is not None
+
+
+def pytest_flight_recorder_no_stall_during_warmup():
+    # min_fill gates: even a 1000x step cannot stall before the window
+    # has enough history — first-epoch compile/warmup steps never alert
+    fr = FlightRecorder(capacity=16, stall_factor=2.0, min_fill=8)
+    for _ in range(7):
+        fr.record(0.01)
+    assert fr.record(10.0) is None  # 8th record: only 7 buffered
+    for _ in range(7):
+        fr.record(0.01)
+    assert fr.record(10.0) is not None  # window is live now
+
+
+def pytest_on_step_skips_compile_steps(tmp_path, monkeypatch):
+    """A step whose dispatch contained an XLA compile neither stalls nor
+    enters the ring (its wall time is compile time)."""
+    t = obs_rt.RunTelemetry("fr", str(tmp_path / "fr"), port=None)
+    try:
+        for _ in range(10):
+            t.on_step(0.01)
+        assert t.flight.count == 10
+        # simulate a backend compile landing during the next dispatch
+        monkeypatch.setattr(
+            obs_rt, "_compile_events", obs_rt._compile_events + 1
+        )
+        t.on_step(5.0)  # would be a flagrant stall if recorded
+        assert t.flight.count == 10  # skipped, not buffered
+        assert t.metrics.snapshot()["stalls_total"] == 0
+        # the NEXT non-compile slow step does stall
+        t.on_step(5.0)
+        assert t.metrics.snapshot()["stalls_total"] == 1
+    finally:
+        t.close()
+    recs = validate_events(str(tmp_path / "fr" / "events.jsonl"))
+    stalls = [r for r in recs if r["event"] == "stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["median"] == pytest.approx(0.01)
+    assert stalls[0]["factor"] == 8.0  # the documented default
+
+
+def pytest_on_step_normalizes_multi_step_dispatches(tmp_path):
+    """K-step scan dispatches are judged on PER-STEP time: a healthy
+    multi dispatch among single-step dispatches must not read as a
+    stall."""
+    t = obs_rt.RunTelemetry("ms", str(tmp_path / "ms"), port=None)
+    try:
+        for _ in range(10):
+            t.on_step(0.01)
+        t.on_step(0.08, count=8)  # 10ms/step: healthy, 8x the wall time
+        assert t.metrics.snapshot()["stalls_total"] == 0
+        t.on_step(0.9, count=8)  # 112ms/step > 8 x 10ms median: stall
+        assert t.metrics.snapshot()["stalls_total"] == 1
+    finally:
+        t.close()
+
+
+# ---- instrumented jit ----------------------------------------------------
+
+
+def pytest_instrument_passthrough_when_disabled():
+    f = it.instrument("toy", jax.jit(lambda x: x * 2))
+    np.testing.assert_allclose(np.asarray(f(jnp.ones(4))), 2.0)
+    assert it.captured() == []
+
+
+def pytest_instrument_captures_per_novel_shape(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_INTROSPECT", "1")
+    f = it.instrument("toy", jax.jit(lambda x: (x @ x).sum()))
+    f(jnp.ones((8, 8)))
+    f(jnp.ones((8, 8)))  # repeat shape: no second capture
+    f(jnp.ones((16, 16)))
+    recs = it.captured("toy")
+    assert len(recs) == 2
+    buckets = {r["bucket"] for r in recs}
+    assert len(buckets) == 2
+    for r in recs:
+        assert r["bucket"].startswith("toy/")
+        assert r["cost"].get("flops", 0) > 0
+        assert r["memory"].get("peak_bytes", 0) > 0
+        assert r["memory"].get("argument_bytes", 0) > 0
+    # the bigger matmul costs more flops — the figures are real
+    by_flops = sorted(r["cost"]["flops"] for r in recs)
+    assert by_flops[1] > by_flops[0]
+
+
+def pytest_instrument_forwards_attributes(monkeypatch):
+    jitted = jax.jit(lambda x: x + 1)
+    f = it.instrument("fw", jitted)
+    x = jnp.ones(3)
+    # the AOT surface benchmarks use, and the sentinel's cache probe
+    assert f.lower(x).compile() is not None
+    assert f._cache_size() == jitted._cache_size()
+    # a non-jit callable degrades to pure passthrough even when enabled
+    monkeypatch.setenv("HYDRAGNN_INTROSPECT", "1")
+    g = it.instrument("plain", lambda x: x * 3)
+    assert g(2) == 6
+    assert it.captured("plain") == []
+
+
+def pytest_instrument_bucket_label_stable():
+    key = it.signature_key((jnp.ones((4, 2)),))
+    assert it.bucket_label("p", key) == it.bucket_label("p", key)
+    other = it.signature_key((jnp.ones((4, 3)),))
+    assert it.bucket_label("p", key) != it.bucket_label("p", other)
+
+
+# ---- trace capture -------------------------------------------------------
+
+
+class _FakeJaxProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, trace_dir):
+        self.calls.append(("start", trace_dir))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax.profiler
+
+    fake = _FakeJaxProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    return fake
+
+
+def pytest_trace_capture_lifecycle(fake_profiler, tmp_path):
+    tc = it.TraceCapture(str(tmp_path / "tr"))
+    assert tc.arm(0)["status"] == "error"
+    assert tc.tick() is None  # idle: no-op
+    assert tc.arm(2)["status"] == "armed"
+    assert tc.arm(1)["status"] == "busy"  # one capture at a time
+    started = tc.tick()
+    assert started["status"] == "started" and started["steps"] == 2
+    assert fake_profiler.calls == [("start", str(tmp_path / "tr"))]
+    assert tc.tick() is None  # step 1 of 2
+    done = tc.tick()  # step 2 of 2 -> stop
+    assert done["status"] == "done"
+    assert fake_profiler.calls[-1] == ("stop",)
+    assert tc.tick() is None  # back to idle
+
+
+def pytest_trace_capture_start_failure_does_not_wedge(
+    monkeypatch, tmp_path
+):
+    """A profiler that refuses to start (another session active) must
+    surface as an error payload, not an exception into the training
+    loop — and the next arm must work."""
+    import jax.profiler
+
+    calls = []
+
+    def _boom(trace_dir):
+        if not calls:
+            calls.append("boom")
+            raise RuntimeError("profiler already active")
+        calls.append(("start", trace_dir))
+
+    monkeypatch.setattr(jax.profiler, "start_trace", _boom)
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+    )
+    tc = it.TraceCapture(str(tmp_path / "tr"))
+    assert tc.arm(1)["status"] == "armed"
+    err = tc.tick()
+    assert err["status"] == "error"
+    assert "already active" in err["error"]
+    # not wedged: a fresh arm starts cleanly once the profiler recovers
+    assert tc.arm(1)["status"] == "armed"
+    assert tc.tick()["status"] == "started"
+    assert tc.tick()["status"] == "done"
+
+
+def pytest_fit_path_profile_ticks_at_chunk_boundaries(
+    fake_profiler, tmp_path, monkeypatch
+):
+    """Whole-chunk dispatches have no per-step hook: /profile and
+    HYDRAGNN_PROFILE_AT_STEP resolve at dispatch boundaries instead of
+    wedging the endpoint in 'busy'."""
+    t = obs_rt.RunTelemetry("fitp", str(tmp_path / "fitp"), port=None)
+    obs_rt.activate(t)
+    try:
+        assert t.profile(1)["status"] == "armed"
+        obs_rt.epoch_start(0)
+        obs_rt.dispatch_boundary()  # chunk 1 done -> trace starts
+        assert fake_profiler.calls == [("start", t.trace.trace_dir)]
+        obs_rt.dispatch_boundary()  # chunk 2 done -> trace flushed
+        assert fake_profiler.calls[-1] == ("stop",)
+        assert t.profile(1)["status"] == "armed"  # endpoint not wedged
+    finally:
+        obs_rt.deactivate()
+
+
+def pytest_staged_epoch_profile_ticks_per_dispatch(
+    fake_profiler, tmp_path, monkeypatch
+):
+    """train_epoch_staged is ONE dispatch per epoch with no per-step
+    hook: /profile must tick per staged epoch, not wedge in 'busy'."""
+    monkeypatch.chdir(tmp_path)
+    trainer, state, loaders, _ = _build_tiny_training(num_epoch=2)
+    staged = trainer.stage_batches(list(loaders[0]))
+    rng = jax.random.PRNGKey(0)
+    t = obs_rt.activate(
+        obs_rt.RunTelemetry("st", str(tmp_path / "st"), port=None)
+    )
+    try:
+        state, rng, _, _ = trainer.train_epoch_staged(state, staged, rng)
+        assert t.profile(1)["status"] == "armed"
+        state, rng, _, _ = trainer.train_epoch_staged(state, staged, rng)
+        assert fake_profiler.calls[0][0] == "start"
+        state, rng, _, _ = trainer.train_epoch_staged(state, staged, rng)
+        assert fake_profiler.calls[-1] == ("stop",)
+        assert t.profile(1)["status"] == "armed"  # not wedged
+    finally:
+        obs_rt.deactivate()
+
+
+def pytest_trace_capture_close_flushes_open_trace(fake_profiler, tmp_path):
+    tc = it.TraceCapture(str(tmp_path / "tr"))
+    tc.arm(10)
+    tc.tick()
+    assert tc.close()["status"] == "done"
+    assert fake_profiler.calls[-1] == ("stop",)
+    assert tc.close() is None  # idempotent
+
+
+def pytest_parse_profile_at_step():
+    assert it.parse_profile_at_step(None) is None
+    assert it.parse_profile_at_step("") is None
+    assert it.parse_profile_at_step("2:5") == (2, 5)
+    assert it.parse_profile_at_step("7") == (0, 7)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert it.parse_profile_at_step("nope") is None
+    assert any("PROFILE_AT_STEP" in str(c.message) for c in caught)
+
+
+def pytest_env_armed_profile_at_step(fake_profiler, tmp_path, monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_PROFILE_AT_STEP", "1:2")
+    monkeypatch.setenv("HYDRAGNN_PROFILE_STEPS", "2")
+    t = obs_rt.RunTelemetry("arm", str(tmp_path / "arm"), port=None)
+    try:
+        t.on_epoch_start(0)
+        for _ in range(5):
+            t.on_step(0.01)
+        assert fake_profiler.calls == []  # wrong epoch: never armed
+        t.on_epoch_start(1)
+        t.on_step(0.01)
+        assert fake_profiler.calls == []  # step 1 < target 2
+        t.on_step(0.01)  # step 2: arms AND starts on the same tick
+        assert fake_profiler.calls == [("start", t.trace.trace_dir)]
+        t.on_step(0.01)
+        t.on_step(0.01)
+        assert fake_profiler.calls[-1] == ("stop",)
+        # one-shot: later epochs do not re-arm
+        t.on_epoch_start(1)
+        for _ in range(5):
+            t.on_step(0.01)
+        assert len(fake_profiler.calls) == 2
+    finally:
+        t.close()
+
+
+def pytest_http_profile_501_without_provider_support(tmp_path):
+    from hydragnn_tpu.obs.http import ObservabilityServer
+    from hydragnn_tpu.obs.metrics import MetricsRegistry
+
+    class Dummy:
+        metrics = MetricsRegistry("dummy")
+
+        def health(self):
+            return {"status": "ok"}
+
+    srv = ObservabilityServer(Dummy(), port=0).start()
+    try:
+        host, port = srv.address
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/profile?steps=1", timeout=10
+            )
+        assert exc.value.code == 501
+    finally:
+        srv.stop()
+
+
+# ---- deprecation shim ----------------------------------------------------
+
+
+def pytest_utils_profile_shim_reexports_and_warns():
+    import importlib
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        import hydragnn_tpu.utils.profile as shim
+
+        shim = importlib.reload(shim)  # module body re-runs: must warn
+    assert any(
+        issubclass(c.category, DeprecationWarning) for c in caught
+    )
+    assert shim.Profiler is it.Profiler
+    assert shim.record_function is it.record_function
+
+
+# ---- report + budget ratchet (unit) --------------------------------------
+
+
+def _write_events(path, records):
+    with open(path, "w") as f:
+        for i, r in enumerate(records):
+            f.write(json.dumps({"ts": 100.0 + i, "seq": i, **r}) + "\n")
+
+
+_MANIFEST = {
+    "event": "run_manifest", "schema_version": 1, "run": "r",
+    "config_hash": "c", "git_rev": "g", "world_size": 1,
+    "device_kind": "cpu", "device_count": 1, "num_epoch": 2,
+}
+
+
+def _synthetic_stream(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    _write_events(
+        path,
+        [
+            _MANIFEST,
+            {"event": "compile", "name": "train_step",
+             "bucket": "train_step/aaaa1111",
+             "cost": {"flops": 1000.0, "bytes_accessed": 500.0},
+             "memory": {"peak_bytes": 2048.0, "argument_bytes": 1024.0}},
+            {"event": "epoch", "epoch": 0, "train_loss": 0.5,
+             "val_loss": 0.6, "test_loss": 0.7, "mode": "stream",
+             "wall_time_s": 1.0, "graphs_per_sec": 100.0,
+             "padding_waste": 0.25},
+            {"event": "stall", "step": 9, "seconds": 1.0, "median": 0.1,
+             "factor": 8.0},
+            {"event": "epoch", "epoch": 1, "train_loss": None,
+             "val_loss": None, "test_loss": None, "mode": "stream"},
+            {"event": "run_end", "status": "complete"},
+        ],
+    )
+    return path
+
+
+def pytest_report_builds_and_renders_all_formats(tmp_path):
+    path = _synthetic_stream(tmp_path)
+    report = rep.build_report(rep.load_events(path))
+    assert report["run"]["status"] == "complete"
+    assert len(report["epochs"]) == 2
+    assert report["epochs"][1]["train_loss"] is None  # nulled NaN survives
+    assert report["throughput"]["best_graphs_per_sec"] == 100.0
+    assert report["counts"]["stall"] == 1
+    assert report["programs"]["train_step/aaaa1111"]["flops"] == 1000.0
+    text = rep.render_text(report)
+    assert "train_step" in text and "graphs/s" in text and "stall" in text
+    md = rep.render_markdown(report)
+    assert md.startswith("# Run report") and "| epoch |" in md
+    parsed = json.loads(rep.render_json(report))
+    assert parsed["run"]["status"] == "complete"
+
+
+def pytest_report_tolerates_torn_streams(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "epoch", "ts": 1.0, "seq": 0,
+                            "epoch": 0, "train_loss": 0.1,
+                            "val_loss": 0.1, "test_loss": 0.1,
+                            "mode": "stream"}) + "\n")
+        f.write('{"event": "epoch", "ts": 2.0, "se')  # torn tail
+    report = rep.build_report(rep.load_events(path))
+    assert len(report["epochs"]) == 1
+    assert report["run"]["status"] == "incomplete"  # no run_end recorded
+
+
+def pytest_budget_check_violations_and_notes(tmp_path):
+    path = _synthetic_stream(tmp_path)
+    report = rep.build_report(rep.load_events(path))
+    budget = rep.budget_from_report(report, tolerance=0.10)
+    assert budget["programs"]["train_step/aaaa1111"]["flops"] == 1000.0
+
+    # within tolerance: clean
+    assert rep.check_budget(report, budget) == ([], [], [])
+    # baseline tightened under the measurement -> violation with the
+    # offending metric named
+    tight = json.loads(json.dumps(budget))
+    tight["programs"]["train_step/aaaa1111"]["flops"] = 500.0
+    violations, unbudgeted, stale = rep.check_budget(report, tight)
+    assert [v["metric"] for v in violations] == ["flops"]
+    assert violations[0]["current"] == 1000.0
+    assert violations[0]["limit"] == pytest.approx(550.0)
+    # inside an explicitly wider tolerance: clean again
+    assert rep.check_budget(report, tight, tolerance=1.5)[0] == []
+    # unknown buckets on either side are notes, not failures
+    extra = json.loads(json.dumps(budget))
+    extra["programs"]["gone/00000000"] = {"flops": 1.0}
+    del extra["programs"]["train_step/aaaa1111"]
+    violations, unbudgeted, stale = rep.check_budget(report, extra)
+    assert violations == []
+    assert unbudgeted == ["train_step/aaaa1111"]
+    assert stale == ["gone/00000000"]
+
+
+def pytest_report_cli_exit_codes(tmp_path, capsys):
+    path = _synthetic_stream(tmp_path)
+    budget_path = str(tmp_path / "budget.json")
+    # usage error: no stream
+    assert obs_main(["report", str(tmp_path / "nope")]) == 2
+    # write the baseline from the run, then the check passes
+    assert obs_main(["report", path, "--write-budget", budget_path]) == 0
+    assert obs_main(["report", path, "--check-budget", budget_path]) == 0
+    # exceed beyond tolerance -> exit 1
+    budget = json.load(open(budget_path))
+    budget["programs"]["train_step/aaaa1111"]["peak_bytes"] = 100.0
+    json.dump(budget, open(budget_path, "w"))
+    capsys.readouterr()
+    assert obs_main(["report", path, "--check-budget", budget_path]) == 1
+    assert "OVER BUDGET" in capsys.readouterr().err
+    # malformed budget -> usage error
+    json.dump({"not": "a budget"}, open(budget_path, "w"))
+    assert obs_main(["report", path, "--check-budget", budget_path]) == 2
+
+
+def pytest_report_cli_refuses_vacuous_budget_pass(tmp_path, capsys):
+    """A stream with ZERO compile events cannot satisfy a non-empty
+    budget — the gate must fail loudly, not pass having checked
+    nothing (e.g. introspection silently off in CI)."""
+    path = str(tmp_path / "events.jsonl")
+    _write_events(
+        path, [_MANIFEST, {"event": "run_end", "status": "complete"}]
+    )
+    budget_path = str(tmp_path / "budget.json")
+    json.dump(
+        {"version": 1, "tolerance": 0.1,
+         "programs": {"train_step/aaaa1111": {"flops": 1.0}}},
+        open(budget_path, "w"),
+    )
+    capsys.readouterr()
+    assert obs_main(["report", path, "--check-budget", budget_path]) == 2
+    assert "no compile events" in capsys.readouterr().err
+
+
+# ---- the acceptance e2e --------------------------------------------------
+
+
+def _build_tiny_training(num_epoch=2):
+    from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {"num_headlayers": 1, "dim_headlayers": [8],
+                     "type": "mlp"},
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {
+        "num_epoch": num_epoch,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "resume_every": 0,
+    }
+    samples = make_samples()
+    layout = compute_layout([samples], batch_size=4)
+    loaders = (
+        GraphLoader(samples[:16], 4, layout, shuffle=True, seed=7),
+        GraphLoader(samples[16:20], 4, layout, shuffle=False),
+        GraphLoader(samples[20:], 4, layout, shuffle=False),
+    )
+    model = create_model_config(arch)
+    trainer = Trainer(model, training)
+    state = trainer.init_state(next(iter(loaders[0])), seed=0)
+    return trainer, state, loaders, training
+
+
+class _ProfileOnEpochWriter:
+    """writer= hook that arms /profile?steps=1 DURING the run — the
+    'on-demand capture on a live run' acceptance leg."""
+
+    def __init__(self, url):
+        self.url = url
+        self.response = None
+
+    def add_scalar(self, tag, value, step):
+        if self.response is None and step >= 1:
+            self.response = json.loads(
+                urllib.request.urlopen(self.url, timeout=10).read()
+            )
+
+    def close(self):
+        pass
+
+
+def pytest_introspection_training_e2e(tmp_path, monkeypatch):
+    from hydragnn_tpu.train.epoch_driver import train_validate_test
+
+    monkeypatch.chdir(tmp_path)
+    num_epoch = 3
+    trainer, state, loaders, training = _build_tiny_training(num_epoch)
+    log_dir = str(tmp_path / "logs" / "xla-e2e")
+    telem = obs_rt.activate(obs_rt.RunTelemetry("xla-e2e", log_dir, port=0))
+    try:
+        telem.emit_manifest(
+            {"NeuralNetwork": {"Training": training}}, "xla-e2e"
+        )
+        host, port = telem.address
+        writer = _ProfileOnEpochWriter(
+            f"http://{host}:{port}/profile?steps=1"
+        )
+        config_nn = {
+            "Training": training,
+            "Variables_of_interest": {"output_names": ["sum", "x"]},
+        }
+        train_validate_test(
+            trainer, state, *loaders, config_nn, "xla-e2e", verbosity=0,
+            writer=writer,
+        )
+        assert writer.response is not None, "mid-run /profile never hit"
+        assert writer.response["status"] == "armed"
+        # per-bucket compiled-cost gauges are live on /metrics
+        metrics = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10
+        ).read().decode()
+        assert 'hydragnn_train_flops_per_step{bucket="train_step/' in metrics
+        assert 'hydragnn_train_hbm_peak_bytes{bucket="train_step/' in metrics
+    finally:
+        obs_rt.deactivate()
+
+    # -- compile events carry non-empty cost AND memory analysis
+    recs = validate_events(
+        os.path.join(log_dir, "events.jsonl"),
+        require=["run_manifest", "compile", "profile", "epoch", "run_end"],
+    )
+    compiles = [r for r in recs if r["event"] == "compile"]
+    names = {r["name"] for r in compiles}
+    assert "train_step" in names and "eval_step" in names
+    assert len({r["bucket"] for r in compiles}) == len(compiles)
+    for r in compiles:
+        assert r["cost"].get("flops", 0) > 0, r
+        assert r["memory"].get("peak_bytes", 0) > 0, r
+        assert r["memory"].get("argument_bytes", 0) > 0, r
+
+    # -- the live-armed capture completed and left a loadable trace dir
+    profile_events = [r for r in recs if r["event"] == "profile"]
+    assert [p["status"] for p in profile_events][:3] == [
+        "armed", "started", "done"
+    ]
+    trace_dir = profile_events[-1]["trace_dir"]
+    trace_files = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(trace_dir)
+        for f in files
+    ]
+    assert any(f.endswith(".xplane.pb") for f in trace_files), trace_files
+
+    # -- the report CLI renders all three formats from this run
+    for fmt in ("text", "markdown", "json"):
+        assert obs_main(["report", log_dir, "--format", fmt]) == 0
+
+    # -- budget ratchet against THIS run: write, pass, then trip it
+    budget_path = str(tmp_path / "perf-baseline.json")
+    assert obs_main(["report", log_dir, "--write-budget", budget_path]) == 0
+    assert obs_main(["report", log_dir, "--check-budget", budget_path]) == 0
+    budget = json.load(open(budget_path))
+    key = next(
+        k for k in budget["programs"] if k.startswith("train_step/")
+    )
+    budget["programs"][key]["flops"] /= 10.0
+    json.dump(budget, open(budget_path, "w"))
+    assert obs_main(["report", log_dir, "--check-budget", budget_path]) == 1
