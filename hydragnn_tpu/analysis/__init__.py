@@ -1,17 +1,24 @@
-"""jaxlint: JAX/TPU anti-pattern static analysis + runtime guards.
+"""jaxlint + threadlint: static analysis + runtime guards, one engine.
 
 Static pass (``python -m hydragnn_tpu.analysis``): an AST-based rule
-engine targeting the failure modes this stack actually has — per-batch
-host syncs in step loops, jit wrappers rebuilt per call, state-threading
-jits missing ``donate_argnums``, PRNG key reuse, recompile-hazard static
-args, and general hygiene. See ``docs/static-analysis.md`` for the rule
-catalog, suppression syntax, and the baseline ratchet.
+engine in two suites. The ``jax`` suite (jaxlint) targets JAX/TPU
+anti-patterns — per-batch host syncs in step loops, jit wrappers rebuilt
+per call, state-threading jits missing ``donate_argnums``, PRNG key
+reuse, recompile-hazard static args, general hygiene. The
+``concurrency`` suite (threadlint, ``--suite=concurrency``) targets the
+always-on serving/telemetry surface — lock-order inversions, blocking
+calls under held locks, leaked threads/executors, lock-free mutation of
+lock-guarded state, unbounded or shutdown-hostile queues. See
+``docs/static-analysis.md`` for the rule catalog, suppression syntax,
+and the per-suite baseline ratchets.
 
 Runtime guards (``hydragnn_tpu.analysis.guards``): what the static pass
 cannot prove — a :class:`CompileSentinel` asserting the XLA compile
-counter stays flat after warmup, and :func:`no_host_syncs`, a
+counter stays flat after warmup, :func:`no_host_syncs`, a
 ``jax.transfer_guard`` harness that turns implicit device->host
-transfers into hard errors inside tests.
+transfers into hard errors inside tests, and :func:`lock_sanitizer`, a
+lock-order/deadlock sanitizer with per-lock wait/hold metrics and a
+stack-dumping watchdog.
 """
 
 from hydragnn_tpu.analysis.core import (  # noqa: F401
@@ -25,6 +32,7 @@ from hydragnn_tpu.analysis.core import (  # noqa: F401
 
 # importing the rule modules populates the registry
 from hydragnn_tpu.analysis import (  # noqa: F401  (registration side effect)
+    rules_concurrency,
     rules_host_sync,
     rules_hygiene,
     rules_jit,
@@ -32,6 +40,10 @@ from hydragnn_tpu.analysis import (  # noqa: F401  (registration side effect)
 )
 from hydragnn_tpu.analysis.guards import (  # noqa: F401
     CompileSentinel,
+    InstrumentedLock,
+    LockOrderViolation,
+    LockSanitizer,
+    lock_sanitizer,
     no_host_syncs,
     no_implicit_transfers,
 )

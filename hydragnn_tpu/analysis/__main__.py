@@ -12,7 +12,12 @@ import os
 import sys
 
 from hydragnn_tpu.analysis import baseline as baseline_mod
-from hydragnn_tpu.analysis.core import all_rules, analyze_paths
+from hydragnn_tpu.analysis.core import (
+    all_rules,
+    all_suites,
+    analyze_paths,
+    rules_in_suite,
+)
 from hydragnn_tpu.analysis.report import (
     render_github,
     render_json,
@@ -59,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="print per-rule counts (the ratchet numbers)",
     )
     p.add_argument(
+        "--suite",
+        metavar="SUITE",
+        help="run only one rule suite: 'jax' (the jaxlint gate) or "
+        "'concurrency' (the threadlint gate); default: every suite",
+    )
+    p.add_argument(
         "--select",
         metavar="RULES",
         help="comma-separated rule names to run (default: all)",
@@ -79,7 +90,7 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         for name, rule in sorted(all_rules().items()):
-            print(f"{name}: {rule.description}")
+            print(f"{name} [{rule.suite}]: {rule.description}")
         return 0
 
     paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
@@ -102,6 +113,27 @@ def main(argv=None) -> int:
         if given not in known:
             print(f"jaxlint: unknown rule {given!r}", file=sys.stderr)
             return 2
+    if args.suite is not None:
+        if args.suite not in all_suites():
+            print(
+                f"jaxlint: unknown suite {args.suite!r} "
+                f"(have: {', '.join(sorted(all_suites()))})",
+                file=sys.stderr,
+            )
+            return 2
+        suite_rules = rules_in_suite(args.suite)
+        select = suite_rules if select is None else (select & suite_rules)
+    # contradictory flags must not masquerade as a clean run: a
+    # --suite/--select/--ignore combination that leaves zero rules to
+    # execute would report 0 findings and exit 0 — a green gate that
+    # checked nothing
+    effective = (select if select is not None else known) - (ignore or set())
+    if not effective:
+        print(
+            "jaxlint: --suite/--select/--ignore leave no rule to run",
+            file=sys.stderr,
+        )
+        return 2
 
     result = analyze_paths(paths, select=select, ignore=ignore)
 
@@ -145,7 +177,7 @@ def main(argv=None) -> int:
     }[args.format]
     print(renderer(new, baselined, result))
     if args.stats:
-        print(render_stats(new, baselined, result))
+        print(render_stats(new, baselined, result, rules=select))
 
     if result.parse_errors:
         return 1

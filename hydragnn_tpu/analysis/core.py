@@ -24,8 +24,11 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
+# both tags are accepted everywhere: `jaxlint` predates the concurrency
+# suite (threadlint), and a suppression should read as the suite it
+# silences — but the engine is one engine
 _SUPPRESS_RE = re.compile(
-    r"#\s*jaxlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
+    r"#\s*(?:jaxlint|threadlint):\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
 )
 
 
@@ -122,10 +125,15 @@ _RULES: Dict[str, "Rule"] = {}
 
 class Rule:
     """Base class: subclasses set ``name``/``description`` and implement
-    ``check``. ``hot_path_patterns`` narrows a rule to specific files."""
+    ``check``. ``hot_path_patterns`` narrows a rule to specific files.
+    ``suite`` groups rules for ``--suite`` gating: the JAX/TPU rules are
+    ``jax`` (the jaxlint gate), the concurrency/shutdown-safety rules are
+    ``concurrency`` (the threadlint gate) — each gate ratchets against
+    its own baseline file."""
 
     name = ""
     description = ""
+    suite = "jax"
 
     def check(self, module: ModuleInfo) -> Iterable[Finding]:
         raise NotImplementedError
@@ -145,6 +153,14 @@ def register(cls):
 
 def all_rules() -> Dict[str, Rule]:
     return dict(_RULES)
+
+
+def all_suites() -> Set[str]:
+    return {r.suite for r in _RULES.values()}
+
+
+def rules_in_suite(suite: str) -> Set[str]:
+    return {name for name, r in _RULES.items() if r.suite == suite}
 
 
 # ---- AST helpers shared by the rule modules -------------------------------

@@ -1,6 +1,6 @@
 """Runtime correctness guards — what the static pass cannot prove.
 
-Two harnesses, both designed for tests (cheap, no-op-safe, CPU-friendly):
+Three harnesses, all designed for tests (cheap, no-op-safe, CPU-friendly):
 
 - :class:`CompileSentinel` asserts the XLA compile counter stays FLAT
   across a region: warm a step function up, enter the sentinel, run an
@@ -20,10 +20,29 @@ Two harnesses, both designed for tests (cheap, no-op-safe, CPU-friendly):
   is the stricter all-directions variant for regions that should move no
   data implicitly at all (a fully staged dispatch, a serve batch whose
   inputs are packed host-side).
+
+- :func:`lock_sanitizer` / :class:`InstrumentedLock` — the runtime half
+  of the threadlint concurrency suite (``rules_concurrency.py``). The
+  static pass sees lock orders the SOURCE nests; only execution sees the
+  orders call graphs compose at runtime. Instrumented locks track each
+  thread's held-lock set, build the global acquisition-order graph, and
+  record a :class:`LockOrderViolation` the moment any thread acquires
+  against an order another thread has already established — the deadlock
+  is caught on the first interleaving that could EVER deadlock, not the
+  unlucky run that does. Per-lock wait/hold-time histograms export
+  through a :class:`~hydragnn_tpu.obs.metrics.MetricsRegistry`, and a
+  deadlock watchdog dumps every thread's stack + held locks and emits a
+  ``deadlock_suspect`` event (``events.jsonl`` schema,
+  ``obs/events.py``) when an acquisition blocks past its threshold.
 """
 
 import contextlib
-from typing import Dict, Iterable, Optional
+import re
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from hydragnn_tpu.obs import runtime as _obs_runtime
 
@@ -151,3 +170,335 @@ def no_implicit_transfers():
         return
     with jax.transfer_guard("disallow"):
         yield
+
+
+# ---- lock sanitizer -------------------------------------------------------
+
+# lock waits/holds live well below the serving-latency bounds: critical
+# sections are microseconds when healthy, and the interesting tail is
+# "someone slept under a lock" (ms) through "deadlock suspect" (s)
+LOCK_LATENCY_BOUNDS = (
+    1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
+    0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+)
+
+_METRIC_SAFE_RE = re.compile(r"[^A-Za-z0-9_]")
+
+
+class LockOrderViolation(AssertionError):
+    """Two locks were acquired in opposite orders by live code paths."""
+
+
+def _call_site() -> str:
+    """'file.py:123 in fn' for the first frame outside this module."""
+    for frame in reversed(traceback.extract_stack()):
+        if frame.filename != __file__:
+            return f"{frame.filename}:{frame.lineno} in {frame.name}"
+    return "<unknown>"
+
+
+def _thread_dump(held: Dict[int, List[str]]) -> List[Dict]:
+    """One JSON-able record per live thread: name, held locks, stack."""
+    frames = sys._current_frames()
+    threads = []
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        stack = (
+            [
+                f"{f.filename}:{f.lineno} in {f.name}"
+                for f in traceback.extract_stack(frame)
+            ]
+            if frame is not None
+            else []
+        )
+        threads.append(
+            {
+                "name": t.name,
+                "ident": t.ident,
+                "daemon": t.daemon,
+                "held_locks": list(held.get(t.ident, ())),
+                "stack": stack,
+            }
+        )
+    return threads
+
+
+class InstrumentedLock:
+    """Drop-in ``threading.Lock``/``RLock`` wrapper reporting to a
+    :class:`LockSanitizer`. Same surface as the stdlib lock (``with``,
+    ``acquire(blocking=, timeout=)``, ``release``, ``locked``), so
+    production classes can take a lock *factory* and tests can inject
+    ``sanitizer.lock`` without touching the code under test."""
+
+    def __init__(self, sanitizer: "LockSanitizer", name: str, inner):
+        self._san = sanitizer
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._san._note_wait(self.name, blocking)
+        t0 = time.monotonic()
+        if not blocking:
+            ok = self._inner.acquire(False)
+        else:
+            ok = self._acquire_watched(timeout, t0)
+        if ok:
+            self._san._note_acquired(
+                self.name, time.monotonic() - t0, blocking
+            )
+        return ok
+
+    def _acquire_watched(self, timeout: float, t0: float) -> bool:
+        wd = self._san.watchdog_s
+        if wd is None:
+            return self._inner.acquire(True, timeout)
+        # first try inside the watchdog window; on expiry dump + emit,
+        # then keep blocking for the remainder — the watchdog REPORTS a
+        # suspected deadlock, it does not turn one into a TimeoutError.
+        # A caller timeout SHORTER than the threshold can never reach
+        # it: timing out there is the caller's normal control flow, not
+        # a deadlock suspect
+        first = wd if timeout < 0 else min(wd, timeout)
+        if self._inner.acquire(True, first):
+            return True
+        waited = time.monotonic() - t0
+        if timeout < 0 or timeout >= wd:
+            self._san._fire_watchdog(self.name, waited)
+        if timeout < 0:
+            return self._inner.acquire(True, -1)
+        remaining = timeout - waited
+        if remaining <= 0:
+            return False
+        return self._inner.acquire(True, remaining)
+
+    def release(self):
+        self._san._note_release(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+
+class LockSanitizer:
+    """Tracks per-thread held-lock sets across every
+    :class:`InstrumentedLock` it issued.
+
+    - **order graph**: first acquisition of B while holding A records the
+      edge A->B (with its call site). Acquiring A while ANY path B->..->A
+      already exists in the graph is an order inversion: two threads
+      running the two paths concurrently can deadlock. Recorded into
+      :attr:`violations` (and raised on :func:`lock_sanitizer` exit).
+    - **metrics**: per-lock wait/hold-time histograms into ``registry``
+      (``lock_wait_seconds_<name>`` / ``lock_hold_seconds_<name>``).
+    - **watchdog**: an acquisition blocked past ``watchdog_s`` dumps all
+      thread stacks + held locks into :attr:`deadlock_suspects` and
+      emits a ``deadlock_suspect`` event to ``event_log``.
+    """
+
+    def __init__(
+        self,
+        registry=None,
+        watchdog_s: Optional[float] = None,
+        event_log=None,
+    ):
+        self.registry = registry
+        self.watchdog_s = watchdog_s
+        self.event_log = event_log
+        self.violations: List[Dict] = []
+        self.deadlock_suspects: List[Dict] = []
+        self._mu = threading.Lock()
+        self._edges: Dict[Tuple[str, str], str] = {}  # (a, b) -> site
+        self._succ: Dict[str, List[str]] = {}  # edge adjacency, cached
+        self._held: Dict[int, List[str]] = {}  # ident -> acquisition order
+        self._acquired_at: Dict[Tuple[int, str], float] = {}
+
+    # ---- lock factories ------------------------------------------------
+    def lock(self, name: str) -> InstrumentedLock:
+        return InstrumentedLock(self, name, threading.Lock())
+
+    def rlock(self, name: str) -> InstrumentedLock:
+        return InstrumentedLock(self, name, threading.RLock())
+
+    def wrap(self, name: str, inner) -> InstrumentedLock:
+        """Instrument an existing lock object (e.g. swap a server's
+        ``_pending_lock`` in a test without rebuilding the server)."""
+        return InstrumentedLock(self, name, inner)
+
+    # ---- recording (called by InstrumentedLock) ------------------------
+    def _note_wait(self, name: str, blocking: bool):
+        """Pre-acquire inversion check. Non-blocking attempts are exempt
+        by construction: a trylock never waits, so it can never be the
+        blocked edge of a deadlock cycle — flagging the standard
+        trylock-avoidance idiom would be a false positive. The call site
+        is only captured when a violation is actually appended (stack
+        extraction is too expensive for every acquire)."""
+        if not blocking:
+            return
+        ident = threading.get_ident()
+        with self._mu:
+            held = self._held.get(ident, [])
+            for h in held:
+                if h == name:  # reentrant re-acquire: no new ordering
+                    return
+            for h in held:
+                path = self._find_path(name, h)
+                if path is not None:
+                    chain = " -> ".join(path)
+                    first_site = self._edges.get(
+                        (path[0], path[1]), "<unknown>"
+                    )
+                    self.violations.append(
+                        {
+                            "thread": threading.current_thread().name,
+                            "holding": h,
+                            "acquiring": name,
+                            "reverse_chain": chain,
+                            "site": _call_site(),
+                            "first_seen_site": first_site,
+                        }
+                    )
+
+    def _note_acquired(self, name: str, waited_s: float, blocking: bool):
+        """Post-acquire bookkeeping. Order edges are recorded HERE, not
+        pre-wait: a timed-out acquire must leave no phantom edge behind,
+        and only a blocking nest establishes an ordering another thread
+        could deadlock against (trylocks join the held set for dump and
+        later-edge purposes, but record no edge of their own)."""
+        ident = threading.get_ident()
+        with self._mu:
+            held = self._held.setdefault(ident, [])
+            first_hold = name not in held
+            if blocking and first_hold:
+                new = [h for h in held if (h, name) not in self._edges]
+                if new:
+                    site = _call_site()
+                    for h in new:
+                        self._edges[(h, name)] = site
+                        self._succ.setdefault(h, []).append(name)
+            held.append(name)
+            if first_hold:
+                # reentrant re-acquires must NOT reset the clock: the
+                # hold histogram measures the OUTERMOST hold
+                self._acquired_at[(ident, name)] = time.monotonic()
+        self._observe(f"lock_wait_seconds_{self._safe(name)}", waited_s)
+
+    def _note_release(self, name: str):
+        ident = threading.get_ident()
+        held_s = None
+        with self._mu:
+            held = self._held.get(ident, [])
+            if name in held:
+                # remove the LAST occurrence (reentrant locks nest)
+                held.reverse()
+                held.remove(name)
+                held.reverse()
+                if name not in held:
+                    t0 = self._acquired_at.pop((ident, name), None)
+                    if t0 is not None:
+                        held_s = time.monotonic() - t0
+                if not held:
+                    self._held.pop(ident, None)
+        if held_s is not None:
+            self._observe(
+                f"lock_hold_seconds_{self._safe(name)}", held_s
+            )
+
+    def _fire_watchdog(self, name: str, waited_s: float):
+        with self._mu:
+            held_snapshot = {k: list(v) for k, v in self._held.items()}
+        payload = {
+            "lock": name,
+            "waited_s": round(waited_s, 6),
+            "threads": _thread_dump(held_snapshot),
+        }
+        with self._mu:
+            self.deadlock_suspects.append(payload)
+        if self.event_log is not None:
+            self.event_log.emit("deadlock_suspect", **payload)
+
+    # ---- helpers -------------------------------------------------------
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """BFS path src -> dst through recorded edges (caller holds
+        ``_mu``; ``_succ`` is maintained on edge insert)."""
+        if src == dst:
+            return [src]
+        succ = self._succ
+        frontier = [[src]]
+        seen = {src}
+        while frontier:
+            path = frontier.pop(0)
+            for nxt in succ.get(path[-1], ()):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(path + [nxt])
+        return None
+
+    @staticmethod
+    def _safe(name: str) -> str:
+        return _METRIC_SAFE_RE.sub("_", name)
+
+    def _observe(self, metric: str, seconds: float):
+        if self.registry is None:
+            return
+        try:
+            self.registry.observe(metric, seconds)
+        except KeyError:
+            try:
+                self.registry.histogram(
+                    metric,
+                    "lock sanitizer latency",
+                    bounds=LOCK_LATENCY_BOUNDS,
+                )
+            except ValueError:
+                pass  # lost a declare race — the metric exists now
+            self.registry.observe(metric, seconds)
+
+    def assert_clean(self):
+        """Raise :class:`LockOrderViolation` if any inversion was seen."""
+        with self._mu:
+            violations = list(self.violations)
+        if violations:
+            v = violations[0]
+            raise LockOrderViolation(
+                f"{len(violations)} lock order inversion(s): thread "
+                f"{v['thread']!r} acquired `{v['acquiring']}` while "
+                f"holding `{v['holding']}` at {v['site']}, but the "
+                f"reverse order ({v['reverse_chain']}) was established "
+                f"at {v['first_seen_site']}"
+            )
+
+
+@contextlib.contextmanager
+def lock_sanitizer(
+    registry=None,
+    watchdog_s: Optional[float] = None,
+    event_log=None,
+    check_on_exit: bool = True,
+):
+    """Context harness for tests::
+
+        with lock_sanitizer(watchdog_s=0.5) as san:
+            server._pending_lock = san.wrap("pending", threading.Lock())
+            ... drive the server from several threads ...
+        # exit raises LockOrderViolation on any inversion seen
+
+    ``registry`` (a :class:`~hydragnn_tpu.obs.metrics.MetricsRegistry`)
+    receives per-lock wait/hold histograms; ``event_log`` (a
+    :class:`~hydragnn_tpu.obs.events.RunEventLog`) receives
+    ``deadlock_suspect`` events from the watchdog."""
+    san = LockSanitizer(
+        registry=registry, watchdog_s=watchdog_s, event_log=event_log
+    )
+    yield san
+    if check_on_exit:
+        san.assert_clean()

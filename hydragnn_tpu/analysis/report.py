@@ -6,7 +6,7 @@ the diff line that introduced them, not in a log nobody scrolls.
 """
 
 import json
-from typing import Dict, List
+from typing import Dict, List, Optional, Set
 
 from hydragnn_tpu.analysis.core import AnalysisResult, Finding, all_rules
 
@@ -81,11 +81,18 @@ def render_github(
 
 
 def render_stats(
-    new: List[Finding], baselined: List[Finding], result: AnalysisResult
+    new: List[Finding],
+    baselined: List[Finding],
+    result: AnalysisResult,
+    rules: Optional[Set[str]] = None,
 ) -> str:
-    """Per-rule counts — the ratchet numbers CHANGES.md and CI logs cite."""
+    """Per-rule counts — the ratchet numbers CHANGES.md and CI logs cite.
+    ``rules`` restricts the table to the rules that actually ran (a
+    ``--suite``/``--select`` invocation should not list the other
+    suite's rules as zero-count noise)."""
     per_rule: Dict[str, Dict[str, int]] = {
-        name: {"new": 0, "baselined": 0} for name in sorted(all_rules())
+        name: {"new": 0, "baselined": 0}
+        for name in sorted(rules if rules is not None else all_rules())
     }
     for f in new:
         per_rule.setdefault(f.rule, {"new": 0, "baselined": 0})["new"] += 1

@@ -1,0 +1,743 @@
+"""threadlint: the concurrency & shutdown-safety rule suite.
+
+The always-on surface (micro-batched ``InferenceServer``, obs HTTP
+listeners, prefetch workers, flight recorder, thread-pooled HPO launcher)
+is exactly where deadlocks and leaked threads turn into 3 a.m. pages, and
+exactly what an AST pass CAN reason about: lock nesting is syntactic
+(``with self._lock:``), thread lifecycles are module-local (the repo's
+idiom creates, starts, and joins threads in one class), and queue
+boundedness is a constructor argument. Five rules, all reusing the
+jaxlint engine (per-line suppressions, fingerprint baseline ratchet,
+``--format=github`` annotations):
+
+- **lock-order-inversion** — per-module/per-class lock-acquisition graph
+  from nested ``with *_lock`` bodies; any cycle means two call paths can
+  interleave into a deadlock.
+- **blocking-under-lock** — device dispatch (``jax.device_get``,
+  ``block_until_ready``), file/socket/process I/O, ``queue.get/put``,
+  ``Event.wait`` and ``time.sleep`` inside a held-lock body: the lock's
+  critical section inherits the full latency (and on the serving path,
+  every submitter stalls behind it).
+- **thread-leak** — a non-daemon ``threading.Thread`` started with no
+  reachable ``join``, or an executor neither context-managed nor
+  ``shutdown`` — interpreter exit hangs, or workers outlive the epoch
+  holding batches on device.
+- **unguarded-shared-state** — a class that owns a lock and mutates some
+  attribute under it in one method, then mutates the same attribute
+  lock-free in another: the lock documents the invariant, the bare write
+  breaks it.
+- **queue-misuse** — unbounded queues on serving/loader paths (a stalled
+  consumer grows them without bound), and blocking ``.get()`` without a
+  timeout inside stop/shutdown paths (shutdown wedges on an empty queue).
+
+The static suite is paired with the runtime lock sanitizer
+(:mod:`hydragnn_tpu.analysis.guards`: ``lock_sanitizer()`` /
+``InstrumentedLock`` + the deadlock watchdog) for the orderings only
+execution can see. Suppressions accept the ``# threadlint: disable=...``
+tag as well as ``# jaxlint:``.
+"""
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from hydragnn_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    matches_any,
+    register,
+    walk_no_nested_functions,
+)
+
+# a `with X:` context whose dotted name's last segment matches this is a
+# lock acquisition (self._lock, _LOCK, _captured_lock, _pending_lock, ...)
+_LOCK_NAME_RE = re.compile(r"(lock|mutex)s?$", re.IGNORECASE)
+
+# receivers that read as queues for get/put classification
+_QUEUE_RECV_RE = re.compile(r"(queue$|(^|\.)_?q$|_q$)", re.IGNORECASE)
+
+# receivers that read as file/socket handles for read/write/flush
+_FILE_RECV_RE = re.compile(
+    r"(^|\.)_?(f|fh|fp|file\w*|out|sock\w*|conn\w*|wfile|rfile)$",
+    re.IGNORECASE,
+)
+
+
+def _last_segment(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_lock_expr(node: ast.AST) -> Optional[str]:
+    """Dotted name of a lock-like context expression, else None.
+    ``with self._lock:`` and ``with lock.acquire_timeout(...)`` style
+    helpers both resolve through their dotted names."""
+    name = dotted_name(node)
+    if not name and isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+    if name and _LOCK_NAME_RE.search(_last_segment(name)):
+        return name
+    return None
+
+
+def _with_lock_names(stmt: ast.With) -> List[str]:
+    names = []
+    for item in stmt.items:
+        name = _is_lock_expr(item.context_expr)
+        if name is not None:
+            names.append(name)
+    return names
+
+
+def _receiver_name(call: ast.Call) -> str:
+    """Dotted name of the receiver of an attribute call ('' otherwise)."""
+    if isinstance(call.func, ast.Attribute):
+        return dotted_name(call.func.value)
+    return ""
+
+
+def _enclosing_scopes(module: ModuleInfo):
+    """Yield (class_name_or_'', function_def) for every function, so
+    rules can qualify ``self.X`` references per class."""
+    def visit(node, class_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (class_name, child)
+                yield from visit(child, class_name)
+            else:
+                yield from visit(child, class_name)
+
+    yield from visit(module.tree, "")
+
+
+def _qualify_lock(name: str, class_name: str) -> str:
+    """Scope a lock's dotted name: ``self._lock`` inside class C becomes
+    ``C.self._lock`` so two classes' ``self._lock`` stay distinct; bare
+    module-level names pass through."""
+    if name.startswith(("self.", "cls.")) and class_name:
+        return f"{class_name}.{name}"
+    return name
+
+
+# ---- lock-order-inversion -------------------------------------------------
+
+
+@register
+class LockOrderInversion(Rule):
+    name = "lock-order-inversion"
+    suite = "concurrency"
+    description = (
+        "Two locks acquired in opposite orders on different paths "
+        "(cycle in the module's nested-with lock graph) — two threads "
+        "taking one edge each deadlock"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        # edges[(outer, inner)] = the With node that acquired `inner`
+        edges: Dict[Tuple[str, str], ast.With] = {}
+        for class_name, fn in _enclosing_scopes(module):
+            self._collect(fn, class_name, [], edges)
+
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+
+        findings: List[Finding] = []
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b), site in sorted(
+            edges.items(), key=lambda kv: kv[1].lineno
+        ):
+            if (b, a) in reported:
+                continue  # one report per cycle pair
+            path = self._path(graph, b, a)
+            if path is None:
+                continue
+            reported.add((a, b))
+            chain = " -> ".join([a, b] + path[1:])
+            findings.append(
+                module.finding(
+                    self.name,
+                    site,
+                    f"lock order cycle: `{a}` is held while acquiring "
+                    f"`{b}` here, but another path acquires them in the "
+                    f"reverse order ({chain}) — two threads taking one "
+                    "path each deadlock; pick one global order",
+                )
+            )
+        return findings
+
+    def _collect(self, fn, class_name, held: List[str], edges):
+        """DFS over a function body tracking the held-lock stack; does
+        not descend into nested defs (they run on their own stacks)."""
+        def visit(node, held):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                names = [
+                    _qualify_lock(n, class_name)
+                    for n in _with_lock_names(node)
+                ]
+                inner = list(held)
+                for n in names:
+                    for h in inner:
+                        if h != n:
+                            edges.setdefault((h, n), node)
+                    inner.append(n)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, held)
+
+    @staticmethod
+    def _path(graph, src, dst) -> Optional[List[str]]:
+        """Shortest edge path src -> dst, or None (BFS; graphs are tiny)."""
+        if src == dst:
+            return [src]
+        frontier = [[src]]
+        seen = {src}
+        while frontier:
+            path = frontier.pop(0)
+            for nxt in sorted(graph.get(path[-1], ())):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(path + [nxt])
+        return None
+
+
+# ---- blocking-under-lock --------------------------------------------------
+
+# dotted names that block outright, wherever they appear
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep()",
+    "jax.device_get": "jax.device_get() (device sync)",
+    "jax.device_put": "jax.device_put() (device transfer)",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+}
+
+# terminal attribute names that block on any receiver
+_BLOCKING_ANY_RECV = {
+    "block_until_ready": "block_until_ready() (device sync)",
+    "wait": ".wait()",
+    "recv": "socket recv()",
+    "recv_into": "socket recv_into()",
+    "sendall": "socket sendall()",
+    "accept": "socket accept()",
+    "connect": "socket connect()",
+}
+
+# terminal names that block when the receiver reads as a file/socket
+_BLOCKING_FILE_RECV = {"read", "readline", "readlines", "write", "flush",
+                       "send"}
+
+# terminal names that block when the receiver reads as a queue, unless
+# the no-wait spelling / a non-blocking flag is used
+_BLOCKING_QUEUE_RECV = {"get", "put"}
+
+
+@register
+class BlockingUnderLock(Rule):
+    name = "blocking-under-lock"
+    suite = "concurrency"
+    description = (
+        "Blocking call (device sync, file/socket I/O, queue get/put, "
+        "Event.wait, sleep) inside a held-lock body — every other thread "
+        "needing the lock inherits the full latency"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+        for class_name, fn in _enclosing_scopes(module):
+            for node in walk_no_nested_functions(fn):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                lock_names = _with_lock_names(node)
+                if not lock_names:
+                    continue
+                lock = _qualify_lock(lock_names[0], class_name)
+                for child in self._body_nodes(node):
+                    if id(child) in seen:
+                        continue
+                    what = self._classify(child)
+                    if what:
+                        seen.add(id(child))
+                        findings.append(
+                            module.finding(
+                                self.name,
+                                child,
+                                f"{what} while holding `{lock}` — move "
+                                "the blocking work outside the critical "
+                                "section (snapshot under the lock, act "
+                                "after releasing it)",
+                            )
+                        )
+        return findings
+
+    @staticmethod
+    def _body_nodes(with_stmt):
+        """Nodes inside the with body, not crossing nested defs and not
+        descending into NESTED with-lock bodies (they report themselves,
+        against their own — innermost — lock)."""
+        stack = list(with_stmt.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)) and _with_lock_names(node):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _classify(node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted_name(node.func)
+        if name in _BLOCKING_DOTTED:
+            return f"`{_BLOCKING_DOTTED[name]}`"
+        if name == "open":
+            return "`open()` (file I/O)"
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        terminal = node.func.attr
+        recv = _receiver_name(node)
+        if terminal in _BLOCKING_ANY_RECV:
+            # threading.Event().wait() / sock.accept() / fut.wait() — but
+            # never subprocess-style `self.wait` overloads on constants
+            if isinstance(node.func.value, ast.Constant):
+                return None
+            return f"`{recv or '<expr>'}.{terminal}()`"
+        if terminal in _BLOCKING_QUEUE_RECV and _QUEUE_RECV_RE.search(recv):
+            for kw in node.keywords:
+                if kw.arg == "block" and (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                ):
+                    return None
+            return f"blocking `{recv}.{terminal}()`"
+        if terminal in _BLOCKING_FILE_RECV and _FILE_RECV_RE.search(recv):
+            return f"`{recv}.{terminal}()` (file/socket I/O)"
+        return None
+
+
+# ---- thread-leak ----------------------------------------------------------
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_EXECUTOR_CTORS = {
+    "ThreadPoolExecutor",
+    "ProcessPoolExecutor",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "futures.ThreadPoolExecutor",
+    "futures.ProcessPoolExecutor",
+}
+
+
+def _kwarg_const(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+@register
+class ThreadLeak(Rule):
+    name = "thread-leak"
+    suite = "concurrency"
+    description = (
+        "Non-daemon Thread started with no reachable join, or an "
+        "executor neither context-managed nor shutdown — interpreter "
+        "exit hangs, or workers outlive their owner holding resources"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        joined = self._joined_names(module)
+        shutdown = self._shutdown_names(module)
+        with_ctx = self._context_managed(module)
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee in _THREAD_CTORS:
+                if _kwarg_const(node, "daemon") is True:
+                    continue
+                target = self._binding_name(module, node)
+                if target is not None and target in joined:
+                    continue
+                where = (
+                    f"`{target}`" if target else "an unbound Thread"
+                )
+                findings.append(
+                    module.finding(
+                        self.name,
+                        node,
+                        f"non-daemon Thread {where} is started but never "
+                        "joined in this module — join it in the stop "
+                        "path (bounded timeout), or mark it daemon=True "
+                        "with an explicit lifecycle owner",
+                    )
+                )
+            elif callee in _EXECUTOR_CTORS:
+                if id(node) in with_ctx:
+                    continue
+                target = self._binding_name(module, node)
+                if target is not None and target in shutdown:
+                    continue
+                findings.append(
+                    module.finding(
+                        self.name,
+                        node,
+                        f"`{callee}` is neither used as a context "
+                        "manager nor `.shutdown()` anywhere in this "
+                        "module — worker threads outlive their owner",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _binding_name(module: ModuleInfo, call: ast.Call) -> Optional[str]:
+        """'x' / 'self._x' when the call is the value of an assignment
+        (searches the whole module — assignments are statements wrapping
+        the call node)."""
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for t in node.targets:
+                    name = dotted_name(t)
+                    if name:
+                        return _last_segment(name)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                if node.value is call:
+                    name = dotted_name(node.target)
+                    if name:
+                        return _last_segment(name)
+        return None
+
+    @staticmethod
+    def _joined_names(module: ModuleInfo) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                recv = dotted_name(node.func.value)
+                if recv:
+                    out.add(_last_segment(recv))
+        return out
+
+    @staticmethod
+    def _shutdown_names(module: ModuleInfo) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "shutdown"
+            ):
+                recv = dotted_name(node.func.value)
+                if recv:
+                    out.add(_last_segment(recv))
+        return out
+
+    @staticmethod
+    def _context_managed(module: ModuleInfo) -> Set[int]:
+        """ids of calls used directly as `with <call>(...)` contexts."""
+        out: Set[int] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        out.add(id(item.context_expr))
+        return out
+
+
+# ---- unguarded-shared-state -----------------------------------------------
+
+_LOCK_VALUE_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "Lock",
+    "RLock",
+}
+
+# method calls on a self attribute that mutate the underlying container
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "remove", "discard",
+    "pop", "popitem", "clear", "update", "setdefault",
+}
+
+
+@register
+class UnguardedSharedState(Rule):
+    name = "unguarded-shared-state"
+    suite = "concurrency"
+    description = (
+        "A class owns a lock and mutates an attribute under it in one "
+        "method, but mutates the same attribute lock-free in another — "
+        "the unguarded write races every guarded reader"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(module, node))
+        return findings
+
+    def _check_class(self, module: ModuleInfo, cls: ast.ClassDef):
+        lock_attrs = self._lock_attrs(cls)
+        if not lock_attrs:
+            return []
+        # (attr, method, under_lock, site)
+        mutations: List[Tuple[str, str, bool, ast.AST]] = []
+        for method in cls.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            self._collect_mutations(
+                method, lock_attrs, mutations, under=False
+            )
+        guarded = {
+            attr
+            for attr, meth, under, _ in mutations
+            if under and meth != "__init__"
+        }
+        out = []
+        for attr, meth, under, site in mutations:
+            if under or meth == "__init__" or attr not in guarded:
+                continue
+            if attr in lock_attrs:
+                continue
+            out.append(
+                module.finding(
+                    self.name,
+                    site,
+                    f"`self.{attr}` is mutated under the lock elsewhere "
+                    f"in `{cls.name}` but written lock-free in "
+                    f"`{meth}` — take the lock here too (or document "
+                    "single-threaded ownership with a suppression)",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in _LOCK_VALUE_CTORS
+            ):
+                continue
+            for t in node.targets:
+                name = dotted_name(t)
+                if name.startswith("self."):
+                    out.add(name.split(".", 1)[1])
+        return out
+
+    def _collect_mutations(self, fn, lock_attrs, mutations, under):
+        """Walk a method body tracking whether a `with self.<lock>` is
+        held; record every self-attribute mutation with that flag."""
+        def self_attr_of_target(target) -> Optional[str]:
+            # self.x = / self.x[k] = / self.x += ...
+            node = target
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            name = dotted_name(node)
+            if name.startswith("self.") and name.count(".") == 1:
+                return name.split(".", 1)[1]
+            return None
+
+        def visit(node, under):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locks_here = {
+                    n.split(".", 1)[1]
+                    for n in _with_lock_names(node)
+                    if n.startswith("self.")
+                }
+                inner = under or bool(locks_here & lock_attrs)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    attr = self_attr_of_target(t)
+                    if attr:
+                        mutations.append((attr, fn.name, under, node))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                attr = self_attr_of_target(node.target)
+                if attr:
+                    mutations.append((attr, fn.name, under, node))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_METHODS
+            ):
+                recv = dotted_name(node.func.value)
+                if recv.startswith("self.") and recv.count(".") == 1:
+                    mutations.append(
+                        (recv.split(".", 1)[1], fn.name, under, node)
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child, under)
+
+        for stmt in fn.body:
+            visit(stmt, under)
+
+
+# ---- queue-misuse ---------------------------------------------------------
+
+_QUEUE_CTORS = {"queue.Queue", "Queue", "queue.LifoQueue", "LifoQueue",
+                "queue.PriorityQueue", "PriorityQueue"}
+_UNBOUNDED_OK_CTORS = {"queue.SimpleQueue", "SimpleQueue"}
+
+# serving / loader / listener paths where an unbounded queue is a paging
+# incident, not a style nit (extend when new always-on surfaces land)
+QUEUE_HOT_PATTERNS = (
+    "*/serve/*.py",
+    "*/data/loaders.py",
+    "*/obs/http.py",
+    "*/obs/runtime.py",
+    "*/hpo/launcher.py",
+    "serve/*.py",
+    "data/loaders.py",
+    "obs/http.py",
+    "obs/runtime.py",
+    "hpo/launcher.py",
+)
+
+_STOP_PATH_RE = re.compile(
+    r"^(stop|shutdown|close|drain|teardown|__exit__|__del__)\w*$"
+)
+
+
+@register
+class QueueMisuse(Rule):
+    name = "queue-misuse"
+    suite = "concurrency"
+    description = (
+        "Unbounded queue on a serving/loader path (a stalled consumer "
+        "grows it without bound), or a blocking queue get without a "
+        "timeout inside a stop path (shutdown wedges on empty)"
+    )
+
+    def applies_to(self, module: ModuleInfo) -> bool:
+        return matches_any(module.rel_path, QUEUE_HOT_PATTERNS)
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee in _QUEUE_CTORS:
+                if not self._bounded(node):
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            node,
+                            f"`{callee}()` without a maxsize on a "
+                            "serving/loader path — a stalled consumer "
+                            "grows it without bound; bound it and shed "
+                            "or block at the submit edge",
+                        )
+                    )
+            elif callee in _UNBOUNDED_OK_CTORS:
+                findings.append(
+                    module.finding(
+                        self.name,
+                        node,
+                        f"`{callee}()` is always unbounded — use "
+                        "queue.Queue(maxsize=...) on serving/loader "
+                        "paths",
+                    )
+                )
+
+        for _, fn in _enclosing_scopes(module):
+            if not _STOP_PATH_RE.match(fn.name):
+                continue
+            for node in walk_no_nested_functions(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                if node.func.attr != "get":
+                    continue
+                recv = _receiver_name(node)
+                if not _QUEUE_RECV_RE.search(recv):
+                    continue
+                if self._nonblocking_get(node):
+                    continue
+                findings.append(
+                    module.finding(
+                        self.name,
+                        node,
+                        f"blocking `{recv}.get()` in stop path "
+                        f"`{fn.name}` — an empty queue wedges shutdown; "
+                        "use get_nowait() or a timeout",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _bounded(call: ast.Call) -> bool:
+        size = None
+        if call.args:
+            size = call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "maxsize":
+                size = kw.value
+        if size is None:
+            return False
+        if isinstance(size, ast.Constant) and size.value in (0, None):
+            return False
+        if (
+            isinstance(size, ast.UnaryOp)
+            and isinstance(size.op, ast.USub)
+        ):
+            return False  # negative maxsize is unbounded too
+        return True
+
+    @staticmethod
+    def _nonblocking_get(call: ast.Call) -> bool:
+        if call.args:  # q.get(False) / q.get(True, timeout)
+            first = call.args[0]
+            if isinstance(first, ast.Constant) and first.value is False:
+                return True
+            if len(call.args) > 1:
+                return True  # positional timeout
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return True
+            if kw.arg == "block" and (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+            ):
+                return True
+        return False

@@ -911,13 +911,30 @@ def prefetch_iter(
             yield item
     finally:
         stop.set()
-        # unblock a worker stuck on a full queue, then reap it
+        # unblock a worker stuck on a full queue, then reap it — with a
+        # BOUNDED join: generator close (an interrupted epoch, a break
+        # on HYDRAGNN_MAX_NUM_BATCH) must never inherit a wedged
+        # collate's wait, and the daemon flag keeps a pathological
+        # worker from pinning interpreter exit
         try:
             while True:
                 q.get_nowait()
         except queue.Empty:
             pass
-        t.join()
+        t.join(timeout=10.0)
+        if not t.is_alive():
+            # the worker is done but `source` may be suspended mid-yield
+            # still referencing a collated (or device-resident) batch;
+            # closing it runs its finally blocks and drops that
+            # reference now instead of at GC time. Only safe once the
+            # worker has exited — close() on an executing generator
+            # raises ValueError.
+            closer = getattr(source, "close", None)
+            if callable(closer):
+                try:
+                    closer()
+                except Exception:
+                    pass
     if err:
         raise err[0]
 
@@ -951,6 +968,15 @@ def _ordered_pool_map(source, fn, workers, depth, name, places):
         finally:
             for f in window:
                 f.cancel()
+            # release the plan generator's suspended frame (iterated by
+            # THIS thread, so it is suspended — not executing — whenever
+            # this cleanup runs; closing it is race-free)
+            closer = getattr(source, "close", None)
+            if callable(closer):
+                try:
+                    closer()
+                except Exception:
+                    pass
 
 
 def create_dataloaders(

@@ -178,24 +178,35 @@ def optimize_concurrent(
     with ThreadPoolExecutor(max_workers=max_concurrent) as ex:
         inflight = {}
         submitted = 0
-        while submitted < n_trials or inflight:
-            while submitted < n_trials and len(inflight) < max_concurrent:
-                trial = study.ask()
-                suggest(trial)
-                block = pool.acquire(launcher.nnodes) if pool.free is not None else None
-                fut = ex.submit(launcher.run, trial, block)
-                inflight[fut] = (trial, block)
-                submitted += 1
-            done, _ = wait(inflight, return_when=FIRST_COMPLETED)
-            for fut in done:
-                trial, block = inflight.pop(fut)
-                pool.release(block)
-                try:
-                    val = fut.result()
-                except Exception:
-                    val = float("inf")
-                if val == float("inf"):
-                    study.tell(trial, None, state="failed")
-                else:
-                    study.tell(trial, val)
+        try:
+            while submitted < n_trials or inflight:
+                while (
+                    submitted < n_trials
+                    and len(inflight) < max_concurrent
+                ):
+                    trial = study.ask()
+                    suggest(trial)
+                    block = pool.acquire(launcher.nnodes) if pool.free is not None else None
+                    fut = ex.submit(launcher.run, trial, block)
+                    inflight[fut] = (trial, block)
+                    submitted += 1
+                done, _ = wait(inflight, return_when=FIRST_COMPLETED)
+                for fut in done:
+                    trial, block = inflight.pop(fut)
+                    pool.release(block)
+                    try:
+                        val = fut.result()
+                    except Exception:
+                        val = float("inf")
+                    if val == float("inf"):
+                        study.tell(trial, None, state="failed")
+                    else:
+                        study.tell(trial, val)
+        except BaseException:
+            # operator interrupt / study crash: queued-but-unstarted
+            # trials must not launch AFTER the stop was requested — the
+            # pool context below joins only what is already running
+            for fut in inflight:
+                fut.cancel()
+            raise
     return study.best_trial

@@ -41,6 +41,9 @@ def build_library(name: str, sources, extra_flags=()) -> str:
             tmp,
             "-lpthread",
         ]
+        # serializing the compile IS this lock's job: concurrent callers
+        # must block until the one g++ build lands, not race it
+        # threadlint: disable=blocking-under-lock
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(

@@ -58,6 +58,10 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "profile": ("status",),
     # device memory report (parallel.distributed.print_peak_memory)
     "device_memory": ("devices",),
+    # lock sanitizer watchdog (analysis/guards.py): a lock acquisition
+    # blocked past the threshold; threads carries every thread's held
+    # locks + stack at the moment of the dump
+    "deadlock_suspect": ("lock", "waited_s", "threads"),
 }
 
 _ENVELOPE = ("event", "ts", "seq")
@@ -171,6 +175,10 @@ class RunEventLog:
                         ),
                         allow_nan=False,
                     )
+                # the write must stay in the critical section: seq order
+                # ON DISK must match assignment order, and interleaved
+                # writes from two emitters would tear the JSONL stream
+                # threadlint: disable=blocking-under-lock
                 self._f.write(line + "\n")
                 self._seq += 1
             except (OSError, ValueError, TypeError):

@@ -28,22 +28,45 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 
+class _Listener(ThreadingHTTPServer):
+    """SO_REUSEADDR explicitly on: tests and CI smokes restart listeners
+    back-to-back, and a close()d socket lingering in TIME_WAIT must not
+    fail the rebind. Handler threads are daemonic so one hung in-flight
+    scrape cannot block interpreter exit (the listener thread itself is
+    joined with a bounded timeout in :meth:`ObservabilityServer.stop`)."""
+
+    allow_reuse_address = True  # SO_REUSEADDR
+    daemon_threads = True
+
+
 class ObservabilityServer:
     """Serves ``/healthz`` + ``/metrics`` for one provider object
     (an :class:`~hydragnn_tpu.serve.server.InferenceServer`, a training
-    :class:`~hydragnn_tpu.obs.runtime.RunTelemetry`, ...)."""
+    :class:`~hydragnn_tpu.obs.runtime.RunTelemetry`, ...).
+
+    Lifecycle is idempotent and thread-safe: ``start()`` on a started
+    listener and ``stop()`` on a stopped one are no-ops, and concurrent
+    ``stop()`` calls race safely. Two locks, always lifecycle -> state:
+    ``_lifecycle_lock`` serializes whole start/stop TRANSITIONS (so a
+    restart on a fixed port cannot bind before the previous socket is
+    actually closed — SO_REUSEADDR covers TIME_WAIT, not a still-open
+    listener), while the quick ``_state_lock`` guards the handle pair so
+    :attr:`address` never blocks behind a slow shutdown. ``port=0``
+    binds an ephemeral port; read the real one from :attr:`address`
+    after ``start()`` — fixed test ports collide under parallel CI,
+    ephemeral ones cannot."""
 
     def __init__(self, provider, port: int = 8080,
                  host: str = "127.0.0.1"):
         self._provider = provider
         self._host = host
         self._port = port
+        self._lifecycle_lock = threading.Lock()
+        self._state_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
-        if self._httpd is not None:
-            return self
         provider = self._provider
 
         class Handler(BaseHTTPRequestHandler):
@@ -96,28 +119,47 @@ class ObservabilityServer:
             def log_message(self, *args):  # scrape spam off stderr
                 pass
 
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="hydragnn-observability",
-            daemon=True,
-        )
-        self._thread.start()
+        with self._lifecycle_lock:
+            with self._state_lock:
+                if self._httpd is not None:
+                    return self
+            httpd = _Listener((self._host, self._port), Handler)
+            # daemon=True is the crashed-caller backstop; the orderly
+            # path is stop(), which shuts the loop down and joins
+            thread = threading.Thread(
+                target=httpd.serve_forever,
+                name="hydragnn-observability",
+                daemon=True,
+            )
+            thread.start()
+            with self._state_lock:
+                self._httpd, self._thread = httpd, thread
         return self
 
     @property
     def address(self) -> Optional[Tuple[str, int]]:
         """(host, port) actually bound — port 0 resolves here."""
-        if self._httpd is None:
-            return None
-        return self._httpd.server_address[:2]
+        with self._state_lock:
+            if self._httpd is None:
+                return None
+            return self._httpd.server_address[:2]
 
-    def stop(self):
-        if self._httpd is None:
-            return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(5.0)
-            self._thread = None
-        self._httpd = None
+    def stop(self, timeout: float = 5.0):
+        # the whole teardown runs under the lifecycle lock: a concurrent
+        # start() on the same fixed port must wait until server_close()
+        # has actually released the socket, or its bind hits EADDRINUSE.
+        # The quick state lock still hands the pair to exactly one
+        # closer (concurrent/repeated stop() calls are race-free
+        # no-ops) and is dropped before the blocking shutdown/join, so
+        # address readers never stall behind a slow teardown.
+        with self._lifecycle_lock:
+            with self._state_lock:
+                httpd, thread = self._httpd, self._thread
+                self._httpd = None
+                self._thread = None
+            if httpd is None:
+                return
+            httpd.shutdown()
+            httpd.server_close()
+            if thread is not None:
+                thread.join(timeout)

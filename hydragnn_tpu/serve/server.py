@@ -168,47 +168,75 @@ class InferenceServer:
         the batcher thread (and the observability endpoint, if asked)."""
         if self._running.is_set():
             return self
-        self._stopped = False
+        with self._submit_lock:
+            # the stopped flag is read/written ONLY under this lock
+            # (threadlint unguarded-shared-state): a lock-free restart
+            # here could race a concurrent stop()'s sweep and revive a
+            # queue that sweep already declared dead
+            self._stopped = False
         from hydragnn_tpu.utils.compile_cache import enable_compile_cache
 
         enable_compile_cache()
         if warmup:
             self.warmup()
         self._running.set()
-        self._thread = threading.Thread(
+        # daemon=True is the crashed-caller backstop ONLY: the orderly
+        # path is stop(), which drains, joins with a bounded timeout and
+        # fails anything still queued — never fire-and-forget
+        thread = threading.Thread(
             target=self._batcher_loop,
             name="hydragnn-serve-batcher",
             daemon=True,
         )
-        self._thread.start()
+        thread.start()
+        http = None
         if self._observability_port is not None:
             from hydragnn_tpu.serve.http import ObservabilityServer
 
-            self._http = ObservabilityServer(
-                self, port=self._observability_port
-            )
-            self._http.start()
+            http = ObservabilityServer(self, port=self._observability_port)
+            http.start()
+        # publish the teardown handles under the lock stop() takes them
+        # with — a lock-free write here would race stop()'s handoff
+        with self._submit_lock:
+            self._thread = thread
+            self._http = http
         return self
 
     def stop(self, drain: bool = True, timeout: float = 10.0):
         """Stop the batcher; ``drain=True`` serves already-queued work
         first, otherwise queued requests fail with a shutdown error.
         Also sweeps a never-started server's queue, so requests
-        submitted before ``start()`` cannot strand."""
+        submitted before ``start()`` cannot strand. Idempotent: a second
+        ``stop()`` after a completed one is a no-op (unless the batcher
+        outlived its join timeout, in which case it retries the join)."""
         with self._submit_lock:
             # after this block no submit can enqueue: any submit holding
             # the lock finished its put before the flag flipped, and the
-            # sweep below runs strictly later — nothing slips past it
+            # sweep below runs strictly later — nothing slips past it.
+            # Taking the teardown handles here hands them to exactly ONE
+            # stopper: concurrent stop() calls must not both join (or
+            # both null) the same thread/listener
+            already_stopped = self._stopped
             self._stopped = True
+            thread, self._thread = self._thread, None
+            http, self._http = self._http, None
+        if already_stopped and thread is None and http is None:
+            return
         if self._running.is_set():
             if drain:
                 deadline = time.monotonic() + timeout
                 while self._depth() and time.monotonic() < deadline:
                     time.sleep(0.005)
             self._running.clear()
-            if self._thread is not None:
-                self._thread.join(timeout)
-                self._thread = None
+        if thread is not None:
+            # bounded join — shutdown must terminate even if a dispatch
+            # wedges; a still-alive batcher hands its handle back so a
+            # retry stop() can join it again instead of silently
+            # forgetting it
+            thread.join(timeout)
+            if thread.is_alive():
+                with self._submit_lock:
+                    self._thread = thread
         # fail anything still queued — no silent black hole. Counted as
         # errors so the metrics lifecycle invariant (every accepted
         # request ends in responses/timeouts/errors) survives shutdown.
@@ -230,9 +258,8 @@ class InferenceServer:
         )
         if failed:
             self.metrics.on_error(failed)
-        if self._http is not None:
-            self._http.stop()
-            self._http = None
+        if http is not None:
+            http.stop()
 
     def __enter__(self):
         return self.start()

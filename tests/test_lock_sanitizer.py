@@ -1,0 +1,484 @@
+"""Runtime half of the threadlint concurrency suite (ISSUE 6).
+
+The static pass (``tests/test_threadlint.py``) proves what the SOURCE
+nests; these tests prove what execution composes: ``lock_sanitizer()``
+catches an injected lock-order inversion the first time two threads
+establish opposite orders (not the unlucky run that deadlocks), the
+watchdog dumps all thread stacks + held locks and emits a
+``deadlock_suspect`` event when an acquisition blocks past threshold,
+and the shutdown paths this PR hardened actually terminate: server
+stop-under-load drains within its timeout, the obs listener's stop is
+idempotent and race-free, the prefetch worker joins on generator close,
+and ``MetricsRegistry`` survives concurrent registration + scrape.
+"""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.analysis.guards import (
+    LockOrderViolation,
+    lock_sanitizer,
+)
+from hydragnn_tpu.data.loaders import prefetch_iter
+from hydragnn_tpu.obs.events import RunEventLog, validate_events
+from hydragnn_tpu.obs.http import ObservabilityServer
+from hydragnn_tpu.obs.metrics import MetricsRegistry
+
+
+def _run_threads(*targets):
+    errors = []
+
+    def wrap(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # surface on the test thread
+                errors.append(e)
+
+        return run
+
+    threads = [threading.Thread(target=wrap(t)) for t in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+        assert not t.is_alive(), "test thread wedged"
+    if errors:
+        raise errors[0]
+    return errors
+
+
+# ---- order-inversion detection -------------------------------------------
+
+
+def pytest_sanitizer_catches_injected_inversion():
+    """The acceptance case: thread 1 nests A->B, thread 2 nests B->A.
+    Neither run deadlocks (the threads run back-to-back), but the
+    interleaving COULD — the sanitizer flags it from the order graph
+    alone, and the harness raises on exit."""
+    with pytest.raises(LockOrderViolation, match="reverse order"):
+        with lock_sanitizer() as san:
+            a = san.lock("a")
+            b = san.lock("b")
+
+            def forward():
+                with a:
+                    with b:
+                        pass
+
+            def backward():
+                with b:
+                    with a:
+                        pass
+
+            t1 = threading.Thread(target=forward)
+            t1.start()
+            t1.join()
+            t2 = threading.Thread(target=backward)
+            t2.start()
+            t2.join()
+            assert san.violations, "inversion not recorded"
+            v = san.violations[0]
+            assert v["holding"] == "b" and v["acquiring"] == "a"
+            assert "a -> b" in v["reverse_chain"]
+
+
+def pytest_sanitizer_consistent_order_is_clean():
+    with lock_sanitizer() as san:
+        a = san.lock("a")
+        b = san.lock("b")
+
+        def worker():
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+
+        _run_threads(worker, worker, worker)
+        assert not san.violations
+
+
+def pytest_sanitizer_transitive_inversion_across_threads():
+    """a->b and b->c on two threads, then c->a on a third: a 3-cycle no
+    single pair of nested withs exhibits."""
+    with lock_sanitizer(check_on_exit=False) as san:
+        a, b, c = san.lock("a"), san.lock("b"), san.lock("c")
+        for outer, inner in ((a, b), (b, c), (c, a)):
+            t = threading.Thread(
+                target=lambda o=outer, i=inner: o.acquire()
+                and i.acquire() and (i.release(), o.release())
+            )
+            t.start()
+            t.join()
+    assert san.violations
+    assert san.violations[0]["reverse_chain"] == "a -> b -> c"
+    with pytest.raises(LockOrderViolation):
+        san.assert_clean()
+
+
+def pytest_sanitizer_trylock_idiom_is_not_an_inversion():
+    """`acquire(blocking=False)` against the established order is the
+    STANDARD deadlock-avoidance idiom — it never waits, so it can never
+    close a deadlock cycle and must not be flagged."""
+    with lock_sanitizer() as san:
+        a = san.lock("a")
+        b = san.lock("b")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=forward)
+        t.start()
+        t.join()
+        with b:
+            assert a.acquire(blocking=False)
+            a.release()
+        assert not san.violations
+
+
+def pytest_sanitizer_failed_timed_acquire_leaves_no_phantom_edge():
+    """A timed-out acquire under a held lock established nothing — the
+    reverse nesting later must stay clean."""
+    with lock_sanitizer() as san:
+        x = san.lock("x")
+        y = san.lock("y")
+        gate = threading.Lock()
+        gate.acquire()
+        x_wrapped_holder = threading.Event()
+
+        def holder():  # keeps x busy so the timed acquire times out
+            with x:
+                x_wrapped_holder.set()
+                gate.acquire()
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert x_wrapped_holder.wait(5.0)
+        with y:
+            assert x.acquire(timeout=0.05) is False  # y->x NOT recorded
+        gate.release()
+        t.join(5.0)
+        with x:  # the reverse order — clean, no phantom y->x edge
+            with y:
+                pass
+        assert not san.violations
+
+
+def pytest_sanitizer_reentrant_rlock_and_lock_surface():
+    with lock_sanitizer() as san:
+        r = san.rlock("r")
+        with r:
+            with r:  # reentrant re-acquire is not a new ordering
+                pass
+        assert not san.violations
+
+        l = san.lock("plain")
+        assert l.acquire()
+        assert l.locked()
+        l.release()
+        assert not l.locked()
+
+        # a timed-out acquire must not corrupt the held-set
+        other = threading.Lock()
+        wrapped = san.wrap("contended", other)
+        other.acquire()
+        t0 = time.monotonic()
+        assert wrapped.acquire(timeout=0.05) is False
+        assert time.monotonic() - t0 < 5.0
+        other.release()
+        with wrapped:  # now it acquires fine
+            pass
+
+
+# ---- watchdog -------------------------------------------------------------
+
+
+def pytest_watchdog_dumps_threads_and_emits_event(tmp_path):
+    """An acquisition blocked past watchdog_s dumps every thread's held
+    locks + stack and emits a schema-valid ``deadlock_suspect`` event —
+    then still completes once the holder releases (the watchdog
+    REPORTS, it does not convert waits into failures)."""
+    events = str(tmp_path / "events.jsonl")
+    log = RunEventLog(events)
+    with lock_sanitizer(watchdog_s=0.05, event_log=log) as san:
+        lock = san.lock("hot")
+        holding = threading.Event()
+
+        def holder():
+            with lock:
+                holding.set()
+                time.sleep(0.4)
+
+        t = threading.Thread(target=holder, name="holder-thread")
+        t.start()
+        assert holding.wait(5.0)
+        with lock:  # blocks ~0.4s > 0.05s watchdog
+            pass
+        t.join(5.0)
+
+    assert len(san.deadlock_suspects) == 1
+    suspect = san.deadlock_suspects[0]
+    assert suspect["lock"] == "hot"
+    assert suspect["waited_s"] >= 0.05
+    by_name = {rec["name"]: rec for rec in suspect["threads"]}
+    assert by_name["holder-thread"]["held_locks"] == ["hot"]
+    assert any("holder" in line for line in by_name["holder-thread"]["stack"])
+
+    log.close()
+    records = validate_events(events, require=["deadlock_suspect"])
+    (rec,) = [r for r in records if r["event"] == "deadlock_suspect"]
+    assert rec["lock"] == "hot" and rec["threads"]
+
+
+def pytest_watchdog_quiet_for_timeouts_below_threshold():
+    """A caller timeout shorter than watchdog_s is ordinary control
+    flow (the trylock-with-deadline idiom) — timing out there must not
+    produce a deadlock_suspect."""
+    with lock_sanitizer(watchdog_s=5.0) as san:
+        lock = san.lock("busy")
+        ready = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                ready.set()
+                release.wait(10.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert ready.wait(5.0)
+        assert lock.acquire(timeout=0.05) is False  # 0.05 << 5.0
+        release.set()
+        t.join(5.0)
+    assert not san.deadlock_suspects
+
+
+def pytest_watchdog_quiet_when_uncontended(tmp_path):
+    log = RunEventLog(str(tmp_path / "events.jsonl"))
+    with lock_sanitizer(watchdog_s=0.05, event_log=log) as san:
+        lock = san.lock("calm")
+        for _ in range(20):
+            with lock:
+                pass
+    assert not san.deadlock_suspects
+    log.close()
+    records = validate_events(str(tmp_path / "events.jsonl"))
+    assert not [r for r in records if r["event"] == "deadlock_suspect"]
+
+
+# ---- metrics export -------------------------------------------------------
+
+
+def pytest_sanitizer_exports_wait_hold_histograms():
+    registry = MetricsRegistry("hydragnn_test")
+    with lock_sanitizer(registry=registry) as san:
+        lock = san.lock("pending queue")  # name gets metric-sanitized
+        with lock:
+            time.sleep(0.01)
+        with lock:
+            pass
+    snap = registry.snapshot()
+    wait = snap["lock_wait_seconds_pending_queue"]
+    hold = snap["lock_hold_seconds_pending_queue"]
+    assert wait["count"] == 2 and hold["count"] == 2
+    assert hold["sum"] >= 0.009  # the sleep is inside the hold
+    text = registry.render_prometheus()
+    assert "lock_hold_seconds_pending_queue" in text
+    assert "lock_wait_seconds_pending_queue" in text
+
+
+def pytest_sanitizer_reentrant_hold_measures_outermost():
+    """A nested re-acquire must not reset the hold clock — the
+    histogram answers 'how long was this lock unavailable'."""
+    registry = MetricsRegistry("hydragnn_test")
+    with lock_sanitizer(registry=registry) as san:
+        r = san.rlock("re")
+        with r:
+            time.sleep(0.03)
+            with r:  # inner re-acquire, immediately released
+                pass
+            time.sleep(0.03)
+    hold = registry.snapshot()["lock_hold_seconds_re"]
+    assert hold["count"] == 1  # one OUTER hold, not two
+    assert hold["sum"] >= 0.055
+
+
+def pytest_metrics_registry_concurrent_registration_and_scrape():
+    """The satellite stress test: writers declaring + recording NEW
+    metrics while scrapers render — no torn exposition, no lost
+    metrics, no 'dict changed size during iteration'."""
+    registry = MetricsRegistry("stress")
+    writers, per_writer = 6, 25
+    done = threading.Event()
+
+    def writer(wid):
+        def run():
+            for i in range(per_writer):
+                name = f"w{wid}_m{i}"
+                registry.counter(name)
+                registry.inc(name, wid + 1)
+        return run
+
+    def scraper():
+        while not done.is_set():
+            text = registry.render_prometheus()
+            assert text.endswith("\n")
+            registry.snapshot()
+
+    scrapers = [threading.Thread(target=scraper) for _ in range(2)]
+    for s in scrapers:
+        s.start()
+    try:
+        _run_threads(*[writer(w) for w in range(writers)])
+    finally:
+        done.set()
+        for s in scrapers:
+            s.join(10.0)
+            assert not s.is_alive()
+    snap = registry.snapshot()
+    for w in range(writers):
+        for i in range(per_writer):
+            assert snap[f"w{w}_m{i}"] == w + 1
+
+
+# ---- obs listener lifecycle ----------------------------------------------
+
+
+class _Provider:
+    def __init__(self):
+        self.metrics = MetricsRegistry("probe")
+        self.metrics.counter("up")
+        self.metrics.inc("up")
+
+    def health(self):
+        return {"status": "ok"}
+
+
+def pytest_obs_server_port0_idempotent_start_and_racing_stops():
+    srv = ObservabilityServer(_Provider(), port=0)
+    assert srv.address is None  # not started yet
+    srv.start()
+    host, port = srv.address
+    assert port != 0
+    assert srv.start() is srv  # idempotent, same listener
+    assert srv.address == (host, port)
+    with urllib.request.urlopen(
+        f"http://{host}:{port}/healthz", timeout=10
+    ) as resp:
+        assert resp.status == 200
+
+    # concurrent stops race safely: exactly one closes, the rest no-op
+    _run_threads(*(srv.stop for _ in range(4)))
+    assert srv.address is None
+    srv.stop()  # stop-after-stop is a no-op too
+
+    # SO_REUSEADDR: rebinding the just-closed port must not fail even
+    # while the old socket lingers in TIME_WAIT
+    srv2 = ObservabilityServer(_Provider(), host=host, port=port).start()
+    try:
+        assert srv2.address == (host, port)
+    finally:
+        srv2.stop()
+
+
+# ---- prefetch worker shutdown --------------------------------------------
+
+
+def pytest_prefetch_close_joins_worker_and_closes_source():
+    """An interrupted epoch (generator close after one batch) must reap
+    the worker thread AND run the source generator's finally blocks, so
+    nothing keeps referencing a collated/device-resident batch."""
+    state = {"closed": False, "produced": 0}
+
+    def source():
+        try:
+            for i in range(1000):
+                state["produced"] += 1
+                yield i
+        finally:
+            state["closed"] = True
+
+    it = prefetch_iter(source(), depth=2, name="pf-close-test")
+    assert next(it) == 0
+    it.close()  # the early `break` / exception path
+    assert state["closed"], "source generator finally did not run"
+    assert state["produced"] < 1000
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if not any(
+            t.name == "pf-close-test" for t in threading.enumerate()
+        ):
+            break
+        time.sleep(0.01)
+    else:
+        raise AssertionError("prefetch worker leaked past close()")
+
+
+# ---- server stop-under-load ----------------------------------------------
+
+
+def pytest_serve_stop_under_load_drains_within_timeout():
+    """stop(drain=True) under concurrent submit pressure: terminates
+    within its timeout, resolves EVERY accepted future (result or
+    shutdown error — no stranded waiter), joins the batcher, and stays
+    idempotent."""
+    from test_serve import _graph, _harness
+    from hydragnn_tpu.serve import InferenceServer
+
+    h = _harness()
+    rng = np.random.default_rng(7)
+    graphs = [
+        _graph(int(n), rng, with_targets=False)
+        for n in rng.integers(4, 30, 36)
+    ]
+    server = InferenceServer(
+        h["registry"], h["plan"], max_wait_s=0.002, queue_capacity=256
+    )
+    server.start()
+    futures = []
+    fut_lock = threading.Lock()
+
+    def submitter(chunk):
+        def run():
+            for g in chunk:
+                f = server.submit(g)
+                with fut_lock:
+                    futures.append(f)
+        return run
+
+    _run_threads(*(submitter(graphs[i::3]) for i in range(3)))
+
+    t0 = time.monotonic()
+    server.stop(drain=True, timeout=30.0)
+    assert time.monotonic() - t0 < 30.0
+    assert server._thread is None, "batcher not joined"
+
+    resolved = 0
+    for f in futures:
+        try:
+            heads = f.result(timeout=5.0)
+            assert all(np.isfinite(o).all() for o in heads)
+        except RuntimeError:
+            pass  # failed-at-shutdown is a deterministic outcome too
+        resolved += 1
+    assert resolved == len(futures) == len(graphs)
+
+    # every accepted request ended in exactly one terminal counter
+    snap = server.metrics.snapshot()
+    assert snap["requests_total"] == (
+        snap["responses_total"]
+        + snap["timeouts_total"]
+        + snap["errors_total"]
+    )
+
+    # a burst of concurrent stop() calls must all no-op cleanly (the
+    # handle handoff under _submit_lock gives teardown to exactly one)
+    _run_threads(*(server.stop for _ in range(6)))
+    with pytest.raises(RuntimeError, match="stopped"):
+        server.submit(graphs[0])

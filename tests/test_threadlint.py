@@ -1,0 +1,557 @@
+"""threadlint (analysis --suite=concurrency): the concurrency rule suite.
+
+Per rule: a bad snippet that must flag and a good snippet that must not,
+plus the suite-selection CLI, the threadlint suppression tag, and the
+acceptance regression — the merged tree runs clean against the committed
+(empty) ``.threadlint-baseline.json``.
+
+Everything here is pure-AST: no threads are started, so the whole file
+runs in well under a second. The RUNTIME half of the suite
+(``lock_sanitizer``, the deadlock watchdog) lives in
+``tests/test_lock_sanitizer.py``.
+"""
+
+import os
+import textwrap
+
+from hydragnn_tpu.analysis import analyze_paths
+from hydragnn_tpu.analysis.__main__ import main as lint_main
+from hydragnn_tpu.analysis.core import all_suites, rules_in_suite
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONCURRENCY_RULES = {
+    "lock-order-inversion",
+    "blocking-under-lock",
+    "thread-leak",
+    "unguarded-shared-state",
+    "queue-misuse",
+}
+
+
+def _lint(tmp_path, files, **kw):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return analyze_paths([str(tmp_path)], root=str(tmp_path), **kw).findings
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def pytest_suite_registry_is_partitioned():
+    assert all_suites() == {"jax", "concurrency"}
+    assert rules_in_suite("concurrency") == CONCURRENCY_RULES
+    # jax suite still carries every pre-existing rule
+    assert "host-sync-in-hot-loop" in rules_in_suite("jax")
+    assert not rules_in_suite("jax") & CONCURRENCY_RULES
+
+
+# ---- lock-order-inversion -------------------------------------------------
+
+_INVERSION_BAD = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._queue_lock = threading.Lock()
+            self._state_lock = threading.Lock()
+
+        def submit(self):
+            with self._queue_lock:
+                with self._state_lock:
+                    pass
+
+        def stop(self):
+            with self._state_lock:
+                with self._queue_lock:
+                    pass
+"""
+
+_INVERSION_GOOD = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._queue_lock = threading.Lock()
+            self._state_lock = threading.Lock()
+
+        def submit(self):
+            with self._queue_lock:
+                with self._state_lock:
+                    pass
+
+        def stop(self):
+            with self._queue_lock:
+                with self._state_lock:
+                    pass
+"""
+
+
+def pytest_lock_order_inversion_flags_cycle(tmp_path):
+    findings = _lint(tmp_path, {"m.py": _INVERSION_BAD})
+    li = [f for f in findings if f.rule == "lock-order-inversion"]
+    assert len(li) == 1, findings
+    assert "reverse order" in li[0].message
+
+
+def pytest_lock_order_consistent_nesting_is_clean(tmp_path):
+    findings = _lint(tmp_path, {"m.py": _INVERSION_GOOD})
+    assert not [f for f in findings if f.rule == "lock-order-inversion"]
+
+
+def pytest_lock_order_distinct_classes_do_not_merge(tmp_path):
+    # two classes each nesting their own self-locks in opposite textual
+    # orders are NOT a cycle — self.X is qualified per class
+    src = """
+        import threading
+
+        class A:
+            def f(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+        class B:
+            def g(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    assert not [f for f in findings if f.rule == "lock-order-inversion"]
+
+
+def pytest_lock_order_transitive_cycle_flags(tmp_path):
+    # a -> b in one function, b -> c and c -> a elsewhere: a 3-cycle no
+    # direct-edge check would see
+    src = """
+        def f(a_lock, b_lock):
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def g(b_lock, c_lock):
+            with b_lock:
+                with c_lock:
+                    pass
+
+        def h(c_lock, a_lock):
+            with c_lock:
+                with a_lock:
+                    pass
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    assert [f for f in findings if f.rule == "lock-order-inversion"]
+
+
+# ---- blocking-under-lock --------------------------------------------------
+
+_BLOCKING_BAD = """
+    import queue
+    import threading
+    import time
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._queue = queue.Queue(8)
+
+        def tick(self, jax, batch):
+            with self._lock:
+                time.sleep(0.1)
+                item = self._queue.get()
+                out = jax.device_get(batch)
+                self._event.wait()
+            return out
+"""
+
+_BLOCKING_GOOD = """
+    import queue
+    import threading
+    import time
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._queue = queue.Queue(8)
+
+        def tick(self, jax, batch):
+            with self._lock:
+                depth = self._depth
+                item = self._queue.get_nowait()
+            time.sleep(0.1)
+            out = jax.device_get(batch)
+            return depth, item, out
+"""
+
+
+def pytest_blocking_under_lock_flags_each_call(tmp_path):
+    findings = _lint(tmp_path, {"m.py": _BLOCKING_BAD})
+    bl = [f for f in findings if f.rule == "blocking-under-lock"]
+    # sleep, queue.get, device_get, event.wait
+    assert len(bl) == 4, findings
+
+
+def pytest_blocking_snapshot_then_act_is_clean(tmp_path):
+    findings = _lint(tmp_path, {"m.py": _BLOCKING_GOOD})
+    assert not [f for f in findings if f.rule == "blocking-under-lock"]
+
+
+def pytest_blocking_file_io_and_nested_lock_scoping(tmp_path):
+    # file writes on a file-ish receiver flag; a nested with-lock body
+    # reports against its own (innermost) lock only — one finding each
+    src = """
+        import threading
+
+        class Log:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def emit(self, line):
+                with self._lock:
+                    self._f.write(line)
+
+            def emit2(self, line):
+                with self._lock:
+                    with self._io_lock:
+                        self._f.write(line)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    bl = [f for f in findings if f.rule == "blocking-under-lock"]
+    assert len(bl) == 2, findings
+    assert "_io_lock" in bl[1].message  # innermost lock named
+
+
+# ---- thread-leak ----------------------------------------------------------
+
+
+def pytest_thread_leak_flags_unjoined_nondaemon(tmp_path):
+    src = """
+        import threading
+
+        def serve():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    tl = [f for f in findings if f.rule == "thread-leak"]
+    assert len(tl) == 1 and "`t`" in tl[0].message, findings
+
+
+def pytest_thread_leak_join_or_daemon_is_clean(tmp_path):
+    src = """
+        import threading
+
+        class S:
+            def start(self):
+                self._thread = threading.Thread(target=print)
+                self._thread.start()
+                self._backstop = threading.Thread(
+                    target=print, daemon=True
+                )
+                self._backstop.start()
+
+            def stop(self):
+                self._thread.join(5.0)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    assert not [f for f in findings if f.rule == "thread-leak"], findings
+
+
+def pytest_thread_leak_executor_without_shutdown(tmp_path):
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def leak(items, fn):
+            ex = ThreadPoolExecutor(max_workers=4)
+            return [ex.submit(fn, i) for i in items]
+
+        def fine_ctx(items, fn):
+            with ThreadPoolExecutor(max_workers=4) as ex:
+                return [f.result() for f in map(ex.submit, items)]
+
+        class Pool:
+            def start(self):
+                self._ex = ThreadPoolExecutor(max_workers=2)
+
+            def stop(self):
+                self._ex.shutdown(wait=True)
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    tl = [f for f in findings if f.rule == "thread-leak"]
+    assert len(tl) == 1 and "shutdown" in tl[0].message, findings
+
+
+# ---- unguarded-shared-state -----------------------------------------------
+
+_UNGUARDED_BAD = """
+    import threading
+
+    class Metrics:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def record(self):
+            with self._lock:
+                self.count += 1
+
+        def reset(self):
+            self.count = 0
+"""
+
+_UNGUARDED_GOOD = """
+    import threading
+
+    class Metrics:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def record(self):
+            with self._lock:
+                self.count += 1
+
+        def reset(self):
+            with self._lock:
+                self.count = 0
+"""
+
+
+def pytest_unguarded_shared_state_flags_lock_free_write(tmp_path):
+    findings = _lint(tmp_path, {"m.py": _UNGUARDED_BAD})
+    us = [f for f in findings if f.rule == "unguarded-shared-state"]
+    assert len(us) == 1, findings
+    assert "reset" in us[0].message and "count" in us[0].message
+
+
+def pytest_unguarded_shared_state_guarded_everywhere_is_clean(tmp_path):
+    findings = _lint(tmp_path, {"m.py": _UNGUARDED_GOOD})
+    assert not [f for f in findings if f.rule == "unguarded-shared-state"]
+
+
+def pytest_unguarded_shared_state_init_and_lockless_attrs_exempt(tmp_path):
+    # __init__ constructs before sharing; attrs NEVER touched under the
+    # lock are (assumed) single-thread-owned and not this rule's business
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.guarded = {}
+                self.private = 0
+
+            def record(self, k, v):
+                with self._lock:
+                    self.guarded[k] = v
+
+            def bookkeeping(self):
+                self.private += 1
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    assert not [f for f in findings if f.rule == "unguarded-shared-state"]
+
+
+def pytest_unguarded_shared_state_mutating_method_calls_count(tmp_path):
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.pending = []
+
+            def add(self, x):
+                with self._lock:
+                    self.pending.append(x)
+
+            def sweep(self):
+                self.pending.clear()
+    """
+    findings = _lint(tmp_path, {"m.py": src})
+    us = [f for f in findings if f.rule == "unguarded-shared-state"]
+    assert len(us) == 1 and "sweep" in us[0].message, findings
+
+
+# ---- queue-misuse ---------------------------------------------------------
+
+
+def pytest_queue_misuse_unbounded_on_serving_path(tmp_path):
+    src = """
+        import queue
+
+        def make():
+            return queue.Queue()
+    """
+    findings = _lint(tmp_path, {"serve/server.py": src})
+    qm = [f for f in findings if f.rule == "queue-misuse"]
+    assert len(qm) == 1 and "maxsize" in qm[0].message, findings
+
+
+def pytest_queue_misuse_bounded_and_off_path_clean(tmp_path):
+    bounded = """
+        import queue
+
+        def make(cap):
+            return queue.Queue(maxsize=cap)
+    """
+    unbounded_elsewhere = """
+        import queue
+
+        def make():
+            return queue.Queue()
+    """
+    findings = _lint(
+        tmp_path,
+        {
+            "serve/server.py": bounded,
+            "postprocess/tools.py": unbounded_elsewhere,
+        },
+    )
+    assert not [f for f in findings if f.rule == "queue-misuse"], findings
+
+
+def pytest_queue_misuse_blocking_get_in_stop_path(tmp_path):
+    src = """
+        class S:
+            def stop(self):
+                while True:
+                    item = self._queue.get()
+                    if item is None:
+                        break
+
+            def drain_ok(self):
+                self._queue.get(timeout=0.1)
+                self._queue.get_nowait()
+    """
+    findings = _lint(tmp_path, {"serve/server.py": src})
+    qm = [f for f in findings if f.rule == "queue-misuse"]
+    assert len(qm) == 1 and "stop" in qm[0].message, findings
+
+
+# ---- suppression / suite CLI ---------------------------------------------
+
+
+def pytest_threadlint_suppression_tag(tmp_path):
+    src = """
+        import queue
+
+        q1 = queue.Queue()  # threadlint: disable=queue-misuse
+        # justification: test fixture, consumed synchronously below
+        # threadlint: disable=queue-misuse
+        q2 = queue.Queue()
+        q3 = queue.Queue()
+    """
+    findings = _lint(tmp_path, {"serve/s.py": src})
+    qm = [f for f in findings if f.rule == "queue-misuse"]
+    assert len(qm) == 1, findings  # only q3 survives
+
+
+def pytest_suite_cli_selects_and_rejects(tmp_path, capsys):
+    bad = tmp_path / "serve" / "s.py"
+    bad.parent.mkdir(parents=True)
+    # one finding per suite: an unbounded queue (concurrency) and a
+    # mutable default (jax)
+    bad.write_text(
+        "import queue\n\nq = queue.Queue()\n\n"
+        "def f(x, acc=[]):\n    return acc\n"
+    )
+    assert lint_main([str(bad), "--suite=concurrency", "--format=json"]) == 1
+    import json
+
+    out = json.loads(capsys.readouterr().out)
+    assert _rules_of_json(out) == ["queue-misuse"]
+    assert lint_main([str(bad), "--suite=jax", "--format=json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert _rules_of_json(out) == ["mutable-default-arg"]
+    # no suite: both
+    assert lint_main([str(bad), "--format=json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert _rules_of_json(out) == ["mutable-default-arg", "queue-misuse"]
+    # unknown suite is a usage error
+    assert lint_main([str(bad), "--suite=nope"]) == 2
+    # contradictory flag combinations that leave NO rule to run must be
+    # a usage error, never a silent zero-rule "clean" run
+    assert (
+        lint_main([str(bad), "--suite=jax", "--select=queue-misuse"]) == 2
+    )
+    assert (
+        lint_main(
+            [
+                str(bad),
+                "--suite=concurrency",
+                "--ignore=" + ",".join(sorted(CONCURRENCY_RULES)),
+            ]
+        )
+        == 2
+    )
+    assert (
+        lint_main(
+            [str(bad), "--suite=jax", "--select=mutable-default-arg"]
+        )
+        == 1
+    )
+    capsys.readouterr()
+
+
+def _rules_of_json(payload):
+    return sorted({f["rule"] for f in payload["new"]})
+
+
+# ---- acceptance -----------------------------------------------------------
+
+
+def pytest_merged_tree_clean_against_committed_empty_baseline(capsys):
+    """The CI gate invocation, verbatim: the committed baseline is EMPTY
+    — every true positive on the tree is fixed, every intentional
+    pattern suppressed with a justification."""
+    import json
+
+    baseline = os.path.join(REPO_ROOT, ".threadlint-baseline.json")
+    assert os.path.exists(baseline), "commit .threadlint-baseline.json"
+    with open(baseline) as f:
+        payload = json.load(f)
+    assert payload["findings"] == [], (
+        "the threadlint baseline must stay EMPTY — fix or suppress with "
+        "a justification instead of baselining"
+    )
+    cwd = os.getcwd()
+    os.chdir(REPO_ROOT)
+    try:
+        rc = lint_main(
+            [
+                "--suite=concurrency",
+                "--format=github",
+                "--baseline",
+                ".threadlint-baseline.json",
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def pytest_reintroduced_shutdown_hazards_fail_the_gate(tmp_path):
+    """The acceptance pair for this suite: an unbounded request queue on
+    the serving path, and a stop() that blocks on queue.get()."""
+    findings = _lint(
+        tmp_path,
+        {
+            "serve/server.py": (
+                "import queue\n\n"
+                "class Server:\n"
+                "    def __init__(self):\n"
+                "        self._queue = queue.Queue()\n\n"
+                "    def stop(self):\n"
+                "        self._queue.get()\n"
+            ),
+        },
+    )
+    qm = [f for f in findings if f.rule == "queue-misuse"]
+    assert len(qm) == 2, findings
