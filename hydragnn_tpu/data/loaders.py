@@ -101,51 +101,14 @@ def _lcm(a, b):
     return a * b // math.gcd(a, b)
 
 
-# Measured dense/segment crossovers (BASELINE.md rounds 2-4, v5e,
-# same-session A/Bs at deg ~12): minimum hidden_dim at which the dense
-# scatter-free path beats segment reductions for each model. Scatter-heavy
-# models (PNA's 4 aggregators, GAT's edge softmax, MFC's degree banks,
-# DimeNet's triplet axis) cross early; GIN/SAGE/CGCNN only win mildly at
-# MXU widths; SchNet and EGNN never do (one already-fused scatter per
-# layer — the dense frame's extra gathers cost more than it removes).
-_DENSE_AUTO_MIN_HIDDEN = {
-    "PNA": 96,
-    "GAT": 96,
-    "MFC": 96,
-    "DimeNet": 96,
-    "GIN": 192,
-    "SAGE": 192,
-    # CGCNN absent from THIS table: its convs run at input_dim width
-    # (constant-width CGConv, create.py), so hidden_dim says nothing about
-    # where it sits relative to the crossover — it gets its own rule below.
-}
-
-# CGCNN's crossover keyed on its TRUE conv width (round-4 verdict item 8,
-# measured round 5 at OC20 shape, same-session interleaved A/Bs): the
-# relationship is INVERSE to the hidden-width table above. CGCNN's dense
-# frame gathers [N, K, input_dim] blocks, so gather traffic grows with
-# input width while the segment path's scatter cost stays flat: dense wins
-# ~23% at input_dim 4 (the realistic case — atomic features), is neutral
-# at 64, and LOSES ~33% at 256 in f32. Maximum input_dim at which the
-# dense path is picked automatically.
-_DENSE_AUTO_MAX_INPUT_DIM = {
-    "CGCNN": 64,
-}
-
-
-def auto_dense_aggregation(arch_config: dict) -> bool:
-    """The measured-crossover policy: dense iff the (model type, width)
-    point sits on the dense-winning side of the tables above. Width is
-    hidden_dim for most stacks; CGCNN's constant-width convs key on
-    input_dim instead — and inversely (narrow input = dense wins; see
-    table comment). Absent/0 input_dim stays conservative: segment."""
-    mt = arch_config.get("model_type")
-    th_in = _DENSE_AUTO_MAX_INPUT_DIM.get(mt)
-    if th_in is not None:
-        dim = int(arch_config.get("input_dim") or 0)
-        return 1 <= dim <= th_in
-    th = _DENSE_AUTO_MIN_HIDDEN.get(mt)
-    return th is not None and int(arch_config.get("hidden_dim") or 0) >= th
+# The measured dense/segment crossover tables and the policy function were
+# promoted to ops/autotune.py (the per-bucket aggregation autotuner owns
+# every choice tier now); the loader keeps the historical import surface.
+from hydragnn_tpu.ops.autotune import (  # noqa: F401  (re-exports)
+    DENSE_AUTO_MAX_INPUT_DIM as _DENSE_AUTO_MAX_INPUT_DIM,
+    DENSE_AUTO_MIN_HIDDEN as _DENSE_AUTO_MIN_HIDDEN,
+    auto_dense_aggregation,
+)
 
 
 def arch_for_auto_policy(nn_config: dict) -> dict:
@@ -164,17 +127,42 @@ def arch_for_auto_policy(nn_config: dict) -> dict:
 
 def needs_dense_neighbors(arch_config: dict) -> bool:
     """Single rule for dense scatter-free aggregation in the BATCH-collate
-    path. ``dense_aggregation`` absent/None = AUTO (the measured-crossover
-    policy picks the winning path per model x width); an explicit
-    true/false always wins. Off under graph partitioning — there the
-    partitioner builds per-shard lists itself
-    (``partition_graph(need_neighbors=True)``, wired by the driver)."""
+    path. ``HYDRAGNN_AGG`` (the autotuner's family force) wins over
+    everything; then an explicit ``dense_aggregation`` true/false; then
+    AUTO (the measured-crossover policy picks the winning path per
+    model x width). Off under graph partitioning — there the partitioner
+    builds per-shard lists itself (``partition_graph(need_neighbors=True)``,
+    wired by the driver)."""
     if arch_config.get("partition_axis"):
         return False
+    from hydragnn_tpu.ops.autotune import (
+        DENSE_AUTO_MAX_INPUT_DIM,
+        cached_model_choice,
+        env_force,
+    )
+
+    forced = env_force()
+    if forced is not None:
+        return forced == "dense"
     flag = arch_config.get("dense_aggregation")
-    if flag is None:
-        return auto_dense_aggregation(arch_config)
-    return bool(flag)
+    if flag is not None:
+        return bool(flag)
+    # AUTO: a measured autotuner decision for this model AT THIS WIDTH
+    # beats the static crossover tables — this is where a cached "dense"
+    # win is actually ENACTED (the layout is where dense happens). The
+    # width key mirrors the static policy's: input_dim for the
+    # constant-width stacks (CGCNN), hidden_dim for the rest.
+    mt = arch_config.get("model_type") or ""
+    width = (
+        arch_config.get("input_dim")
+        if mt in DENSE_AUTO_MAX_INPUT_DIM
+        else arch_config.get("hidden_dim")
+    )
+    if width:
+        cached = cached_model_choice(mt, int(width))
+        if cached is not None:
+            return cached == "dense"
+    return auto_dense_aggregation(arch_config)
 
 
 def _sample_stats(datasets, need_triplets, need_neighbors):

@@ -155,6 +155,67 @@ def create_model_config(config: dict, verbosity: int = 0) -> HydraBase:
     raise ValueError(f"Unknown model_type: {model_type}")
 
 
+# ---------------------------------------------------------------------------
+# param-precision policy (mixed bf16 across the model zoo)
+# ---------------------------------------------------------------------------
+
+# Minimum hidden width at which bf16 compute pays per stack: below it the
+# step is op-latency/scatter-bound and bf16 buys nothing while costing
+# precision (graph/segment.py upcasts scatters for exactly this reason);
+# at MXU widths the measured wins are large (BENCH_EXTRA dense-bf16 rows,
+# e.g. PNA h256 1.76x). DimeNet is deliberately absent: its spherical-
+# basis recurrences are precision-sensitive and the measured bf16 delta
+# was within noise — it stays f32 under "auto".
+BF16_AUTO_MIN_HIDDEN = {
+    "PNA": 128,
+    "GAT": 128,
+    "GIN": 128,
+    "SAGE": 128,
+    "MFC": 128,
+    "CGCNN": 128,
+    "SchNet": 128,
+    "EGNN": 128,
+}
+
+
+def resolve_precision(model, training_config: dict) -> dict:
+    """The ONE mixed-precision decision point (steps.py consumes it).
+
+    Master params always stay f32 for the optimizer; this resolves whether
+    the forward/backward COMPUTE runs in bf16. Order:
+
+    1. ``HYDRAGNN_MIXED_PRECISION=0/1`` — operator override;
+    2. explicit ``Training.mixed_precision: true/false``;
+    3. ``Training.mixed_precision: "auto"`` — the per-model width policy
+       above (bf16 iff the stack is in the table AND hidden_dim clears its
+       threshold — tiny CI-scale configs stay f32 under "auto");
+    4. absent — f32 (the conservative historical default).
+
+    Returns ``{"mixed": bool, "source": "env|explicit|policy|default"}``.
+    """
+    import os
+
+    from hydragnn_tpu.ops.autotune import model_key_for
+
+    env = os.getenv("HYDRAGNN_MIXED_PRECISION")
+    if env is not None and env.strip() != "":
+        off = env.strip().lower() in ("0", "false", "no", "off")
+        return {"mixed": not off, "source": "env"}
+    flag = training_config.get("mixed_precision", False)
+    if isinstance(flag, str) and flag.strip().lower() == "auto":
+        key = model_key_for(model)
+        th = BF16_AUTO_MIN_HIDDEN.get(key)
+        mixed = th is not None and int(
+            getattr(model, "hidden_dim", 0) or 0
+        ) >= th
+        return {"mixed": mixed, "source": "policy"}
+    return {
+        "mixed": bool(flag),
+        "source": "explicit" if "mixed_precision" in training_config
+        else "default",
+    }
+
+
 def init_model_params(model: HydraBase, example_batch, seed: int = 0):
     """Materialize parameters + batch stats (reference seeds torch with 0,
     ``create.py:107``).

@@ -5,12 +5,10 @@ an inner MLP [Linear(in,out), ReLU, Linear(out,out)], trainable eps
 initialized at 100.0. Formula: out = MLP((1 + eps) * x_i + sum_{j->i} x_j).
 """
 
-import jax.numpy as jnp
 from flax import linen as nn
 
-from hydragnn_tpu.graph import segment_sum
 from hydragnn_tpu.models.base import HydraBase
-from hydragnn_tpu.models.common import TorchLinear
+from hydragnn_tpu.models.common import TorchLinear, gather_segment_sum
 
 
 class GINConv(nn.Module):
@@ -30,9 +28,12 @@ class GINConv(nn.Module):
             )
             aggr = dense_sum(x_j, extras["nbr_mask"])
         else:
-            msg = x[batch.senders]
-            msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
-            aggr = segment_sum(msg, batch.receivers, x.shape[0])
+            # gather+mask+reduce through the one shared helper: XLA
+            # segment path or the fused Pallas kernel (autotuner/env)
+            aggr = gather_segment_sum(
+                x, batch.senders, batch.receivers, x.shape[0],
+                batch.edge_mask, model_key="GIN",
+            )
         h = (1.0 + eps) * x + aggr
         h = TorchLinear(self.out_dim, name="mlp_0")(h)
         h = nn.relu(h)  # GINStack hardcodes ReLU inside the conv MLP

@@ -7,9 +7,8 @@ defaults): out = lin_l(mean_{j->i} x_j) + lin_r(x_i), lin_r without bias.
 import jax.numpy as jnp
 from flax import linen as nn
 
-from hydragnn_tpu.graph import segment_mean
 from hydragnn_tpu.models.base import HydraBase
-from hydragnn_tpu.models.common import TorchLinear
+from hydragnn_tpu.models.common import TorchLinear, gather_segment_mean
 
 
 class SAGEConv(nn.Module):
@@ -29,17 +28,13 @@ class SAGEConv(nn.Module):
             deg = nmask.sum(axis=1).astype(x.dtype)
             aggr = dense_sum(x_j, nmask) / jnp.maximum(deg, 1.0)[:, None]
         else:
-            msg = x[batch.senders]
-            msg = jnp.where(batch.edge_mask[:, None], msg, 0.0)
-            # mean over real incoming edges only: sum / real degree
-            n = x.shape[0]
-            from hydragnn_tpu.graph import segment_count, segment_sum
-
-            total = segment_sum(msg, batch.receivers, n)
-            deg = segment_count(
-                batch.receivers, n, weights=batch.edge_mask.astype(jnp.float32)
+            # mean over real incoming edges only (sum / real degree),
+            # through the shared helper: XLA segment path or the fused
+            # Pallas kernel (autotuner/env decision)
+            aggr = gather_segment_mean(
+                x, batch.senders, batch.receivers, x.shape[0],
+                batch.edge_mask, model_key="SAGE",
             )
-            aggr = total / jnp.maximum(deg, 1.0)[:, None]
         out = TorchLinear(self.out_dim, name="lin_l")(aggr) + TorchLinear(
             self.out_dim, use_bias=False, name="lin_r"
         )(x)

@@ -23,7 +23,7 @@ from flax import linen as nn
 
 from hydragnn_tpu.graph import segment_mean, segment_sum
 from hydragnn_tpu.models.base import HydraBase
-from hydragnn_tpu.models.common import TorchLinear
+from hydragnn_tpu.models.common import TorchLinear, gather_weighted_segment_sum
 
 
 def shifted_softplus(x):
@@ -178,8 +178,12 @@ class CFConv(nn.Module):
             h_j = gather_neighbors(h, nbr, rev, rmask)
             aggr = dense_sum(h_j * w, nmask)
         else:
-            msg = h[send] * w
-            aggr = segment_sum(msg, recv, n)
+            # continuous-filter aggregation through the shared helper: XLA
+            # gather-multiply-scatter or the fused Pallas kernel
+            # (autotuner/env decision); w is already edge-masked above
+            aggr = gather_weighted_segment_sum(
+                h, w, send, recv, n, model_key="SchNet"
+            )
         lin2 = self.param("lin2", glorot, (self.num_filters, self.out_dim))
         bias2 = self.param("bias2", nn.initializers.zeros, (self.out_dim,))
         out = aggr @ lin2 + bias2

@@ -62,6 +62,10 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # blocked past the threshold; threads carries every thread's held
     # locks + stack at the moment of the dump
     "deadlock_suspect": ("lock", "waited_s", "threads"),
+    # aggregation autotuner (ops/autotune.py): which kernel family one
+    # bucket layout uses and why — source is env|cache|measured (optional
+    # timings_ms carries the measured candidate times)
+    "agg_choice": ("bucket", "choice", "source"),
 }
 
 _ENVELOPE = ("event", "ts", "seq")

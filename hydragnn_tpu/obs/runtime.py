@@ -144,6 +144,12 @@ class TrainingMetrics:
             "hbm_peak_bytes",
             "Compiled-program peak memory (arg+out+temp-aliased)",
         )
+        # aggregation autotuner (ops/autotune.py): 1 on the (bucket,
+        # choice) label set each bucket actually uses
+        r.labeled_gauge(
+            "aggregation_kernel",
+            "Chosen aggregation kernel family per bucket (1 = active)",
+        )
         # live device memory, polled from device 0's memory_stats() at
         # scrape time (stays 0 on backends that report none, e.g. CPU)
         r.gauge("device_bytes_in_use", "Live device memory in use")

@@ -3,3 +3,12 @@ from hydragnn_tpu.ops.pallas_segment import (
     segment_moments,
     segment_sum_onehot,
 )
+from hydragnn_tpu.ops.fused_mp import (
+    fused_egnn_edge_phase,
+    fused_gather_mean,
+    fused_gather_moments,
+    fused_gather_sum,
+    fused_gather_weighted_sum,
+    fused_message_reduce,
+    fused_mp_enabled,
+)

@@ -1,0 +1,539 @@
+"""Fused Pallas message-passing kernels: gather -> edge op -> segment reduce.
+
+``ops/pallas_segment.py`` established why a standalone Pallas segment-sum is
+a dead heat with XLA scatter: the opaque ``pallas_call`` boundary forfeits
+the gather -> edge-MLP -> reduce fusion XLA performs around its own scatter.
+This module moves the WHOLE message-passing step inside one kernel, so
+nothing is left outside to fuse with:
+
+- **gather**: the node table lives in VMEM for the whole grid; each edge
+  block gathers sender (and optionally receiver) rows as
+  ``onehot(ids) @ table`` — a dense matmul the MXU eats, and the table is
+  read from HBM exactly once;
+- **edge op**: the per-edge computation (masking, filter weighting, PNA
+  moments packing, EGNN's two-layer edge MLP + coordinate update) runs on
+  the block while it is VMEM-resident — the ``[E, *]`` message intermediate
+  never exists in HBM;
+- **reduce**: ``onehot(reduce_ids)^T @ messages`` accumulates into a VMEM
+  accumulator, replacing the serializing scatter.
+
+Edge ops are *pure functions* over ``(xs, xr, ef, params)`` registered in
+:data:`EDGE_OPS`; the SAME function body runs inside the kernel (per block)
+and in the custom VJP (full edge axis, via ``jax.vjp`` on XLA) — backward
+parity with the reference segment path is by construction, and the backward
+stays scatter-light: per-edge cotangents are gathered, only the final
+node-table fold is a segment-sum.
+
+Enablement is decided per bucket by ``ops/autotune.py`` (measured, cached)
+or forced via ``HYDRAGNN_FUSED_MP=0/1``; :func:`fused_mp_enabled` guards the
+VMEM footprint (node tables + one-hot indicators + accumulator must fit the
+~16 MB scoped limit). Non-TPU backends run the Pallas interpreter so tier-1
+CPU tests exercise full numeric parity including gradients.
+"""
+
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.pallas_segment import _interpret, _onehot
+
+_EDGE_BLOCK = 256
+# everything the kernel keeps VMEM-resident across the grid (node tables,
+# accumulator) plus the per-block indicators; headroom below the 16 MB
+# scoped limit for the block operands and Mosaic's own temporaries
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+class EdgeOp(NamedTuple):
+    """One registered edge computation.
+
+    ``fn(xs, xr, ef, params) -> (msg, edge_out)``: ``xs``/``xr`` are the
+    node-table rows gathered at ``gather_ids``/``gather_ids_b``, ``ef`` the
+    per-edge features, ``params`` the op's parameter tuple (reshaped back to
+    their original shapes before the call). ``msg`` is segment-reduced at
+    ``reduce_ids``; ``edge_out`` (or None) is written back per edge for
+    callers that also need the un-reduced messages (PNA's min/max pass).
+    MUST be pure jnp/VPU/MXU code: the same body is traced inside the
+    Pallas kernel and differentiated with ``jax.vjp`` in the backward.
+    """
+
+    fn: Callable
+    uses_recv: bool
+    has_edge_out: bool
+
+
+def _op_copy(xs, xr, ef, params):
+    # ef = [E, 1] edge mask; msg = masked sender rows
+    return xs * ef, None
+
+
+def _op_copy_count(xs, xr, ef, params):
+    # packed [msg, mask]: sum AND real in-degree from one reduction
+    return jnp.concatenate([xs * ef, ef], axis=-1), None
+
+
+def _op_mul(xs, xr, ef, params):
+    # SchNet CFConv: msg = h[sender] * w  (w pre-masked, [E, F])
+    return xs * ef, None
+
+
+def _op_moments(xs, xr, ef, params):
+    # PNA: z = yj[sender] (+ encoded edge), masked; packed [z, z^2, mask]
+    # so one reduction yields sum / sum-of-squares / count. z is also
+    # written back per edge — the min/max pass consumes it without a
+    # second gather.
+    d = xs.shape[-1]
+    if ef.shape[-1] == d + 1:  # edge-encoder contribution rides along
+        z = (xs + ef[..., :d]) * ef[..., d:]
+        mask = ef[..., d:]
+    else:
+        z = xs * ef
+        mask = ef
+    return jnp.concatenate([z, z * z, mask], axis=-1), z
+
+
+def _op_egnn(xs, xr, ef, params):
+    # EGNN E_GCL: xs = [y_snd, pos] @ senders, xr = [y_rcv, pos] @ receivers,
+    # ef = [ze(H or 0), mask]; params = (w_rad, W2, b2[, Wc0, bc0, Wc1]).
+    # Computes the full two-layer edge MLP (and, with the coord params
+    # present, the tanh-bounded equivariant update) and reduces the packed
+    # [e(, trans), mask] at the SENDER index — the whole E_GCL edge phase
+    # in one kernel.
+    w_rad = params[0]
+    h = w_rad.shape[-1]
+    y_s, pos_s = xs[..., :h], xs[..., h:]
+    y_r, pos_r = xr[..., :h], xr[..., h:]
+    mask = ef[..., -1:]
+    coord_diff = pos_s - pos_r
+    radial = jnp.sum(coord_diff * coord_diff, axis=-1, keepdims=True)
+    # norm_diff=True with the safe-sqrt contract of egnn._safe_sqrt:
+    # zero-distance pairs are masked rows, whose gradients are killed by
+    # the mask multiply below — the double-where is still used so the
+    # forward value (and any unmasked degenerate pair) stays finite
+    nonzero = radial > 0
+    norm = jnp.where(nonzero, jnp.sqrt(jnp.where(nonzero, radial, 1.0)), 0.0)
+    coord_diff = coord_diff / (norm + 1.0)
+    pre = y_s + y_r + radial * w_rad
+    if ef.shape[-1] > 1:  # encoded edge_attr contribution
+        pre = pre + ef[..., :h]
+    e = jax.nn.relu(pre)
+    e = jax.nn.relu(
+        jax.lax.dot_general(
+            e, params[1],
+            dimension_numbers=(((e.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        + params[2]
+    )
+    e = e * mask
+    if len(params) > 3:  # equivariant: coord MLP + bounded update
+        cw = jax.nn.relu(
+            jax.lax.dot_general(
+                e, params[3],
+                dimension_numbers=(((e.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + params[4]
+        )
+        cw = jnp.tanh(
+            jax.lax.dot_general(
+                cw, params[5],
+                dimension_numbers=(((cw.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        trans = jnp.clip(coord_diff * cw, -100.0, 100.0) * mask
+        return jnp.concatenate([e, trans, mask], axis=-1), None
+    return jnp.concatenate([e, mask], axis=-1), None
+
+
+EDGE_OPS = {
+    "copy": EdgeOp(_op_copy, uses_recv=False, has_edge_out=False),
+    "copy_count": EdgeOp(_op_copy_count, uses_recv=False, has_edge_out=False),
+    "mul": EdgeOp(_op_mul, uses_recv=False, has_edge_out=False),
+    "moments": EdgeOp(_op_moments, uses_recv=False, has_edge_out=True),
+    "egnn": EdgeOp(_op_egnn, uses_recv=True, has_edge_out=False),
+}
+
+
+def fused_mp_enabled(
+    num_nodes: int,
+    num_segments: int,
+    table_dim: int,
+    out_dim: int,
+    table_dim_b: int = 0,
+) -> bool:
+    """VMEM-footprint guard for one fused call: node table(s) + accumulator
+    + the two per-block one-hot indicators must fit the budget. Callers
+    (``ops/autotune.py`` and the env force) AND the parity tests route
+    eligibility through here so a config that would VMEM-OOM at compile
+    time is never selected."""
+    table_bytes = num_nodes * (table_dim + table_dim_b) * 4
+    acc_bytes = num_segments * out_dim * 4
+    onehot_bytes = _EDGE_BLOCK * (num_nodes * (2 if table_dim_b else 1)
+                                  + num_segments) * 4
+    return table_bytes + acc_bytes + onehot_bytes <= _VMEM_BUDGET
+
+
+def _pad_ids(ids, e_pad):
+    pad = e_pad - ids.shape[0]
+    ids = ids.astype(jnp.int32)
+    if pad:
+        ids = jnp.pad(ids, (0, pad), constant_values=jnp.iinfo(jnp.int32).max)
+    return ids.reshape(-1, 1)  # 2-D: Mosaic tiles it conventionally
+
+
+def _shape_params(params):
+    """Transport shapes for the kernel: every param >= 2-D (0/1-D operands
+    get XLA's T(1024) layout, which Mosaic cannot block). Edge fns see the
+    SAME >=2-D shapes in the kernel and in the backward recompute — 1-D
+    params broadcast identically as ``[1, K]``."""
+    leaves = [jnp.asarray(p, jnp.float32) for p in params]
+    return [p.reshape(1, -1) if p.ndim < 2 else p for p in leaves]
+
+
+def _edge_fn_result_dim(op_name, table_dim, table_dim_b, ef_dim, params):
+    """Static (out_dim, edge_out_dim) probe via eval_shape — the kernel and
+    pallas_call out_shape need them before tracing."""
+    op = EDGE_OPS[op_name]
+    xs = jax.ShapeDtypeStruct((_EDGE_BLOCK, table_dim), jnp.float32)
+    xr = jax.ShapeDtypeStruct((_EDGE_BLOCK, table_dim_b or table_dim),
+                              jnp.float32)
+    ef = jax.ShapeDtypeStruct((_EDGE_BLOCK, ef_dim), jnp.float32)
+    p_shapes = [jax.ShapeDtypeStruct(p.shape, jnp.float32) for p in params]
+    msg, edge_out = jax.eval_shape(
+        lambda a, b, c, p: op.fn(a, b, c, p), xs, xr, ef, p_shapes
+    )
+    return msg.shape[-1], None if edge_out is None else edge_out.shape[-1]
+
+
+def _fused_impl(
+    op_name,
+    num_segments,
+    interpret,
+    node_a,
+    node_b,
+    edge_feat,
+    params,
+    gather_ids,
+    gather_ids_b,
+    reduce_ids,
+):
+    from jax.experimental import pallas as pl
+
+    op = EDGE_OPS[op_name]
+    interpret = _interpret(interpret)
+    node_a = node_a.astype(jnp.float32)
+    n_a, d_a = node_a.shape
+    if op.uses_recv:
+        node_b = node_b.astype(jnp.float32)
+        n_b, d_b = node_b.shape
+    else:
+        node_b, n_b, d_b = None, 0, 0
+
+    e = gather_ids.shape[0]
+    e_pad = e + ((-e) % _EDGE_BLOCK)
+    grid = e_pad // _EDGE_BLOCK
+    edge_feat = edge_feat.astype(jnp.float32)
+    if e_pad != e:
+        edge_feat = jnp.pad(edge_feat, ((0, e_pad - e), (0, 0)))
+    ef_dim = edge_feat.shape[1]
+    gid_a = _pad_ids(gather_ids, e_pad)
+    rid = _pad_ids(reduce_ids, e_pad)
+    gid_b = _pad_ids(gather_ids_b, e_pad) if op.uses_recv else None
+
+    param_shaped = _shape_params(params)
+    out_dim, edge_out_dim = _edge_fn_result_dim(
+        op_name, d_a, d_b, ef_dim, param_shaped
+    )
+
+    n_params = len(param_shaped)
+
+    def kernel(*refs):
+        i = 0
+        gid_a_ref = refs[i]; i += 1
+        if op.uses_recv:
+            gid_b_ref = refs[i]; i += 1
+        rid_ref = refs[i]; i += 1
+        ef_ref = refs[i]; i += 1
+        na_ref = refs[i]; i += 1
+        if op.uses_recv:
+            nb_ref = refs[i]; i += 1
+        p_refs = refs[i : i + n_params]; i += n_params
+        out_ref = refs[i]; i += 1
+        edge_out_ref = refs[i] if op.has_edge_out else None
+
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        tdot = functools.partial(
+            jax.lax.dot_general, preferred_element_type=jnp.float32
+        )
+        # gather: onehot(ids) @ table — out-of-range (padded) ids give a
+        # zero row, so padded edges gather zeros
+        xs = tdot(
+            _onehot(gid_a_ref[:], n_a), na_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+        )
+        xr = (
+            tdot(
+                _onehot(gid_b_ref[:], n_b), nb_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+            )
+            if op.uses_recv
+            else xs
+        )
+        kernel_params = [r[:] for r in p_refs]
+        msg, edge_out = op.fn(xs, xr, ef_ref[:], kernel_params)
+        # reduce: onehot(reduce_ids)^T @ msg — padded edges' reduce rows
+        # are all-zero, so whatever the edge op produced on them (bias
+        # terms survive a zero input) contributes nothing
+        out_ref[:] += tdot(
+            _onehot(rid_ref[:], num_segments), msg,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+        )
+        if edge_out_ref is not None:
+            edge_out_ref[:] = edge_out
+
+    blk = lambda w: pl.BlockSpec((_EDGE_BLOCK, w), lambda i: (i, 0))
+    full = lambda s: pl.BlockSpec(s, lambda i: tuple(0 for _ in s))
+    in_specs = [blk(1)]
+    operands = [gid_a]
+    if op.uses_recv:
+        in_specs.append(blk(1)); operands.append(gid_b)
+    in_specs += [blk(1), blk(ef_dim), full((n_a, d_a))]
+    operands += [rid, edge_feat, node_a]
+    if op.uses_recv:
+        in_specs.append(full((n_b, d_b))); operands.append(node_b)
+    for p in param_shaped:
+        in_specs.append(full(p.shape)); operands.append(p)
+
+    out_shape = [jax.ShapeDtypeStruct((num_segments, out_dim), jnp.float32)]
+    out_specs = [full((num_segments, out_dim))]
+    if op.has_edge_out:
+        out_shape.append(
+            jax.ShapeDtypeStruct((e_pad, edge_out_dim), jnp.float32)
+        )
+        out_specs.append(blk(edge_out_dim))
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shape),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        interpret=interpret,
+    )(*operands)
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    if op.has_edge_out:
+        return outs[0], outs[1][:e]
+    return outs[0], None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def fused_message_reduce(
+    op_name: str,
+    num_segments: int,
+    interpret: bool,
+    node_a,
+    node_b,
+    edge_feat,
+    params: Tuple,
+    gather_ids,
+    gather_ids_b,
+    reduce_ids,
+):
+    """One fused message-passing step.
+
+    ``out[s] = sum_{e: reduce_ids[e]==s} fn(node_a[gather_ids[e]],
+    node_b[gather_ids_b[e]], edge_feat[e], params)`` with ``fn`` =
+    ``EDGE_OPS[op_name]``; ops with ``has_edge_out`` also return the
+    per-edge messages (else None). All floating inputs are differentiable;
+    id arrays are not. Numerics: f32 accumulation regardless of input
+    dtype (callers cast the result back if they need to)."""
+    out, edge_out = _fused_impl(
+        op_name, num_segments, interpret,
+        node_a, node_b, edge_feat, params,
+        gather_ids, gather_ids_b, reduce_ids,
+    )
+    return out, edge_out
+
+
+def _fused_fwd(op_name, num_segments, interpret, node_a, node_b, edge_feat,
+               params, gather_ids, gather_ids_b, reduce_ids):
+    out = fused_message_reduce(
+        op_name, num_segments, interpret, node_a, node_b, edge_feat, params,
+        gather_ids, gather_ids_b, reduce_ids,
+    )
+    return out, (node_a, node_b, edge_feat, params, gather_ids,
+                 gather_ids_b, reduce_ids)
+
+
+def _fused_bwd(op_name, num_segments, interpret, res, g):
+    """Gather-based backward on XLA: recompute the edge op per edge from
+    the residual inputs and pull cotangents through ``jax.vjp`` of the
+    SAME edge function — gradient parity with the unfused path by
+    construction. The only scatters are the final node-table folds
+    (f32 segment-sums XLA fuses with the surrounding gathers)."""
+    node_a, node_b, edge_feat, params, gid_a, gid_b, rid = res
+    op = EDGE_OPS[op_name]
+    g_red, g_edge = g
+
+    def _safe_gather(table, ids):
+        """Same padding contract as the forward one-hot gather: rows with
+        out-of-range ids read ZERO (a bare table[ids] would clamp-gather
+        the last row and linearize the edge op around the wrong point —
+        the padded-edge bug class fixed in pallas_segment's VJPs too)."""
+        valid = (ids >= 0) & (ids < table.shape[0])
+        safe = jnp.clip(ids, 0, table.shape[0] - 1)
+        return jnp.where(valid[:, None], table[safe], 0.0)
+
+    node_a32 = node_a.astype(jnp.float32)
+    xs = _safe_gather(node_a32, gid_a)
+    if op.uses_recv:
+        node_b32 = node_b.astype(jnp.float32)
+        xr = _safe_gather(node_b32, gid_b)
+    else:
+        xr = xs
+    ef = edge_feat.astype(jnp.float32)
+    p32 = _shape_params(params)
+
+    def f(xs_, xr_, ef_, p_):
+        msg, edge_out = op.fn(xs_, xr_, ef_, p_)
+        return (msg, edge_out) if op.has_edge_out else msg
+
+    _, vjp_fn = jax.vjp(f, xs, xr, ef, p32)
+    # out-of-range reduce ids contributed nothing forward -> zero cotangent
+    ge = _safe_gather(g_red.astype(jnp.float32), rid)
+    if op.has_edge_out:
+        if g_edge is None:
+            # custom_vjp instantiates zero cotangents today; this guards a
+            # future symbolic-zeros change — shape comes from the op probe
+            _, ed = _edge_fn_result_dim(
+                op_name, xs.shape[-1], xr.shape[-1], ef.shape[-1], p32
+            )
+            gz = jnp.zeros((xs.shape[0], ed), jnp.float32)
+        else:
+            gz = g_edge.astype(jnp.float32)
+        d_xs, d_xr, d_ef, d_params = vjp_fn((ge, gz))
+    else:
+        d_xs, d_xr, d_ef, d_params = vjp_fn(ge)
+    d_node_a = jax.ops.segment_sum(d_xs, gid_a, num_segments=node_a.shape[0])
+    if op.uses_recv:
+        d_node_a_b = jax.ops.segment_sum(
+            d_xr, gid_b, num_segments=node_b.shape[0]
+        )
+        d_node_b = d_node_a_b.astype(node_b.dtype)
+    else:
+        # xr aliased xs: its cotangent already flowed through d_xs's vjp
+        # output only when the op read it — copy-family ops ignore xr
+        d_node_b = None
+    d_params = tuple(
+        dp.reshape(jnp.shape(p)).astype(jnp.asarray(p).dtype)
+        for dp, p in zip(d_params, params)
+    )
+    return (
+        d_node_a.astype(node_a.dtype),
+        d_node_b,
+        d_ef.astype(edge_feat.dtype),
+        d_params,
+        None,
+        None,
+        None,
+    )
+
+
+fused_message_reduce.defvjp(_fused_fwd, _fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# model-facing wrappers (thin shape/packing adapters over the one kernel)
+# ---------------------------------------------------------------------------
+
+
+def fused_gather_sum(x, senders, receivers, num_segments, edge_mask,
+                     interpret: bool = False):
+    """``segment_sum(where(mask, x[senders], 0), receivers)`` in one fused
+    kernel (GIN's aggregation). Returns ``[num_segments, D]`` f32."""
+    out, _ = fused_message_reduce(
+        "copy", num_segments, interpret,
+        x, None, edge_mask.astype(jnp.float32)[:, None], (),
+        senders, None, receivers,
+    )
+    return out
+
+
+def fused_gather_mean(x, senders, receivers, num_segments, edge_mask,
+                      interpret: bool = False):
+    """Masked mean over real incoming edges (SAGE): sum and real in-degree
+    from ONE fused reduction. Returns ``([S, D] mean, [S, 1] degree)``."""
+    out, _ = fused_message_reduce(
+        "copy_count", num_segments, interpret,
+        x, None, edge_mask.astype(jnp.float32)[:, None], (),
+        senders, None, receivers,
+    )
+    d = x.shape[-1]
+    deg = out[:, d:]
+    return out[:, :d] / jnp.maximum(deg, 1.0), deg
+
+
+def fused_gather_weighted_sum(h, w, senders, receivers, num_segments,
+                              interpret: bool = False):
+    """``segment_sum(h[senders] * w, receivers)`` in one fused kernel
+    (SchNet's CFConv aggregation; ``w`` pre-masked ``[E, F]``)."""
+    out, _ = fused_message_reduce(
+        "mul", num_segments, interpret,
+        h, None, w, (),
+        senders, None, receivers,
+    )
+    return out
+
+
+def fused_gather_moments(yj, senders, receivers, num_segments, edge_mask,
+                         ze=None, interpret: bool = False):
+    """PNA's statistics pass: ``z = (yj[senders] (+ ze)) * mask`` with
+    (sum, count, sum-of-squares) reduced at receivers AND ``z`` returned
+    per edge for the min/max pass — one gather, one reduction.
+    Returns ``(s [S, D], cnt [S, 1], sq [S, D], z [E, D])``."""
+    mask = edge_mask.astype(jnp.float32)[:, None]
+    ef = mask if ze is None else jnp.concatenate(
+        [ze.astype(jnp.float32), mask], axis=-1
+    )
+    out, z = fused_message_reduce(
+        "moments", num_segments, interpret,
+        yj, None, ef, (),
+        senders, None, receivers,
+    )
+    d = yj.shape[-1]
+    return out[:, :d], out[:, 2 * d :], out[:, d : 2 * d], z
+
+
+def fused_egnn_edge_phase(
+    y_snd, y_rcv, pos, edge_params, senders, receivers, num_segments,
+    edge_mask, ze=None, interpret: bool = False,
+):
+    """EGNN's whole edge phase — radial, two-layer edge MLP, optional
+    equivariant coordinate weighting — fused with the sender-side
+    aggregation. ``edge_params`` = (w_rad [1, H], W2, b2[, Wc0, bc0, Wc1]);
+    with the coord params present the packed result carries the coordinate
+    update. Returns ``[S, H + (3) + 1]`` packed (agg, (coord_agg), count)."""
+    node_a = jnp.concatenate(
+        [y_snd.astype(jnp.float32), pos.astype(jnp.float32)], axis=-1
+    )
+    node_b = jnp.concatenate(
+        [y_rcv.astype(jnp.float32), pos.astype(jnp.float32)], axis=-1
+    )
+    mask = edge_mask.astype(jnp.float32)[:, None]
+    ef = mask if ze is None else jnp.concatenate(
+        [ze.astype(jnp.float32), mask], axis=-1
+    )
+    out, _ = fused_message_reduce(
+        "egnn", num_segments, interpret,
+        node_a, node_b, ef, tuple(edge_params),
+        senders, receivers, senders,
+    )
+    return out

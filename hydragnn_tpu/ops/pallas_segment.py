@@ -39,13 +39,21 @@ _VMEM_ACC_BUDGET = 6 * 1024 * 1024  # bytes of VMEM we allow the accumulators
 def pallas_segments_enabled(num_segments: int, dim: int, n_outputs: int = 1):
     """Decide kernel vs XLA fallback for a [num_segments, dim] accumulation.
 
+    On via ``HYDRAGNN_PALLAS=1`` or the autotuner's family force
+    ``HYDRAGNN_AGG=fused`` (``ops/autotune.py``): forcing the fused
+    message-passing family also turns on the one-hot segment kernels at
+    the sites the fused ops don't cover, so an A/B flips the whole tree.
+
     Budget covers everything the kernel keeps resident in VMEM: the
     accumulators AND the per-block ``[_EDGE_BLOCK, num_segments]`` one-hot
     indicator (at 16k+ segments the indicator alone exceeds the 16 MB VMEM
     scoped limit — observed as a compile-time VMEM OOM on the giant-graph
     partition config before this guard included it)."""
     if os.getenv("HYDRAGNN_PALLAS", "0") != "1":
-        return False
+        from hydragnn_tpu.ops.autotune import env_force
+
+        if env_force() != "fused":
+            return False
     acc_bytes = n_outputs * num_segments * max(dim, 1) * 4
     onehot_bytes = _EDGE_BLOCK * num_segments * 4
     return acc_bytes + onehot_bytes <= _VMEM_ACC_BUDGET
@@ -144,9 +152,13 @@ def _segment_sum_fwd(data, segment_ids, num_segments, interpret):
 
 def _segment_sum_bwd(num_segments, interpret, res, g):
     segment_ids, _ = res
-    # d/d_data = g gathered at each edge's segment; padded/out-of-range ids
-    # never reach here (they only exist inside the kernel)
-    return g[segment_ids], None
+    # d/d_data = g gathered at each edge's segment. Out-of-range ids (the
+    # kernels' padded-edge contract: they contribute nothing forward) must
+    # get exactly ZERO gradient — a bare g[ids] would clamp-gather the
+    # last segment's cotangent onto them.
+    valid = (segment_ids >= 0) & (segment_ids < num_segments)
+    safe = jnp.clip(segment_ids, 0, num_segments - 1)
+    return jnp.where(valid[:, None], g[safe], 0.0), None
 
 
 segment_sum_onehot.defvjp(_segment_sum_fwd, _segment_sum_bwd)
@@ -225,8 +237,12 @@ def _moments_fwd(data, segment_ids, num_segments, interpret):
 def _moments_bwd(num_segments, interpret, res, grads):
     data, segment_ids = res
     g_sum, _g_cnt, g_sq = grads  # count is piecewise constant: no gradient
-    d_data = g_sum[segment_ids] + 2.0 * data * g_sq[segment_ids]
-    return d_data, None
+    # same padded-edge contract as _segment_sum_bwd: out-of-range ids
+    # contributed nothing forward, so they get zero gradient back
+    valid = (segment_ids >= 0) & (segment_ids < num_segments)
+    safe = jnp.clip(segment_ids, 0, num_segments - 1)
+    d_data = g_sum[safe] + 2.0 * data * g_sq[safe]
+    return jnp.where(valid[:, None], d_data, 0.0), None
 
 
 segment_moments.defvjp(_moments_fwd, _moments_bwd)

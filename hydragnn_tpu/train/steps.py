@@ -48,10 +48,14 @@ def build_steps(model, tx, training_config: dict) -> CompiledSteps:
     # to f32 (graph/segment.py). The QM9-scale step is scatter/
     # op-latency-bound, not matmul-bound, so bf16 buys little there;
     # expect wins on matmul-bound configurations (wide hidden dims,
-    # dense-mode batches). Accuracy-validated opt-in
+    # dense-mode batches). Enablement is the param-precision policy in
+    # models/create.py (HYDRAGNN_MIXED_PRECISION env > explicit bool >
+    # "auto" per-model width table); accuracy-validated
     # (tests/test_mixed_precision.py) — measure with a true completion
     # fence before enabling (see BASELINE.md measurement note).
-    mixed = bool(training_config.get("mixed_precision", False))
+    from hydragnn_tpu.models.create import resolve_precision
+
+    mixed = resolve_precision(model, training_config)["mixed"]
     # divergence guard (train/guard.py): when on, every train step also
     # reports a device-computed "finite" scalar — loss AND all gradient
     # leaves finite — so the host can skip a poisoned update without

@@ -1,0 +1,90 @@
+"""CI kernel-microbench smoke driver: the aggregation autotuner end to end
+on one bucket, interpreter mode, with schema-validated observability.
+
+Usage: ``python tests/_autotune_smoke.py <outdir>``
+
+Runs the autotuner's measured pass over {segment, dense, fused} for one
+small bucket (the fused candidate runs the Pallas interpreter on CPU),
+asserts the decision lands in the on-disk cache AND that a second,
+cache-state-dropped read returns the SAME choice without re-timing
+(source=cache), exercises the env override, and validates the emitted
+``agg_choice`` events against the documented schema. Exits non-zero on
+any missing piece.
+
+(Underscore-prefixed: a driver script, not a collected test file. The
+pytest twin is tests/test_autotune.py.)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(outdir: str) -> int:
+    os.makedirs(outdir, exist_ok=True)
+    os.environ["HYDRAGNN_AUTOTUNE_CACHE"] = os.path.join(
+        outdir, "autotune.json"
+    )
+    from hydragnn_tpu.obs import runtime as obs_rt
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.ops import autotune as at
+
+    telem = obs_rt.activate(obs_rt.RunTelemetry("autotune-smoke", outdir))
+    try:
+        # interpret=True: explicitly time the interpreter so the fused
+        # machinery is exercised on CPU CI (off-TPU, autotune_bucket
+        # otherwise refuses to let emulation timings into the cache)
+        choice = at.autotune_bucket(
+            "GIN", 64, 256, 16, candidates=("segment", "dense", "fused"),
+            iters=3, interpret=True,
+        )
+        assert choice in at.CHOICES, choice
+        sig = at.bucket_signature("GIN", 64, 256, 16)
+        cache = json.load(open(at.cache_path()))
+        rec = cache["devices"][at.device_kind()][sig]
+        assert rec["choice"] == choice, rec
+        assert set(rec["timings_ms"]) == {"segment", "dense", "fused"}, rec
+
+        # deterministic re-read: drop the in-process state, same answer,
+        # sourced from the cache (no re-timing)
+        at.reset_cache_state()
+        assert at.autotune_bucket("GIN", 64, 256, 16) == choice
+
+        # env override wins over the cached decision
+        os.environ["HYDRAGNN_AGG"] = "segment"
+        try:
+            assert at.autotune_bucket("GIN", 64, 256, 16) == "segment"
+            assert not at.use_fused("GIN", 64, 256, 16, 16)
+        finally:
+            del os.environ["HYDRAGNN_AGG"]
+    finally:
+        obs_rt.deactivate()
+
+    recs = validate_events(
+        os.path.join(outdir, "events.jsonl"), require=["agg_choice"]
+    )
+    ev = [r for r in recs if r["event"] == "agg_choice"]
+    sources = {r["source"] for r in ev}
+    assert {"measured", "cache", "env"} <= sources, sources
+    measured = [r for r in ev if r["source"] == "measured"]
+    assert measured and measured[0]["timings_ms"], measured
+    print(
+        f"autotune smoke ok: bucket {sig} -> {choice} "
+        f"(timings {measured[0]['timings_ms']}), {len(ev)} agg_choice "
+        f"event(s), cache at {at.cache_path()}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(
+            "usage: python tests/_autotune_smoke.py <outdir>",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    sys.exit(main(sys.argv[1]))

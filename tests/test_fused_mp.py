@@ -1,0 +1,280 @@
+"""Fused message-passing kernels (ops/fused_mp.py) vs XLA references.
+
+Interpret mode on CPU — the same kernel code compiles on TPU. Values AND
+gradients must match the unfused gather -> edge-op -> segment-sum
+composition, including masked (padded) edges, empty segments, and edge
+counts that are not a multiple of the kernel block.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hydragnn_tpu.ops import (
+    fused_egnn_edge_phase,
+    fused_gather_mean,
+    fused_gather_moments,
+    fused_gather_sum,
+    fused_gather_weighted_sum,
+    fused_mp_enabled,
+)
+
+
+def _case(seed=0, e=301, n=40, d=12, mask_p=0.2):
+    """e=301 is deliberately NOT a multiple of the 256 edge block."""
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    snd = jnp.asarray(rng.integers(0, n, e), jnp.int32)
+    rcv = jnp.asarray(rng.integers(0, n, e), jnp.int32)
+    mask = jnp.asarray(rng.random(e) > mask_p)
+    return x, snd, rcv, mask, n
+
+
+def _ref_sum(x, snd, rcv, mask, n):
+    msg = jnp.where(mask[:, None], x[snd], 0.0)
+    return jax.ops.segment_sum(msg, rcv, num_segments=n)
+
+
+def pytest_fused_gather_sum_matches_xla():
+    x, snd, rcv, mask, n = _case()
+    out = fused_gather_sum(x, snd, rcv, n, mask, True)
+    np.testing.assert_allclose(
+        out, _ref_sum(x, snd, rcv, mask, n), rtol=1e-5, atol=1e-5
+    )
+
+
+def pytest_fused_gather_sum_grad():
+    x, snd, rcv, mask, n = _case(seed=1, e=120, n=24, d=8)
+
+    def ours(x):
+        return jnp.sum(fused_gather_sum(x, snd, rcv, n, mask, True) ** 2)
+
+    def ref(x):
+        return jnp.sum(_ref_sum(x, snd, rcv, mask, n) ** 2)
+
+    np.testing.assert_allclose(
+        jax.grad(ours)(x), jax.grad(ref)(x), rtol=1e-4, atol=1e-5
+    )
+
+
+def pytest_fused_gather_sum_empty_segments():
+    x, snd, rcv, mask, n = _case(seed=2, e=60, n=32)
+    rcv = jnp.minimum(rcv, 9)  # segments 10.. empty
+    out = fused_gather_sum(x, snd, rcv, n, mask, True)
+    assert np.allclose(np.asarray(out[10:]), 0.0)
+
+
+def pytest_fused_gather_mean_matches_xla():
+    x, snd, rcv, mask, n = _case(seed=3)
+    mean, deg = fused_gather_mean(x, snd, rcv, n, mask, True)
+    cnt = jax.ops.segment_sum(mask.astype(jnp.float32), rcv, num_segments=n)
+    ref = _ref_sum(x, snd, rcv, mask, n) / jnp.maximum(cnt, 1.0)[:, None]
+    np.testing.assert_allclose(mean, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(deg[:, 0], cnt, rtol=1e-6, atol=0)
+
+
+def pytest_fused_gather_weighted_sum_matches_xla():
+    x, snd, rcv, mask, n = _case(seed=4)
+    rng = np.random.default_rng(14)
+    w = jnp.asarray(rng.standard_normal(x[snd].shape), jnp.float32)
+    w = w * mask[:, None]
+    out = fused_gather_weighted_sum(x, w, snd, rcv, n, True)
+    ref = jax.ops.segment_sum(x[snd] * w, rcv, num_segments=n)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def ours(x, w):
+        return jnp.sum(fused_gather_weighted_sum(x, w, snd, rcv, n, True) ** 2)
+
+    def refl(x, w):
+        return jnp.sum(jax.ops.segment_sum(x[snd] * w, rcv, num_segments=n) ** 2)
+
+    ga = jax.grad(ours, argnums=(0, 1))(x, w)
+    gb = jax.grad(refl, argnums=(0, 1))(x, w)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def pytest_fused_gather_moments_matches_xla():
+    x, snd, rcv, mask, n = _case(seed=5)
+    rng = np.random.default_rng(15)
+    ze = jnp.asarray(rng.standard_normal(x[snd].shape), jnp.float32)
+    s, c, sq, z = fused_gather_moments(x, snd, rcv, n, mask, ze, True)
+    z_ref = jnp.where(mask[:, None], x[snd] + ze, 0.0)
+    np.testing.assert_allclose(z, z_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        s, jax.ops.segment_sum(z_ref, rcv, num_segments=n),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        c[:, 0],
+        jax.ops.segment_sum(mask.astype(jnp.float32), rcv, num_segments=n),
+        rtol=1e-6, atol=0,
+    )
+    np.testing.assert_allclose(
+        sq, jax.ops.segment_sum(z_ref * z_ref, rcv, num_segments=n),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def pytest_fused_gather_moments_grad_through_all_outputs():
+    # gradient flows through the reduced stats AND the per-edge z output
+    x, snd, rcv, mask, n = _case(seed=6, e=96, n=24, d=6)
+    rng = np.random.default_rng(16)
+    ze = jnp.asarray(rng.standard_normal((96, 6)), jnp.float32)
+
+    def ours(x, ze):
+        s, c, sq, z = fused_gather_moments(x, snd, rcv, n, mask, ze, True)
+        mean = s / jnp.maximum(c, 1.0)
+        return jnp.sum(mean**2) + jnp.sum(sq) + jnp.sum(z**3)
+
+    def ref(x, ze):
+        z = jnp.where(mask[:, None], x[snd] + ze, 0.0)
+        s = jax.ops.segment_sum(z, rcv, num_segments=n)
+        c = jax.ops.segment_sum(
+            mask.astype(jnp.float32), rcv, num_segments=n
+        )[:, None]
+        sq = jax.ops.segment_sum(z * z, rcv, num_segments=n)
+        mean = s / jnp.maximum(c, 1.0)
+        return jnp.sum(mean**2) + jnp.sum(sq) + jnp.sum(z**3)
+
+    ga = jax.grad(ours, argnums=(0, 1))(x, ze)
+    gb = jax.grad(ref, argnums=(0, 1))(x, ze)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def _egnn_setup(equivariant, seed=7, e=90, n=20, h=8):
+    rng = np.random.default_rng(seed)
+    snd = jnp.asarray(rng.integers(0, n, e), jnp.int32)
+    rcv = jnp.asarray(rng.integers(0, n, e), jnp.int32)
+    mask = jnp.asarray(rng.random(e) > 0.25)
+    ys = jnp.asarray(rng.standard_normal((n, h)), jnp.float32)
+    yr = jnp.asarray(rng.standard_normal((n, h)), jnp.float32)
+    pos = jnp.asarray(rng.standard_normal((n, 3)), jnp.float32)
+    params = [
+        jnp.asarray(rng.standard_normal((h,)), jnp.float32),  # w_rad
+        jnp.asarray(rng.standard_normal((h, h)) * 0.3, jnp.float32),
+        jnp.asarray(rng.standard_normal((h,)) * 0.1, jnp.float32),
+    ]
+    if equivariant:
+        params += [
+            jnp.asarray(rng.standard_normal((h, h)) * 0.3, jnp.float32),
+            jnp.zeros((h,), jnp.float32),
+            jnp.asarray(rng.standard_normal((h, 1)) * 0.1, jnp.float32),
+        ]
+    return ys, yr, pos, tuple(params), snd, rcv, mask, n, h
+
+
+def _egnn_ref(ys, yr, pos, params, snd, rcv, mask, n):
+    w_rad, W2, b2 = params[:3]
+    cd = pos[snd] - pos[rcv]
+    radial = (cd * cd).sum(-1, keepdims=True)
+    nz = radial > 0
+    norm = jnp.where(nz, jnp.sqrt(jnp.where(nz, radial, 1.0)), 0.0)
+    cd = cd / (norm + 1.0)
+    pre = ys[snd] + yr[rcv] + radial * w_rad
+    e = jax.nn.relu(pre)
+    e = jax.nn.relu(e @ W2 + b2)
+    e = jnp.where(mask[:, None], e, 0.0)
+    if len(params) > 3:
+        Wc0, bc0, Wc1 = params[3:]
+        cw = jax.nn.relu(e @ Wc0 + bc0)
+        cw = jnp.tanh(cw @ Wc1)
+        trans = jnp.clip(cd * cw, -100.0, 100.0)
+        trans = jnp.where(mask[:, None], trans, 0.0)
+        packed = jnp.concatenate(
+            [e, trans, mask.astype(jnp.float32)[:, None]], -1
+        )
+    else:
+        packed = jnp.concatenate([e, mask.astype(jnp.float32)[:, None]], -1)
+    return jax.ops.segment_sum(packed, snd, num_segments=n)
+
+
+def pytest_fused_egnn_edge_phase_matches_xla():
+    for equivariant in (False, True):
+        ys, yr, pos, params, snd, rcv, mask, n, h = _egnn_setup(equivariant)
+        out = fused_egnn_edge_phase(
+            ys, yr, pos, params, snd, rcv, n, mask, None, True
+        )
+        ref = _egnn_ref(ys, yr, pos, params, snd, rcv, mask, n)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def pytest_fused_egnn_edge_phase_grad():
+    ys, yr, pos, params, snd, rcv, mask, n, h = _egnn_setup(True)
+
+    def ours(ys, yr, pos, params):
+        return jnp.sum(
+            fused_egnn_edge_phase(
+                ys, yr, pos, params, snd, rcv, n, mask, None, True
+            )
+            ** 2
+        )
+
+    def ref(ys, yr, pos, params):
+        return jnp.sum(_egnn_ref(ys, yr, pos, params, snd, rcv, mask, n) ** 2)
+
+    ga = jax.tree_util.tree_leaves(
+        jax.grad(ours, argnums=(0, 1, 2, 3))(ys, yr, pos, params)
+    )
+    gb = jax.tree_util.tree_leaves(
+        jax.grad(ref, argnums=(0, 1, 2, 3))(ys, yr, pos, params)
+    )
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(
+            a, b, rtol=1e-3, atol=np.abs(np.asarray(b)).max() * 1e-4 + 1e-5
+        )
+
+
+def pytest_fused_backward_zeroes_out_of_range_ids():
+    # the VJP honors the forward kernel's padding contract: edges whose
+    # GATHER id is out of range linearize around a ZERO gather (not a
+    # clamp-gather of the last row), and out-of-range REDUCE ids get a
+    # zero cotangent
+    x, snd, rcv, mask, n = _case(seed=8, e=60, n=16, d=4, mask_p=0.0)
+    big = jnp.iinfo(jnp.int32).max
+    snd = snd.at[-5:].set(big)
+    rng = np.random.default_rng(18)
+    ze = jnp.asarray(rng.standard_normal((60, 4)), jnp.float32)
+
+    def loss(x, ze):
+        s, c, sq, z = fused_gather_moments(x, snd, rcv, n, mask, ze, True)
+        return jnp.sum(s**2) + jnp.sum(z**3)
+
+    def ref(x, ze):
+        real = snd < n
+        safe = jnp.where(real, snd, 0)
+        z = jnp.where(real[:, None], x[safe], 0.0) + ze  # mask all-true
+        s = jax.ops.segment_sum(z, rcv, num_segments=n)
+        return jnp.sum(s**2) + jnp.sum(z**3)
+
+    ga = jax.grad(loss, argnums=(0, 1))(x, ze)
+    gb = jax.grad(ref, argnums=(0, 1))(x, ze)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    # and reduce-side: out-of-range receivers drop from the reduction
+    rcv2 = rcv.at[-5:].set(big)
+
+    def loss2(x):
+        return jnp.sum(fused_gather_sum(x, snd, rcv2, n, mask, True) ** 2)
+
+    def ref2(x):
+        real = (snd < n) & (rcv2 < n)
+        safe_s = jnp.where(snd < n, snd, 0)
+        z = jnp.where(real[:, None], x[safe_s], 0.0)
+        safe_r = jnp.where(rcv2 < n, rcv2, n)
+        return jnp.sum(
+            jax.ops.segment_sum(z, safe_r, num_segments=n + 1)[:n] ** 2
+        )
+
+    np.testing.assert_allclose(
+        jax.grad(loss2)(x), jax.grad(ref2)(x), rtol=1e-4, atol=1e-5
+    )
+
+
+def pytest_fused_mp_vmem_guard():
+    # small configs fit; a node table alone past the budget does not
+    assert fused_mp_enabled(1024, 1024, 64, 64)
+    assert not fused_mp_enabled(200_000, 200_000, 64, 64)
+    # the one-hot indicators count too: huge N at tiny dim must not pass
+    assert not fused_mp_enabled(2_000_000, 2_000_000, 1, 1)

@@ -1,21 +1,34 @@
-"""The pallas-fused PNA path must be numerically identical to the XLA path.
+"""Pallas kernel paths must be numerically identical to the XLA paths.
 
-Flips ``HYDRAGNN_PALLAS`` and compares the full multihead forward, loss and
-parameter gradients on the same batch and parameters.
+Three layers of parity, all on the CPU interpreter (the same kernel code
+compiles on TPU):
+
+- model-level, one-hot segment kernels: flip ``HYDRAGNN_PALLAS`` and
+  compare the full multihead forward, loss and parameter gradients on the
+  same batch and parameters (PNA — the stack that consumes
+  ``segment_moments``);
+- model-level, fused message-passing kernels (``ops/fused_mp.py``): flip
+  ``HYDRAGNN_FUSED_MP`` and compare the same way for SchNet, EGNN
+  (equivariant and not), PNA, GIN and SAGE;
+- op-level backward: the custom VJPs of ``segment_sum_onehot`` and
+  ``segment_moments`` against the reference ``jax.ops.segment_sum`` VJP,
+  including padded-edge (out-of-range ids) and empty-segment cases.
 """
 
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from hydragnn_tpu.graph import collate_graphs, pad_sizes_for
 from hydragnn_tpu.models import create_model_config, init_model_params
+from hydragnn_tpu.ops import segment_moments, segment_sum_onehot
 
 
-def _arch():
+def _arch(model_type="PNA", equivariance=False):
     return {
-        "model_type": "PNA",
+        "model_type": model_type,
         "input_dim": 1,
         "hidden_dim": 16,
         "output_dim": [1, 1],
@@ -34,7 +47,10 @@ def _arch():
         "num_nodes": 10,
         "edge_dim": None,
         "pna_deg": [0, 4, 8, 4],
-        "equivariance": False,
+        "equivariance": equivariance,
+        "num_gaussians": 8,
+        "num_filters": 16,
+        "radius": 3.0,
     }
 
 
@@ -65,11 +81,12 @@ def _batch(seed=0):
     )
 
 
-def _loss_and_grads(flag_value):
-    os.environ["HYDRAGNN_PALLAS"] = flag_value
+def _loss_and_grads(env_name, flag_value, model_type="PNA",
+                    equivariance=False):
+    os.environ[env_name] = flag_value
     try:
         batch = jax.tree_util.tree_map(jax.numpy.asarray, _batch())
-        model = create_model_config(_arch())
+        model = create_model_config(_arch(model_type, equivariance))
         variables = init_model_params(model, batch)
 
         def loss_fn(params):
@@ -82,14 +99,171 @@ def _loss_and_grads(flag_value):
         loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
         return float(loss), jax.tree_util.tree_map(np.asarray, grads)
     finally:
-        os.environ.pop("HYDRAGNN_PALLAS", None)
+        os.environ.pop(env_name, None)
 
 
-def pytest_pna_pallas_matches_xla():
-    loss_xla, grads_xla = _loss_and_grads("0")
-    loss_pls, grads_pls = _loss_and_grads("1")
+def _assert_model_parity(env_name, model_type, equivariance=False):
+    loss_xla, grads_xla = _loss_and_grads(env_name, "0", model_type,
+                                          equivariance)
+    loss_pls, grads_pls = _loss_and_grads(env_name, "1", model_type,
+                                          equivariance)
     assert np.isclose(loss_xla, loss_pls, rtol=1e-5), (loss_xla, loss_pls)
     flat_x, _ = jax.tree_util.tree_flatten(grads_xla)
     flat_p, _ = jax.tree_util.tree_flatten(grads_pls)
     for a, b in zip(flat_x, flat_p):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def pytest_pna_pallas_matches_xla():
+    _assert_model_parity("HYDRAGNN_PALLAS", "PNA")
+
+
+# ---- fused message-passing kernels (ops/fused_mp.py) ---------------------
+# the acceptance bar: forward AND gradient parity on the CPU interpreter
+# for the stacks wired through the fused ops
+
+
+def pytest_fused_mp_gin_matches_xla():
+    _assert_model_parity("HYDRAGNN_FUSED_MP", "GIN")
+
+
+def pytest_fused_mp_sage_matches_xla():
+    _assert_model_parity("HYDRAGNN_FUSED_MP", "SAGE")
+
+
+def pytest_fused_mp_schnet_matches_xla():
+    _assert_model_parity("HYDRAGNN_FUSED_MP", "SchNet")
+
+
+def pytest_fused_mp_pna_matches_xla():
+    _assert_model_parity("HYDRAGNN_FUSED_MP", "PNA")
+
+
+def pytest_fused_mp_egnn_matches_xla():
+    _assert_model_parity("HYDRAGNN_FUSED_MP", "EGNN")
+
+
+def pytest_fused_mp_egnn_equivariant_matches_xla():
+    # the deepest fused op: radial + 2-layer edge MLP + tanh-bounded coord
+    # update + packed sender reduction in one kernel
+    _assert_model_parity("HYDRAGNN_FUSED_MP", "EGNN", equivariance=True)
+
+
+# ---- op-level backward parity: pallas custom VJPs vs the reference
+# jax.ops.segment_sum VJP, padded-edge and empty-segment cases included
+
+
+def _grad_case(e=120, n=32, d=8, seed=0, pad_tail=0, empty_from=None):
+    """Data + ids with optional out-of-range padded-edge tail (the kernels'
+    padding contract: ids past num_segments contribute nothing) and an
+    optional empty-segment band [empty_from, n)."""
+    rng = np.random.default_rng(seed)
+    data = jnp.asarray(rng.standard_normal((e, d)), jnp.float32)
+    hi = n if empty_from is None else empty_from
+    ids = rng.integers(0, hi, e)
+    if pad_tail:
+        ids[-pad_tail:] = np.iinfo(np.int32).max  # padded edges
+    return data, jnp.asarray(ids, jnp.int32), n
+
+
+def _sum_losses(ids, n):
+    def ours(x):
+        return jnp.sum(segment_sum_onehot(x, ids, n, True) ** 2)
+
+    def ref(x):
+        return jnp.sum(
+            jax.ops.segment_sum(x, ids, num_segments=n) ** 2
+        )
+
+    return ours, ref
+
+
+def pytest_segment_sum_backward_matches_reference_vjp():
+    data, ids, n = _grad_case()
+    ours, ref = _sum_losses(ids, n)
+    np.testing.assert_allclose(
+        jax.grad(ours)(data), jax.grad(ref)(data), rtol=1e-5, atol=1e-6
+    )
+
+
+def pytest_segment_sum_backward_padded_edges():
+    # out-of-range padded ids: the reference segment_sum DROPS them
+    # (mode-clip semantics differ), so compare against the masked
+    # reference — padded rows must receive exactly zero gradient
+    data, ids, n = _grad_case(e=100, n=24, d=6, pad_tail=17)
+    real = ids < n
+
+    def ours(x):
+        return jnp.sum(segment_sum_onehot(x, ids, n, True) ** 2)
+
+    def ref(x):
+        xm = jnp.where(real[:, None], x, 0.0)
+        safe = jnp.where(real, ids, n)  # route pads to the dropped bin
+        return jnp.sum(
+            jax.ops.segment_sum(xm, safe, num_segments=n + 1)[:n] ** 2
+        )
+
+    g_ours = np.asarray(jax.grad(ours)(data))
+    g_ref = np.asarray(jax.grad(ref)(data))
+    np.testing.assert_allclose(g_ours, g_ref, rtol=1e-5, atol=1e-6)
+    assert np.all(g_ours[-17:] == 0.0), "padded edges must get zero grad"
+
+
+def pytest_segment_sum_backward_empty_segments():
+    data, ids, n = _grad_case(e=80, n=32, d=5, empty_from=20)
+    ours, ref = _sum_losses(ids, n)
+    fwd = segment_sum_onehot(data, ids, n, True)
+    assert np.allclose(np.asarray(fwd[20:]), 0.0)
+    np.testing.assert_allclose(
+        jax.grad(ours)(data), jax.grad(ref)(data), rtol=1e-5, atol=1e-6
+    )
+
+
+def _moments_losses(ids, n):
+    def ours(x):
+        s, c, sq = segment_moments(x, ids, n, True)
+        mean = s / jnp.maximum(c, 1.0)
+        var = jax.nn.relu(sq / jnp.maximum(c, 1.0) - mean**2)
+        return jnp.sum(mean**2) + jnp.sum(jnp.sqrt(var + 1e-5))
+
+    def ref(x):
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        c = jax.ops.segment_sum(
+            jnp.ones(x.shape[0]), ids, num_segments=n
+        ).reshape(-1, 1)
+        sq = jax.ops.segment_sum(x * x, ids, num_segments=n)
+        mean = s / jnp.maximum(c, 1.0)
+        var = jax.nn.relu(sq / jnp.maximum(c, 1.0) - mean**2)
+        return jnp.sum(mean**2) + jnp.sum(jnp.sqrt(var + 1e-5))
+
+    return ours, ref
+
+
+def pytest_segment_moments_backward_matches_reference_vjp():
+    data, ids, n = _grad_case(e=96, n=24, d=8, seed=4)
+    ours, ref = _moments_losses(ids, n)
+    np.testing.assert_allclose(
+        jax.grad(ours)(data), jax.grad(ref)(data), rtol=1e-4, atol=1e-5
+    )
+
+
+def pytest_segment_moments_backward_padded_and_empty():
+    # padded-edge tail AND an empty-segment band in one case: pads get
+    # zero gradient, empty segments produce the reduction identity and a
+    # finite gradient (the sqrt(var+eps) epsilon keeps d/dx finite)
+    data, ids, n = _grad_case(e=90, n=30, d=4, seed=5, pad_tail=13,
+                              empty_from=18)
+    real = np.asarray(ids) < n
+
+    def ours(x):
+        s, c, sq = segment_moments(x, ids, n, True)
+        mean = s / jnp.maximum(c, 1.0)
+        var = jax.nn.relu(sq / jnp.maximum(c, 1.0) - mean**2)
+        return jnp.sum(mean**2) + jnp.sum(jnp.sqrt(var + 1e-5))
+
+    g = np.asarray(jax.grad(ours)(data))
+    assert np.isfinite(g).all()
+    assert np.all(g[~real] == 0.0), "padded edges must get zero grad"
+    s, c, sq = segment_moments(data, ids, n, True)
+    assert np.allclose(np.asarray(s[18:]), 0.0)
+    assert np.allclose(np.asarray(c[18:]), 0.0)
