@@ -7,12 +7,26 @@ comes from environment variables, each trial launches an ``srun`` (or plain
 CLI flags, and the trial metric is the last ``Val Loss: <x>`` printed by the
 training script. On TPU pods the launch prefix targets TPU-VM hosts instead
 of GPUs-per-node, but the orchestration shape is identical.
+
+Early kill (the HPO half of the elastic-training work, docs/resilience.md):
+each trial subprocess writes a heartbeat lease (``HYDRAGNN_HEARTBEAT_FILE``,
+served by ``train/elastic.py`` inside the trial) whose payload carries the
+step/epoch progress counters and the divergence guard's restore count. The
+launcher polls it and KILLS the trial — freeing its node block back to the
+pool for the next trial — when the lease goes stale (hung collective, wedged
+host) or the guard restores exceed the budget (a diverging config is not
+worth its remaining epochs). Every trial outcome lands as a structured
+``hpo_trial`` event in ``<log_dir>/trials.jsonl`` (the run-event schema,
+``obs/events.py``): completed / failed / killed, with the reason — a
+garbled-output trial is marked FAILED there, never silently scored.
 """
 
 import os
 import re
 import subprocess
 import sys
+import threading
+import time
 from typing import Dict, List, Optional
 
 _VAL_LOSS_RE = re.compile(r"Val Loss: ([-+]?(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][-+]?\d+)?)")
@@ -32,6 +46,17 @@ class TrialLauncher:
       ``HPO_NRANKS_PER_TRIAL``  processes per trial (srun -n)
       ``HPO_LOG_DIR``           where per-trial stdout/stderr land
     ``use_srun`` defaults to auto-detection via ``SLURM_JOB_ID``.
+
+    Early-kill knobs (module docstring; both optional, env-defaulted):
+      ``heartbeat_timeout`` / ``HPO_HEARTBEAT_TIMEOUT_S`` — kill a trial
+        whose training PROGRESS (the lease's ``progress_ts``, advanced
+        per optimizer step) is older than this many seconds (applies
+        once the trial has heartbeat at least once — startup/compile
+        time before the first beat or step is covered by ``timeout``
+        alone). Staged/fit-chunk trials tick progress once per whole
+        dispatch: size the timeout above the worst dispatch wall time;
+      ``max_guard_restores`` / ``HPO_MAX_GUARD_RESTORES`` — kill a trial
+        whose divergence guard restored more than this many times.
     """
 
     def __init__(
@@ -41,6 +66,8 @@ class TrialLauncher:
         use_srun: Optional[bool] = None,
         base_env: Optional[Dict[str, str]] = None,
         timeout: Optional[float] = None,
+        heartbeat_timeout: Optional[float] = None,
+        max_guard_restores: Optional[int] = None,
     ):
         self.script = script
         self.log_dir = log_dir or os.environ.get("HPO_LOG_DIR", "./logs/hpo")
@@ -53,7 +80,32 @@ class TrialLauncher:
         )
         self.base_env = dict(base_env or {})
         self.timeout = timeout
+        if heartbeat_timeout is None:
+            env = os.environ.get("HPO_HEARTBEAT_TIMEOUT_S")
+            heartbeat_timeout = float(env) if env else None
+        self.heartbeat_timeout = heartbeat_timeout
+        if max_guard_restores is None:
+            env = os.environ.get("HPO_MAX_GUARD_RESTORES")
+            max_guard_restores = int(env) if env else None
+        self.max_guard_restores = max_guard_restores
         os.makedirs(self.log_dir, exist_ok=True)
+        self._events = None
+        self._events_lock = threading.Lock()
+
+    def _emit_trial(self, trial_id: int, status: str, **fields):
+        """Structured per-trial outcome -> ``<log_dir>/trials.jsonl``
+        (schema-valid ``hpo_trial`` events; the study-side record of WHY
+        each node-block was freed). Lazy: studies that never launch a
+        subprocess never create the file."""
+        from hydragnn_tpu.obs.events import RunEventLog
+
+        with self._events_lock:
+            if self._events is None:
+                self._events = RunEventLog(
+                    os.path.join(self.log_dir, "trials.jsonl")
+                )
+            log = self._events
+        log.emit("hpo_trial", trial=int(trial_id), status=status, **fields)
 
     def build_command(self, trial_id: int, params: Dict[str, object],
                       nodelist: Optional[List[str]] = None) -> List[str]:
@@ -73,35 +125,125 @@ class TrialLauncher:
         cmd.append(f"--log_name_suffix=trial_{trial_id}")
         return cmd
 
+    def _kill_reason(self, hb_path: str, started: float) -> Optional[str]:
+        """Early-kill decision for one poll tick (None = keep running)."""
+        if self.heartbeat_timeout is None and self.max_guard_restores is None:
+            return None
+        # the same tolerant reader the lease's writer side uses
+        from hydragnn_tpu.train.elastic import _read_json
+
+        hb = _read_json(hb_path)
+        if hb is None:
+            return None  # no lease yet: startup/compile, timeout covers it
+        if (
+            self.max_guard_restores is not None
+            and int(hb.get("guard_restores", 0)) > self.max_guard_restores
+        ):
+            return "divergence"
+        # staleness reads the TRAINING-PROGRESS timestamp when the trial
+        # reports one (elastic note_step/note_epoch): the lease daemon
+        # keeps stamping `ts` even while the training thread is wedged in
+        # a hung collective — `ts` alone would never detect exactly the
+        # hang this kill exists for. Before the first step (compile,
+        # data load) only `ts` exists, so a beating-but-not-yet-stepping
+        # trial is not killed.
+        progress = hb.get("progress_ts") or hb.get("ts", started)
+        if (
+            self.heartbeat_timeout is not None
+            and time.time() - float(progress) > self.heartbeat_timeout
+        ):
+            return "heartbeat_timeout"
+        return None
+
     def run(self, trial, nodelist: Optional[List[str]] = None) -> float:
         """Launch the trial subprocess; returns val loss (inf on failure).
 
         The reference returns the string "F" for a failed trial and lets
-        DeepHyper discard it; here failures map to +inf so a minimize-study
-        never selects them.
+        DeepHyper discard it; here every non-completed outcome maps to
+        +inf (``optimize_concurrent`` tells those as *failed* so the
+        sampler never learns from them) AND is recorded as a structured
+        ``hpo_trial`` event with the reason. A trial that exits 0 but
+        prints no parseable ``Val Loss:`` is a FAILURE (garbled output),
+        not a score.
         """
         cmd = self.build_command(trial.number, trial.params, nodelist)
-        env = {**os.environ, **self.base_env}
+        hb_path = os.path.join(
+            self.log_dir, f"heartbeat_{trial.number}.json"
+        )
+        # a stale lease from a previous study run in the same log_dir
+        # (trial numbering restarts at 0) would early-kill the fresh
+        # trial before it ever heartbeats — the lease starts clean
+        try:
+            os.remove(hb_path)
+        except OSError:
+            pass
+        env = {
+            **os.environ,
+            **self.base_env,
+            # the trial-side runtime (train/elastic.py) serves this lease
+            "HYDRAGNN_HEARTBEAT_FILE": hb_path,
+        }
         out_path = os.path.join(self.log_dir, f"output_{trial.number}.txt")
+        started = time.time()
+        nodes = list(nodelist or [])
         with open(out_path, "w") as out:
+            proc = subprocess.Popen(
+                cmd, stdout=out, stderr=subprocess.STDOUT, env=env
+            )
+            killed_reason = None
             try:
-                proc = subprocess.run(
-                    cmd,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    env=env,
-                    timeout=self.timeout,
-                )
-            except subprocess.TimeoutExpired as e:
-                out.write((e.output or b"").decode(errors="replace"))
-                out.write("\n[launcher] trial timed out\n")
-                return float("inf")
-            text = proc.stdout.decode(errors="replace")
-            out.write(text)
-        if proc.returncode != 0:
+                while True:
+                    try:
+                        proc.wait(timeout=0.25)
+                        break
+                    except subprocess.TimeoutExpired:
+                        pass
+                    elapsed = time.time() - started
+                    if self.timeout is not None and elapsed > self.timeout:
+                        killed_reason = "timeout"
+                    else:
+                        killed_reason = self._kill_reason(hb_path, started)
+                    if killed_reason is not None:
+                        proc.kill()
+                        proc.wait(timeout=30)
+                        break
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+        if killed_reason is not None:
+            self._emit_trial(
+                trial.number, "killed", reason=killed_reason,
+                wall_s=round(time.time() - started, 3), nodes=nodes,
+            )
             return float("inf")
+        if proc.returncode != 0:
+            self._emit_trial(
+                trial.number, "failed",
+                reason=f"exit_{proc.returncode}", nodes=nodes,
+            )
+            return float("inf")
+        try:
+            with open(out_path) as f:
+                text = f.read()
+        except OSError:
+            text = ""
         val = parse_val_loss(text)
-        return float("inf") if val is None else val
+        if val is None:
+            # exit 0 with no parseable metric: the reference would feed
+            # whatever garbage it matched into the sampler — here it is
+            # an explicit failure with its own event, and the caller's
+            # +inf contract releases the node block
+            self._emit_trial(
+                trial.number, "failed", reason="garbled_output",
+                nodes=nodes,
+            )
+            return float("inf")
+        self._emit_trial(
+            trial.number, "completed", val_loss=float(val),
+            wall_s=round(time.time() - started, 3), nodes=nodes,
+        )
+        return val
 
 
 class NodePool:

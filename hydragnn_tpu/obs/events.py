@@ -66,6 +66,18 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # bucket layout uses and why — source is env|cache|measured (optional
     # timings_ms carries the measured candidate times)
     "agg_choice": ("bucket", "choice", "source"),
+    # elastic training (train/elastic.py): a peer's heartbeat lease
+    # expired — emitted by the detecting watchdog just before it breaks
+    # the survivors out of the hung collective
+    "host_lost": ("host",),
+    # elastic training: the world re-formed at a new size and took its
+    # first optimizer step; recovery_s spans loss detection -> first step
+    # (teardown + re-bootstrap + checkpoint restore + recompile)
+    "world_resize": ("old_world", "new_world", "gen", "recovery_s"),
+    # HPO trial lifecycle (hpo/launcher.py trials.jsonl): status is
+    # completed|failed|killed, reason names the failure/kill cause
+    # (garbled_output, heartbeat_timeout, divergence, timeout, exit_<rc>)
+    "hpo_trial": ("trial", "status"),
 }
 
 _ENVELOPE = ("event", "ts", "seq")

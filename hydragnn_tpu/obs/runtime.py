@@ -135,6 +135,13 @@ class TrainingMetrics:
             "Seconds since the training loop last reported progress",
         )
         r.counter("stalls_total", "Steps exceeding the stall threshold")
+        # elastic training (train/elastic.py): current world size and the
+        # last re-mesh's detection->first-step recovery time
+        r.gauge("world_size", "Processes in the current training world")
+        r.gauge(
+            "last_recovery_seconds",
+            "Detection-to-first-step time of the last world resize",
+        )
         # compiled-program accounting (obs/introspect.py): one label set
         # per (program, shape-signature) bucket
         r.labeled_gauge(
@@ -473,6 +480,7 @@ class RunTelemetry:
         import jax
 
         devices = jax.devices()
+        self.metrics.registry.set("world_size", float(jax.process_count()))
         self.emit(
             "run_manifest",
             schema_version=SCHEMA_VERSION,
@@ -639,6 +647,26 @@ def checkpoint_restored(name: str, source: str):
     if t is None:
         return
     t.emit("checkpoint_restored", name=name, source=source)
+
+
+def world_resized(old_world: int, new_world: int, gen: int,
+                  recovery_s: float, **fields):
+    """Elastic re-mesh completed (train/elastic.py): event + gauges. The
+    recovery time spans loss DETECTION to the first optimizer step at the
+    new world size — everything an operator would otherwise do by hand."""
+    t = _active
+    if t is None:
+        return
+    t.metrics.registry.set("world_size", float(new_world))
+    t.metrics.registry.set("last_recovery_seconds", float(recovery_s))
+    t.emit(
+        "world_resize",
+        old_world=int(old_world),
+        new_world=int(new_world),
+        gen=int(gen),
+        recovery_s=float(recovery_s),
+        **fields,
+    )
 
 
 # ---- run construction ----------------------------------------------------

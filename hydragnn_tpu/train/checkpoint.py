@@ -35,7 +35,9 @@ import glob
 import os
 import re
 import struct
-from typing import Any, Dict, List, Optional
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -123,7 +125,9 @@ def _retain_rolling(out_dir: str, name: str, payload: bytes, keep: int):
     tmp = roll + ".tmp"
     with open(tmp, "wb") as f:
         f.write(payload)
-    os.replace(tmp, roll)
+        f.flush()
+        os.fsync(f.fileno())  # a crash right here is WHEN the fallback
+    os.replace(tmp, roll)  # copies get read — they must be durable too
     for old in _rolling_paths(out_dir, name)[keep:]:
         try:
             os.remove(old)
@@ -137,13 +141,21 @@ def save_model(
     path: str = "./logs/",
     train_meta: Optional[Dict[str, Any]] = None,
     keep_last: Optional[int] = None,
+    writer: Optional["AsyncCheckpointWriter"] = None,
 ):
     """Write the checkpoint atomically; optionally embed training-loop
     state (``train_meta``) and retain a rolling history of the last
-    ``keep_last`` saves (see module docstring)."""
+    ``keep_last`` saves (see module docstring).
+
+    With a ``writer``, only the device->host snapshot (consolidation
+    collectives + ``device_get``) stays on the calling thread — the step
+    boundary pays for the copy and nothing else; serialize + CRC + fsync
+    + rename run on the writer's background thread (see
+    :class:`AsyncCheckpointWriter`)."""
     from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
     _, rank = get_comm_size_and_rank()
+    t0 = time.perf_counter()
     # consolidation involves resharding COLLECTIVES — every process must
     # participate, only rank 0 writes the file
     sd = (
@@ -153,16 +165,55 @@ def save_model(
     )
     if rank != 0:
         return
-    out_dir = os.path.join(path, name)
-    os.makedirs(out_dir, exist_ok=True)
-    # to_state_dict flattens custom containers (optax states) to plain dicts
+    # to_state_dict flattens custom containers (optax states) to plain
+    # dicts. Async snapshots need an OWNED host copy of every leaf:
+    # np.asarray of a jax.Array can be a zero-copy view (CPU backend),
+    # and the training loop donates the state buffers into the very next
+    # step — serializing a view of a donated buffer would produce a
+    # CRC-valid torn checkpoint. The copy IS the async path's documented
+    # critical-path cost; the sync path keeps the cheap view (it
+    # serializes before returning, nothing can donate underneath it).
     sd = serialization.to_state_dict(sd)
     if train_meta is not None:
         sd = dict(sd)
         sd[TRAIN_META_KEY] = serialization.to_state_dict(train_meta)
-    blob = serialization.msgpack_serialize(
-        jax.tree_util.tree_map(np.asarray, sd)
+    to_host = (
+        (lambda a: np.array(a, copy=True)) if writer is not None
+        else np.asarray
     )
+    sd = jax.tree_util.tree_map(to_host, sd)
+    snapshot_s = time.perf_counter() - t0
+    keep = _resolve_keep_last(keep_last)
+    resumable = train_meta is not None
+    if writer is None:
+        _serialize_and_write(sd, path, name, keep, resumable, snapshot_s)
+        return
+    queued_ts = time.perf_counter()
+    writer.submit(
+        lambda: _serialize_and_write(
+            sd, path, name, keep, resumable, snapshot_s,
+            queued_ts=queued_ts,
+        )
+    )
+
+
+def _serialize_and_write(
+    sd: Dict[str, Any],
+    path: str,
+    name: str,
+    keep: int,
+    resumable: bool,
+    snapshot_s: float,
+    queued_ts: Optional[float] = None,
+):
+    """msgpack + CRC header + tmp/fsync/rename (+ rolling retention) for
+    an already-host-resident state dict. Runs inline for sync saves, on
+    the background thread for async ones; the ``checkpoint_saved`` event
+    carries the overlap split either way."""
+    t0 = time.perf_counter()
+    out_dir = os.path.join(path, name)
+    os.makedirs(out_dir, exist_ok=True)
+    blob = serialization.msgpack_serialize(sd)
     header = _MAGIC + struct.pack(
         "<II", _VERSION, binascii.crc32(blob) & 0xFFFFFFFF
     )
@@ -173,7 +224,6 @@ def save_model(
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, final)  # atomic: never a half-written checkpoint
-    keep = _resolve_keep_last(keep_last)
     if keep > 0:
         _retain_rolling(out_dir, name, header + blob, keep)
     from hydragnn_tpu.obs import runtime as obs
@@ -181,10 +231,181 @@ def save_model(
     obs.checkpoint_saved(
         name,
         kind="best" if name.endswith("-best") else "primary",
-        resumable=train_meta is not None,
+        resumable=resumable,
         bytes=len(header) + len(blob),
+        snapshot_s=round(snapshot_s, 6),
+        write_s=round(time.perf_counter() - t0, 6),
+        **(
+            {}
+            if queued_ts is None
+            else {
+                "async": True,
+                # time the save spent waiting in the bounded queue before
+                # the writer thread picked it up (backpressure visibility)
+                "queued_s": round(t0 - queued_ts, 6),
+            }
+        ),
     )
     faults.corrupt_checkpoint(final)
+
+
+class AsyncCheckpointWriter:
+    """Bounded background writer: checkpoint serialization and I/O off
+    the training critical path.
+
+    The contract (``docs/resilience.md`` "Async checkpointing"):
+
+    - :meth:`submit` enqueues one already-snapshotted write; with
+      ``max_pending`` saves already in flight it BLOCKS (backpressure —
+      a slow filesystem must throttle the run, not buy unbounded host
+      memory buffering stale snapshots);
+    - writes execute strictly in submission order on one thread, so the
+      rolling-retention sequence numbers stay monotonic;
+    - a failed background write is LOUD: the exception re-raises on the
+      next :meth:`submit` or :meth:`drain` — durability silently lost is
+      the one failure mode this subsystem exists to prevent;
+    - :meth:`drain` is the shutdown/preemption barrier: it returns only
+      when every queued write has been fsync'd + renamed (the elastic
+      watchdog drains before hard-exiting a survivor, and the epoch
+      driver drains at end of run). A kill mid-write costs nothing —
+      the write goes through the same tmp+fsync+rename protocol, so the
+      previous checkpoint (and its CRC-verified rolling fallbacks) stay
+      intact.
+    """
+
+    def __init__(self, max_pending: int = 2):
+        import queue
+
+        self.max_pending = max(int(max_pending), 1)
+        self._q = queue.Queue(maxsize=self.max_pending)
+        self._thread = threading.Thread(
+            target=self._run, name="hydragnn-async-ckpt", daemon=True
+        )
+        self._state_lock = threading.Lock()  # _started/_closed/_pending/_errors
+        self._started = False
+        self._closed = False
+        self._pending = 0
+        self._errors: List[BaseException] = []
+
+    def submit(self, job: Callable[[], None]):
+        # surface any earlier background failure BEFORE booking this job:
+        # raising after the increment would leak a pending count no worker
+        # ever decrements, wedging every later drain()
+        self._raise_pending()
+        # the real bound is the PENDING count, not the queue: a job the
+        # worker already popped still holds its (multi-GB) host snapshot,
+        # so queue.maxsize alone would admit max_pending+1 snapshots
+        while True:
+            with self._state_lock:
+                if self._closed:
+                    raise RuntimeError("AsyncCheckpointWriter is closed")
+                if self._pending < self.max_pending:
+                    if not self._started:
+                        self._started = True
+                        self._thread.start()
+                    self._pending += 1
+                    break
+            time.sleep(0.005)
+        self._q.put(job)
+
+    def _run(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                job()
+            except BaseException as e:  # surfaced on next submit/drain
+                with self._state_lock:
+                    self._errors.append(e)
+            finally:
+                with self._state_lock:
+                    self._pending -= 1
+
+    def _raise_pending(self):
+        with self._state_lock:
+            if not self._errors:
+                return
+            err = self._errors.pop(0)
+        raise RuntimeError(
+            "background checkpoint write failed — the run has NO newer "
+            "durable checkpoint than the last successful save"
+        ) from err
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted write completed (or ``timeout``
+        seconds elapsed; returns False on timeout). Raises if any write
+        failed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._state_lock:
+                pending = self._pending
+            if pending == 0:
+                self._raise_pending()
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+
+    def close(self, timeout: float = 60.0):
+        """Drain, stop the thread, refuse further submits. Bounded: if the
+        drain times out (a write wedged on a hung filesystem), the daemon
+        worker is abandoned rather than blocked on — close() must return
+        within ~timeout, not trade one hang for another."""
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+            started = self._started
+        if started and self.drain(timeout=timeout):
+            self._q.put(None)  # queue is empty post-drain: cannot block
+            self._thread.join(timeout=timeout)
+        self._raise_pending()
+
+
+_ASYNC_WRITER: Optional[AsyncCheckpointWriter] = None
+_ASYNC_WRITER_LOCK = threading.Lock()
+
+
+def async_checkpoint_enabled(training_config: dict) -> bool:
+    """``HYDRAGNN_ASYNC_CKPT`` env > ``Training.async_checkpoint`` config;
+    default off — async durability semantics (a just-"saved" checkpoint
+    becomes durable only once the writer catches up) are opt-in."""
+    from hydragnn_tpu.train.common import _env_flag
+
+    return _env_flag(
+        "HYDRAGNN_ASYNC_CKPT", training_config, "async_checkpoint"
+    )
+
+
+def get_async_writer() -> AsyncCheckpointWriter:
+    """Process-wide writer singleton (one background thread total — saves
+    from the epoch driver and the wall-clock path share the ordering)."""
+    global _ASYNC_WRITER
+    with _ASYNC_WRITER_LOCK:
+        if _ASYNC_WRITER is None:
+            _ASYNC_WRITER = AsyncCheckpointWriter(
+                max_pending=int(os.getenv("HYDRAGNN_ASYNC_CKPT_PENDING", "2"))
+            )
+        return _ASYNC_WRITER
+
+
+def resolve_async_writer(
+    training_config: dict,
+) -> Optional[AsyncCheckpointWriter]:
+    if not async_checkpoint_enabled(training_config):
+        return None
+    return get_async_writer()
+
+
+def drain_async(timeout: Optional[float] = None) -> bool:
+    """Barrier over the process-wide writer (no-op True when async
+    checkpointing never started)."""
+    with _ASYNC_WRITER_LOCK:
+        writer = _ASYNC_WRITER
+    if writer is None:
+        return True
+    return writer.drain(timeout=timeout)
 
 
 def _parse_checkpoint_bytes(raw: bytes, fname: str) -> Dict[str, Any]:

@@ -154,6 +154,13 @@ def run_training_impl(config):
     timer.start()
     enable_compile_cache()
     setup_distributed()
+    # elastic/heartbeat runtime (train/elastic.py): started right after
+    # the distributed bootstrap so the lease exists before the long
+    # data-load/compile phases — None unless HYDRAGNN_ELASTIC_DIR or
+    # HYDRAGNN_HEARTBEAT_FILE opts in
+    from hydragnn_tpu.train import elastic
+
+    elastic_rt = elastic.maybe_elastic()
     tr.initialize()
     verbosity = config.get("Verbosity", {}).get("level", 0)
 
@@ -241,9 +248,19 @@ def run_training_impl(config):
         # post-init span is covered: a failure in the final save / tracer
         # dump must not leave /healthz reporting ok with no run_end.
         try:
+            try:
+                # pending async checkpoint writes are the run's last
+                # durable progress — land them even on the failure path
+                from hydragnn_tpu.train.checkpoint import drain_async
+
+                drain_async(timeout=60.0)
+            except Exception:
+                pass  # the original failure is the one to surface
             if writer is not None:
                 writer.close()
         finally:
+            if elastic_rt is not None:
+                elastic_rt.stop()
             obs.deactivate(status="failed")
         raise
     try:
@@ -251,6 +268,8 @@ def run_training_impl(config):
             writer.close()
     finally:
         # run_end must land even if a scalar backend fails to close
+        if elastic_rt is not None:
+            elastic_rt.stop()
         obs.deactivate(status="complete")
     return state
 

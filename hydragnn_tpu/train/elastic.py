@@ -1,0 +1,821 @@
+"""Elastic self-healing multi-host training: survive preemption and re-mesh.
+
+The resilience story so far (``docs/resilience.md``) assumes a FIXED world:
+a preempted run resumes only when an operator relaunches it at the same
+size. This module removes the operator: each host runs an
+:class:`ElasticAgent` that supervises its training worker process, hosts
+exchange liveness through a shared coordination directory (the natural
+primitive on the HPC filesystems the reference targets — no extra control
+plane), and on host loss the survivors tear down, re-run the
+``jax.distributed`` bootstrap at the new world size, and continue from the
+rolling checkpoint.
+
+Mechanics, one failure end to end:
+
+1. every worker writes a **heartbeat lease** file
+   (``<dir>/workers/host-<k>.json``) from a background thread; the payload
+   carries rank/step/epoch/guard counters (fed by the cheap
+   :func:`note_step`/:func:`note_epoch` hooks in the training loop);
+2. every worker runs a **peer watchdog** thread: a peer whose lease is
+   stale past ``HYDRAGNN_ELASTIC_LEASE_S`` (or already tombstoned) is
+   declared lost. The watchdog lives OFF the training thread on purpose —
+   it fires even while the trainer is wedged inside a collective that
+   hangs because the peer died (the collective-timeout role; XLA's own
+   timeouts are minutes, the lease is seconds);
+3. the detecting watchdog writes a **tombstone**
+   (``<dir>/dead/host-<k>.json``), emits a ``host_lost`` event, drains any
+   pending async checkpoint writes (the shutdown barrier — see
+   ``checkpoint.AsyncCheckpointWriter``), and hard-exits the worker with
+   :data:`EXIT_RESHAPE`;
+4. each surviving **agent** sees its worker exit, reads the coordination
+   dir, and the lowest surviving host (the leader) publishes the next
+   **generation** file: new member list, new coordinator address, the
+   detection timestamp. A ``jax.distributed`` world cannot change size
+   in-process (the PJRT backend is immutable once initialized), so the
+   agent respawns the worker — the fresh process bootstraps at the new
+   world size, per-process batch shards rebalance automatically (the
+   loaders shard by ``process_count``/``process_index``) and per-rank
+   PRNG folds derive from the new rank layout;
+5. the respawned worker resumes from the rolling checkpoint and, on its
+   first completed optimizer step, emits a ``world_resize`` event whose
+   ``recovery_s`` spans tombstone-detection to first-step — the whole
+   re-mesh (teardown + bootstrap + restore + recompile) measured as one
+   number, mirrored to the ``world_size`` / ``last_recovery_seconds``
+   gauges.
+
+A host that was *declared* dead but is merely slow (partitioned, hung
+device) finds its own tombstone and exits with :data:`EXIT_EVICTED`
+instead of split-braining the run.
+
+Env knobs (set by the agent for its worker; the ``HYDRAGNN_ELASTIC_DIR``
+presence is what turns the worker-side runtime on):
+
+- ``HYDRAGNN_ELASTIC_DIR``           shared coordination directory
+- ``HYDRAGNN_ELASTIC_HOST``          this host's stable id (int)
+- ``HYDRAGNN_ELASTIC_GEN``           current world generation
+- ``HYDRAGNN_ELASTIC_MEMBERS``       csv of member host ids, rank order
+- ``HYDRAGNN_ELASTIC_HEARTBEAT_S``   heartbeat interval (default 1.0)
+- ``HYDRAGNN_ELASTIC_LEASE_S``       lease timeout (default 6.0)
+- ``HYDRAGNN_ELASTIC_DETECT_TS``     loss-detection ts (gen > 0)
+- ``HYDRAGNN_ELASTIC_PREV_WORLD``    world size before the resize
+
+``HYDRAGNN_HEARTBEAT_FILE`` is the single-file lightweight mode: no
+agent, no watchdog — just the progress heartbeat, which the HPO launcher
+uses as its hang/divergence early-kill signal (``hpo/launcher.py``).
+
+CLI (one agent per host)::
+
+    python -m hydragnn_tpu.train.elastic --dir /shared/run1 --host 0 \\
+        --hosts 4 --base-port 12360 -- python -m hydragnn_tpu.run_training cfg.json
+"""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from hydragnn_tpu.obs import runtime as obs
+
+# worker exit codes the agent keys on (distinct from faults.KILL_EXIT_CODE
+# = 113, the injected-preemption code)
+EXIT_RESHAPE = 117  # a peer was lost; respawn me at the new world size
+EXIT_EVICTED = 115  # I was declared dead by the others; do not respawn
+EXIT_GEN_TIMEOUT = 116  # no next-generation file appeared in time
+
+_GEN_RE = re.compile(r"gen-(\d+)\.json$")
+
+DEFAULT_HEARTBEAT_S = 1.0
+DEFAULT_LEASE_S = 6.0
+
+
+# ---- progress hooks (no-op cheap when no heartbeat is live) ---------------
+
+# written by the training loop, read by the heartbeat thread. Plain dict
+# stores of ints/floats (GIL-atomic); the heartbeat tolerates a torn
+# multi-field view — it is a liveness signal, not a transaction.
+_progress = {"step": 0, "epoch": 0, "guard_restores": 0, "progress_ts": 0.0}
+_beating = False  # one global read gates every hook (the faults.py pattern)
+_runtime: Optional["ElasticRuntime"] = None
+
+
+def note_step(step: Optional[int] = None):
+    """The trainer completed one optimizer step (called per step from the
+    epoch loop; one global read and return when nothing heartbeats)."""
+    if not _beating:
+        return
+    if step is not None:
+        _progress["step"] = int(step)
+    _progress["progress_ts"] = time.time()
+    rt = _runtime
+    if rt is not None and rt._pending_resize:
+        rt.on_first_step()
+
+
+def note_epoch(epoch: int):
+    if not _beating:
+        return
+    _progress["epoch"] = int(epoch)
+    _progress["progress_ts"] = time.time()
+
+
+def note_guard_restore():
+    """The divergence guard restored last-good state — the HPO launcher
+    reads this counter out of the heartbeat as its early-kill signal."""
+    if not _beating:
+        return
+    _progress["guard_restores"] = _progress["guard_restores"] + 1
+
+
+# ---- coordination-directory primitives ------------------------------------
+
+
+def _write_json(path: str, obj: Dict):
+    """Atomic JSON write (tmp + rename): a reader never sees a torn file."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # mid-rename/missing — the caller polls again
+
+
+def _hb_path(coord_dir: str, kind: str, host: int) -> str:
+    return os.path.join(coord_dir, f"{kind}s", f"host-{int(host)}.json")
+
+
+def _tomb_path(coord_dir: str, host: int) -> str:
+    return os.path.join(coord_dir, "dead", f"host-{int(host)}.json")
+
+
+def _gen_path(coord_dir: str, gen: int) -> str:
+    return os.path.join(coord_dir, "gens", f"gen-{int(gen):06d}.json")
+
+
+def write_tombstone(coord_dir: str, host: int, reason: str, by: int):
+    """Idempotent: the FIRST detection timestamp is the one recoveries are
+    measured from, so an existing tombstone is never overwritten."""
+    path = _tomb_path(coord_dir, host)
+    if os.path.exists(path):
+        return
+    _write_json(
+        path,
+        {"host": int(host), "ts": time.time(), "reason": reason,
+         "by": int(by)},
+    )
+
+
+def read_tombstone(coord_dir: str, host: int) -> Optional[Dict]:
+    return _read_json(_tomb_path(coord_dir, host))
+
+
+def heartbeat_age(coord_dir: str, kind: str, host: int,
+                  now: Optional[float] = None) -> Optional[float]:
+    """Seconds since ``host`` last heartbeat as ``kind``; None = never."""
+    hb = _read_json(_hb_path(coord_dir, kind, host))
+    if hb is None or "ts" not in hb:
+        return None
+    return (now if now is not None else time.time()) - float(hb["ts"])
+
+
+def dead_members(
+    coord_dir: str,
+    members: List[int],
+    lease_s: float,
+    kind: str = "agent",
+    now: Optional[float] = None,
+    current_gen: Optional[int] = None,
+) -> Dict[int, float]:
+    """``{host: detect_ts}`` for every member that is tombstoned or whose
+    ``kind`` heartbeat lease expired. A member that never heartbeat at all
+    is NOT dead — it may still be bootstrapping; the lease only starts
+    ticking once a first heartbeat exists. With ``current_gen``, a lease
+    from an EARLIER generation is treated the same way: worker leases
+    persist at one path across re-meshes, so a respawned peer that has
+    not yet written its first new-gen lease must read as bootstrapping,
+    not as stale (its old lease is necessarily older than the downtime)."""
+    now = time.time() if now is None else now
+    dead: Dict[int, float] = {}
+    for m in members:
+        tomb = read_tombstone(coord_dir, m)
+        if tomb is not None:
+            dead[m] = float(tomb.get("ts", now))
+            continue
+        hb = _read_json(_hb_path(coord_dir, kind, m))
+        if hb is None or "ts" not in hb:
+            continue  # never heartbeat: still bootstrapping, not dead
+        if (
+            current_gen is not None
+            and int(hb.get("gen", current_gen)) < current_gen
+        ):
+            continue  # pre-resize lease: the new-gen worker is booting
+        if hb.get("done"):
+            # a CLEANLY finished member stops heartbeating forever — end
+            # of run, not a death. Without this, rank 0's post-training
+            # tail (final checkpoint, reports) would outlive the other
+            # ranks' leases and a bogus host_lost would kill it mid-write.
+            continue
+        if now - float(hb["ts"]) > lease_s:
+            dead[m] = now
+    return dead
+
+
+def latest_gen(coord_dir: str):
+    """(gen, payload) of the newest readable generation file, or (None,
+    None) on a fresh directory."""
+    best, payload = None, None
+    for p in glob.glob(os.path.join(coord_dir, "gens", "gen-*.json")):
+        m = _GEN_RE.search(p)
+        if not m:
+            continue
+        g = int(m.group(1))
+        if best is None or g > best:
+            data = _read_json(p)
+            if data is not None:
+                best, payload = g, data
+    return best, payload
+
+
+# ---- heartbeat + watchdog threads -----------------------------------------
+
+
+class Heartbeat:
+    """Background lease writer: one atomic JSON write per interval.
+
+    The thread is daemon (a crashed owner must not hang interpreter
+    exit) with an explicit lifecycle: :meth:`stop` joins it bounded."""
+
+    def __init__(self, path: str, payload: Callable[[], Dict],
+                 interval_s: float):
+        self.path = path
+        self._payload = payload
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="hydragnn-heartbeat", daemon=True
+        )
+
+    def start(self) -> "Heartbeat":
+        self._write()  # the lease exists before start() returns
+        self._thread.start()
+        return self
+
+    def _write(self):
+        try:
+            rec = dict(self._payload())
+            rec["ts"] = time.time()
+            rec["pid"] = os.getpid()
+            _write_json(self.path, rec)
+        except OSError:
+            pass  # a full/flaky shared FS must not kill the run
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self._write()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=max(self.interval_s * 4, 5.0))
+        # final flush: the file must end on the TRUE last progress (a run
+        # whose tail beat the next tick would otherwise read one interval
+        # stale forever — e.g. an HPO trial's final step count)
+        self._write()
+
+
+class PeerWatchdog:
+    """Declares peers lost when their worker lease expires.
+
+    Runs off the training thread so a collective hung on a dead peer
+    still gets detected and broken (the default ``on_loss`` hard-exits
+    with :data:`EXIT_RESHAPE` after writing tombstones and draining
+    pending async checkpoint writes). Also notices this host's OWN
+    tombstone — a partitioned straggler must evict itself rather than
+    rejoin a world that already re-formed without it."""
+
+    def __init__(
+        self,
+        coord_dir: str,
+        host: int,
+        members: List[int],
+        lease_s: float,
+        interval_s: float,
+        on_loss: Optional[Callable[[Dict[int, float]], None]] = None,
+        on_evicted: Optional[Callable[[], None]] = None,
+        gen: int = 0,
+    ):
+        self.coord_dir = coord_dir
+        self.host = int(host)
+        self.peers = [int(m) for m in members if int(m) != int(host)]
+        self.lease_s = float(lease_s)
+        self.interval_s = float(interval_s)
+        self.gen = int(gen)
+        self._on_loss = on_loss or self._default_on_loss
+        self._on_evicted = on_evicted or self._default_on_evicted
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="hydragnn-peer-watchdog", daemon=True
+        )
+
+    def start(self) -> "PeerWatchdog":
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            if read_tombstone(self.coord_dir, self.host) is not None:
+                self._on_evicted()
+                return
+            dead = dead_members(
+                self.coord_dir, self.peers, self.lease_s, kind="worker",
+                current_gen=self.gen,
+            )
+            if dead:
+                self._on_loss(dead)
+                return
+
+    def _default_on_loss(self, dead: Dict[int, float]):
+        for h, ts in sorted(dead.items()):
+            write_tombstone(
+                self.coord_dir, h, reason="lease_expired", by=self.host
+            )
+            age = heartbeat_age(self.coord_dir, "worker", h)
+            obs.emit(
+                "host_lost",
+                host=int(h),
+                stale_s=None if age is None else round(float(age), 3),
+                by=self.host,
+            )
+        # the preemption-path drain barrier: pending async checkpoint
+        # writes land before the process dies, so the re-formed world
+        # resumes from the newest completed save, not a lost queue entry
+        from hydragnn_tpu.train import checkpoint as ck
+
+        ck.drain_async(timeout=30.0)
+        os._exit(EXIT_RESHAPE)
+
+    def _default_on_evicted(self):
+        os._exit(EXIT_EVICTED)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=max(self.interval_s * 4, 5.0))
+
+
+# ---- worker-side runtime ---------------------------------------------------
+
+
+class ElasticRuntime:
+    """Everything the TRAINING process contributes to elasticity: its own
+    heartbeat lease, the peer watchdog, and the ``world_resize`` recovery
+    event on the first step after a re-mesh."""
+
+    def __init__(
+        self,
+        coord_dir: str,
+        host: int,
+        gen: int,
+        members: List[int],
+        heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+        lease_s: float = DEFAULT_LEASE_S,
+        detect_ts: Optional[float] = None,
+        prev_world: Optional[int] = None,
+        lost_hosts: Optional[List[int]] = None,
+    ):
+        self.coord_dir = coord_dir
+        self.host = int(host)
+        self.gen = int(gen)
+        self.members = [int(m) for m in members]
+        self.rank = self.members.index(self.host)
+        self.world = len(self.members)
+        self._detect_ts = detect_ts
+        self._prev_world = prev_world
+        self._lost_hosts = list(lost_hosts or [])
+        self._done = False
+        self._pending_resize = bool(
+            self.gen > 0 and detect_ts is not None and prev_world
+        )
+        self.heartbeat = Heartbeat(
+            _hb_path(coord_dir, "worker", self.host),
+            self._payload,
+            heartbeat_s,
+        )
+        self.watchdog = (
+            PeerWatchdog(
+                coord_dir, self.host, self.members, lease_s,
+                interval_s=min(heartbeat_s, lease_s / 3.0),
+                gen=self.gen,
+            )
+            if self.world > 1
+            else None
+        )
+
+    def _payload(self) -> Dict:
+        p = dict(_progress)
+        p.update(host=self.host, rank=self.rank, gen=self.gen,
+                 world=self.world, done=self._done)
+        return p
+
+    def start(self) -> "ElasticRuntime":
+        global _beating, _runtime
+        _beating = True
+        _runtime = self
+        self.heartbeat.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
+        return self
+
+    def on_first_step(self):
+        """First completed optimizer step of a post-resize generation:
+        the recovery is over — detection -> teardown -> re-bootstrap ->
+        restore -> recompile -> first step, measured as one number."""
+        if not self._pending_resize:
+            return
+        self._pending_resize = False
+        recovery = max(time.time() - float(self._detect_ts), 0.0)
+        # the new generation's rank 0 records WHO was lost: when the lost
+        # host was the PREVIOUS rank 0, the detecting survivors had no
+        # active telemetry (obs is rank-0-only) and their host_lost
+        # emits were dropped — this resize-side record is the one that
+        # always lands (duplicates with the detection-side record when
+        # old rank 0 survived are legal: two observers of one loss)
+        for h in self._lost_hosts:
+            tomb = read_tombstone(self.coord_dir, h)
+            obs.emit(
+                "host_lost",
+                host=int(h),
+                by=self.host,
+                source="resize",
+                reason=None if tomb is None else tomb.get("reason"),
+            )
+        obs.world_resized(
+            old_world=int(self._prev_world),
+            new_world=self.world,
+            gen=self.gen,
+            recovery_s=round(recovery, 3),
+        )
+
+    def stop(self):
+        global _beating, _runtime
+        # the final lease write carries done=True: peers whose watchdogs
+        # outlive us (rank 0's post-training tail) must read "finished",
+        # never "lost" — only an UNMARKED stale lease means death
+        self._done = True
+        self.heartbeat._write()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.heartbeat.stop()
+        if _runtime is self:
+            _runtime = None
+            _beating = False
+
+
+class FileHeartbeatRuntime:
+    """``HYDRAGNN_HEARTBEAT_FILE`` mode: just the progress lease, written
+    to one caller-chosen path — the HPO launcher's per-trial liveness +
+    divergence signal."""
+
+    def __init__(self, path: str, heartbeat_s: float = DEFAULT_HEARTBEAT_S):
+        self.heartbeat = Heartbeat(path, lambda: dict(_progress), heartbeat_s)
+
+    def start(self) -> "FileHeartbeatRuntime":
+        global _beating
+        _beating = True
+        self.heartbeat.start()
+        return self
+
+    def stop(self):
+        global _beating
+        self.heartbeat.stop()
+        _beating = False
+
+
+def maybe_elastic():
+    """Driver hook: build + start the runtime the environment asks for
+    (None when neither elastic nor file-heartbeat mode is configured).
+    Call right after ``setup_distributed`` so the lease exists before the
+    long data-load/compile phases — peers must not mistake a compiling
+    host for a dead one."""
+    coord_dir = os.getenv("HYDRAGNN_ELASTIC_DIR")
+    if coord_dir:
+        members = [
+            int(m)
+            for m in os.getenv("HYDRAGNN_ELASTIC_MEMBERS", "0").split(",")
+            if m.strip() != ""
+        ]
+        detect = os.getenv("HYDRAGNN_ELASTIC_DETECT_TS")
+        prev = os.getenv("HYDRAGNN_ELASTIC_PREV_WORLD")
+        lost = [
+            int(m)
+            for m in os.getenv("HYDRAGNN_ELASTIC_LOST", "").split(",")
+            if m.strip() != ""
+        ]
+        return ElasticRuntime(
+            coord_dir,
+            host=int(os.getenv("HYDRAGNN_ELASTIC_HOST", "0")),
+            gen=int(os.getenv("HYDRAGNN_ELASTIC_GEN", "0")),
+            members=members,
+            lost_hosts=lost,
+            heartbeat_s=float(
+                os.getenv("HYDRAGNN_ELASTIC_HEARTBEAT_S",
+                          str(DEFAULT_HEARTBEAT_S))
+            ),
+            lease_s=float(
+                os.getenv("HYDRAGNN_ELASTIC_LEASE_S", str(DEFAULT_LEASE_S))
+            ),
+            detect_ts=float(detect) if detect else None,
+            prev_world=int(prev) if prev else None,
+        ).start()
+    hb_file = os.getenv("HYDRAGNN_HEARTBEAT_FILE")
+    if hb_file:
+        return FileHeartbeatRuntime(
+            hb_file,
+            heartbeat_s=float(
+                os.getenv("HYDRAGNN_ELASTIC_HEARTBEAT_S",
+                          str(DEFAULT_HEARTBEAT_S))
+            ),
+        ).start()
+    return None
+
+
+# ---- per-host agent --------------------------------------------------------
+
+
+class ElasticAgent:
+    """One per host: spawns/respawns the training worker across world
+    generations. The membership/coordinator decisions are driven entirely
+    by the shared directory, so agents need no channel to each other."""
+
+    def __init__(
+        self,
+        worker_cmd: List[str],
+        coord_dir: str,
+        host: int,
+        n_hosts: Optional[int] = None,
+        base_port: int = 12360,
+        addr: str = "127.0.0.1",
+        heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+        lease_s: float = DEFAULT_LEASE_S,
+        env: Optional[Dict[str, str]] = None,
+        gen_timeout_s: float = 120.0,
+        poll_s: float = 0.25,
+    ):
+        self.worker_cmd = list(worker_cmd)
+        self.coord_dir = coord_dir
+        self.host = int(host)
+        self.n_hosts = n_hosts
+        self.base_port = int(base_port)
+        self.addr = addr
+        self.heartbeat_s = float(heartbeat_s)
+        self.lease_s = float(lease_s)
+        self.extra_env = dict(env or {})
+        self.gen_timeout_s = float(gen_timeout_s)
+        self.poll_s = float(poll_s)
+
+    # -- generation bookkeeping ---------------------------------------------
+    def _bootstrap_gen(self):
+        """Gen 0: the initial leader (host 0 of the declared size) writes
+        it; everyone else waits for the file."""
+        gen, info = latest_gen(self.coord_dir)
+        if gen is not None:
+            return gen, info
+        if self.n_hosts is None:
+            raise ValueError(
+                "fresh coordination dir and no --hosts given: the first "
+                "agent needs the initial world size"
+            )
+        members = list(range(int(self.n_hosts)))
+        if self.host == members[0]:
+            info = {
+                "gen": 0,
+                "members": members,
+                "coordinator": f"{self.addr}:{self.base_port}",
+                "detect_ts": None,
+                "prev_members": None,
+                "created_ts": time.time(),
+            }
+            _write_json(_gen_path(self.coord_dir, 0), info)
+            return 0, info
+        return self._await_gen(0)
+
+    def _await_gen(self, gen: int):
+        deadline = time.time() + self.gen_timeout_s
+        while time.time() < deadline:
+            info = _read_json(_gen_path(self.coord_dir, gen))
+            if info is not None:
+                return gen, info
+            # keep OUR lease fresh while the leader decides — a surviving
+            # agent mid-re-mesh must not be mistaken for a second loss
+            self._agent_heartbeat(gen - 1)
+            time.sleep(self.poll_s)
+        return None, None
+
+    def _publish_next_gen(self, gen: int, members: List[int],
+                          dead: Dict[int, float]):
+        """Publish generation ``gen+1`` with SINGLE-WINNER semantics.
+
+        Two survivors can transiently disagree on who died (shared-FS
+        metadata lag makes a live peer's lease look stale) and both
+        self-elect: the publish must not be last-rename-wins with each
+        proceeding on its OWN view — that is the split-brain this module
+        promises away. ``os.link`` onto the final name is atomic AND
+        exclusive (unlike ``os.replace``): exactly one candidate file
+        becomes the generation, and EVERY publisher then re-reads the
+        file to adopt whatever actually won. A loser whose winning view
+        excludes it simply evicts in ``run()``."""
+        survivors = [m for m in members if m not in dead]
+        info = {
+            "gen": gen + 1,
+            "members": survivors,
+            "coordinator": f"{self.addr}:{self.base_port + gen + 1}",
+            "detect_ts": min(dead.values()),
+            "prev_members": members,
+            "created_ts": time.time(),
+        }
+        path = _gen_path(self.coord_dir, gen + 1)
+        tmp = f"{path}.cand.{self.host}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(info, f)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            pass  # another leader won the race — its file governs
+        except OSError:
+            # filesystems without hard links: fall back to the (atomic,
+            # last-wins) rename; the re-read below still converges all
+            # agents onto one file's contents
+            os.replace(tmp, path)
+            tmp = None
+        if tmp is not None:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        return self._await_gen(gen + 1)
+
+    def _agent_heartbeat(self, gen: int):
+        _write_json(
+            _hb_path(self.coord_dir, "agent", self.host),
+            {"host": self.host, "gen": int(gen), "ts": time.time(),
+             "pid": os.getpid(), "addr": self.addr},
+        )
+
+    # -- worker environment --------------------------------------------------
+    def _worker_env(self, gen: int, info: Dict) -> Dict[str, str]:
+        members = [int(m) for m in info["members"]]
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update(
+            HYDRAGNN_ELASTIC_DIR=self.coord_dir,
+            HYDRAGNN_ELASTIC_HOST=str(self.host),
+            HYDRAGNN_ELASTIC_GEN=str(gen),
+            HYDRAGNN_ELASTIC_MEMBERS=",".join(str(m) for m in members),
+            HYDRAGNN_ELASTIC_HEARTBEAT_S=str(self.heartbeat_s),
+            HYDRAGNN_ELASTIC_LEASE_S=str(self.lease_s),
+            HYDRAGNN_TPU_COORDINATOR=str(info["coordinator"]),
+            HYDRAGNN_TPU_NUM_PROCESSES=str(len(members)),
+            HYDRAGNN_TPU_PROCESS_ID=str(members.index(self.host)),
+        )
+        if info.get("detect_ts"):
+            env["HYDRAGNN_ELASTIC_DETECT_TS"] = str(info["detect_ts"])
+        if info.get("prev_members"):
+            prev = [int(m) for m in info["prev_members"]]
+            env["HYDRAGNN_ELASTIC_PREV_WORLD"] = str(len(prev))
+            env["HYDRAGNN_ELASTIC_LOST"] = ",".join(
+                str(m) for m in prev if m not in members
+            )
+        else:
+            env.pop("HYDRAGNN_ELASTIC_DETECT_TS", None)
+            env.pop("HYDRAGNN_ELASTIC_PREV_WORLD", None)
+            env.pop("HYDRAGNN_ELASTIC_LOST", None)
+        return env
+
+    # -- main loop -----------------------------------------------------------
+    def run(self) -> int:
+        for sub in ("agents", "workers", "dead", "gens"):
+            os.makedirs(os.path.join(self.coord_dir, sub), exist_ok=True)
+        gen, info = self._bootstrap_gen()
+        if gen is None:
+            return EXIT_GEN_TIMEOUT
+        while True:
+            members = [int(m) for m in info["members"]]
+            if self.host not in members:
+                return EXIT_EVICTED
+            rc = self._supervise_one(gen, info)
+            if rc == 0:
+                return 0
+            from hydragnn_tpu.utils.faults import KILL_EXIT_CODE
+
+            if rc == KILL_EXIT_CODE:
+                # THIS host was preempted (injected or real): tombstone
+                # ourselves so the survivors' leader re-meshes without
+                # waiting out the lease, then die like the host did
+                write_tombstone(
+                    self.coord_dir, self.host, reason="preempted",
+                    by=self.host,
+                )
+                return rc
+            if rc == EXIT_EVICTED:
+                return rc
+            # EXIT_RESHAPE — or any crash that coincides with a peer
+            # loss (a dead peer can also surface as a collective error
+            # before the watchdog fires): re-mesh iff someone is dead
+            # tombstones (fast path: written by the detecting watchdog or
+            # the dying host's own agent) or an expired AGENT lease (the
+            # whole-host-gone path) both count as dead
+            dead = dead_members(
+                self.coord_dir, [m for m in members if m != self.host],
+                self.lease_s, kind="agent",
+            )
+            if not dead:
+                return rc  # a genuine worker failure, not elasticity
+            survivors = [m for m in members if m not in dead]
+            if not survivors or self.host not in survivors:
+                return EXIT_EVICTED
+            if self.host == survivors[0]:
+                gen, info = self._publish_next_gen(gen, members, dead)
+            else:
+                gen, info = self._await_gen(gen + 1)
+            if gen is None:
+                return EXIT_GEN_TIMEOUT
+
+    def _supervise_one(self, gen: int, info: Dict) -> int:
+        """Run one worker process to completion, heartbeating the AGENT
+        lease (host liveness — it must outlive worker restarts) and
+        watching for our own tombstone while it runs."""
+        proc = subprocess.Popen(
+            self.worker_cmd, env=self._worker_env(gen, info)
+        )
+        try:
+            last_beat = 0.0
+            while True:
+                # the poll runs fast (worker exits and tombstones must be
+                # noticed promptly) but the lease WRITE rate-limits to
+                # heartbeat_s — at fleet scale an every-tick atomic
+                # write+rename is sustained metadata traffic on exactly
+                # the shared filesystem the lease is tuned around
+                if time.time() - last_beat >= self.heartbeat_s:
+                    self._agent_heartbeat(gen)
+                    last_beat = time.time()
+                rc = proc.poll()
+                if rc is not None:
+                    return rc
+                if read_tombstone(self.coord_dir, self.host) is not None:
+                    # the world decided we are dead (partition/straggler):
+                    # kill the worker, do not split-brain
+                    proc.kill()
+                    proc.wait(timeout=30)
+                    return EXIT_EVICTED
+                time.sleep(min(self.heartbeat_s, 0.5))
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.train.elastic",
+        description="Per-host elastic training agent (see module docs).",
+    )
+    parser.add_argument("--dir", required=True, help="shared coordination dir")
+    parser.add_argument("--host", type=int, required=True)
+    parser.add_argument("--hosts", type=int, default=None,
+                        help="initial world size (first launch only)")
+    parser.add_argument("--base-port", type=int, default=12360)
+    parser.add_argument("--addr", default="127.0.0.1")
+    parser.add_argument("--heartbeat", type=float, default=DEFAULT_HEARTBEAT_S)
+    parser.add_argument("--lease", type=float, default=DEFAULT_LEASE_S)
+    parser.add_argument("worker", nargs=argparse.REMAINDER,
+                        help="-- worker command")
+    args = parser.parse_args(argv)
+    cmd = args.worker
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("missing worker command after --")
+    agent = ElasticAgent(
+        cmd, args.dir, args.host, n_hosts=args.hosts,
+        base_port=args.base_port, addr=args.addr,
+        heartbeat_s=args.heartbeat, lease_s=args.lease,
+    )
+    return agent.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

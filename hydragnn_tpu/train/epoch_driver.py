@@ -15,7 +15,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from hydragnn_tpu.obs import runtime as obs
-from hydragnn_tpu.train.checkpoint import save_model
+from hydragnn_tpu.train import elastic
+from hydragnn_tpu.train.checkpoint import (
+    drain_async,
+    resolve_async_writer,
+    save_model,
+)
 from hydragnn_tpu.train.common import SchedState, TrainState, _env_flag, _is_oom
 from hydragnn_tpu.train.optimizer import (
     get_learning_rate,
@@ -137,6 +142,11 @@ def train_validate_test(
             "HYDRAGNN_CKPT_KEEP", str(training.get("checkpoint_keep_last", 3))
         )
     )
+    # async checkpointing (HYDRAGNN_ASYNC_CKPT / Training.async_checkpoint):
+    # the resume-cadence saves keep only the device->host snapshot on the
+    # epoch loop; serialize+CRC+fsync+rename move to the background writer.
+    # Drained at end of run (and by the elastic watchdog on preemption).
+    ckpt_writer = resolve_async_writer(training)
 
     # the driver's end-of-run save reuses the newest loop state; seed it
     # with the incoming meta so a continue-of-a-finished-run (no epochs
@@ -347,6 +357,7 @@ def train_validate_test(
             # whole chunks, so HYDRAGNN_PROFILE_AT_STEP resolves against
             # the chunk's starting epoch here
             obs.epoch_start(epoch0)
+            elastic.note_epoch(epoch0)
             if restage and epoch0 > 0:
                 train_loader.set_epoch(epoch0)
                 # release the old stack FIRST — holding it through the
@@ -375,8 +386,11 @@ def train_validate_test(
                 wall_time_s=round(chunk_time, 6),
             )
             # whole-chunk dispatches have no per-step hook: trace-capture
-            # ticks (and env-armed profiling) advance per chunk here
+            # ticks (and env-armed profiling) advance per chunk here, and
+            # a post-resize elastic run reports its recovery at the first
+            # completed chunk (the fit path's "first optimizer step")
             obs.dispatch_boundary()
+            elastic.note_step()
             for i in range(n):
                 if np.isnan(series["train_loss"][i]):
                     continue
@@ -446,6 +460,7 @@ def train_validate_test(
                 save_model(
                     state, log_name, checkpoint_path,
                     train_meta=fit_meta, keep_last=keep_last,
+                    writer=ckpt_writer,
                 )
                 trainer.final_train_meta = fit_meta
                 trainer.final_state_saved = True
@@ -479,11 +494,16 @@ def train_validate_test(
         # resets the telemetry step-in-epoch counter (the anchor for
         # HYDRAGNN_PROFILE_AT_STEP=<epoch>:<step> trace arming)
         obs.epoch_start(epoch)
+        elastic.note_epoch(epoch)
         train_loader.set_epoch(epoch)
         if staged is not None:
             state, rng, train_loss, train_tasks = trainer.train_epoch_staged(
                 state, staged, rng
             )
+            # the staged epoch is one dispatch with no per-step hook: a
+            # post-resize elastic run reports recovery here (the
+            # streaming path reports from the trainer's step loop)
+            elastic.note_step()
         else:
             state, rng, train_loss, train_tasks = trainer.train_epoch(
                 state, train_loader, rng
@@ -584,6 +604,7 @@ def train_validate_test(
             save_model(
                 state, log_name, checkpoint_path,
                 train_meta=meta, keep_last=keep_last,
+                writer=ckpt_writer,
             )
             # the driver's final save reuses this so a COMPLETED run's
             # checkpoint still carries loop state (continue = no-op resume)
@@ -607,6 +628,7 @@ def train_validate_test(
                 save_model(
                     state, log_name, checkpoint_path,
                     train_meta=meta, keep_last=keep_last,
+                    writer=ckpt_writer,
                 )
                 trainer.final_train_meta = meta
                 trainer.final_state_saved = True
@@ -615,6 +637,11 @@ def train_validate_test(
             )
             obs.emit("wallclock_stop", epoch=int(epoch))
             break
+
+    # async-checkpoint barrier: train_validate_test returning means every
+    # save it initiated is durable on disk (fsync'd + renamed) — callers
+    # (the driver's final save, a restarting supervisor) rely on that
+    drain_async()
 
     if visualizer is not None:
         _, _, true_values, predicted_values = trainer.predict(state, test_loader)

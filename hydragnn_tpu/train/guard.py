@@ -116,6 +116,9 @@ class DivergenceGuard:
                     "epoch ever committed — the run is broken from the "
                     "start; inspect the data/LR"
                 )
+            from hydragnn_tpu.train import elastic
+
+            elastic.note_guard_restore()
             return fallback_state
         return self._restore()
 
@@ -137,6 +140,11 @@ class DivergenceGuard:
         # keep halving across successive restores, not oscillating back up
         self.last_good = self._copy(restored)
         obs.guard_restore(self.restores, lr)
+        # the heartbeat lease carries a guard_restores counter — the HPO
+        # launcher's divergence early-kill signal (train/elastic.py)
+        from hydragnn_tpu.train import elastic
+
+        elastic.note_guard_restore()
         return restored
 
     def state_dict(self) -> dict:

@@ -137,6 +137,9 @@ class PartitionedTrainer:
         self.tx = None
         self._train_step = None
         self._eval_step = None
+        # process-global optimizer-step counter: drives the fault-injection
+        # hooks and the elastic heartbeat, same contract as Trainer
+        self._host_step = 0
         opt_cfg = training_config.get("Optimizer", {})
         if opt_cfg.get("use_zero_redundancy") or int(
             opt_cfg.get("zero_stage") or 0
@@ -257,6 +260,9 @@ class PartitionedTrainer:
     _acc_read = staticmethod(Trainer._acc_read)
 
     def train_epoch(self, state, loader, rng):
+        from hydragnn_tpu.train import elastic
+        from hydragnn_tpu.utils import faults
+
         acc = None
         nbatch = _nbatch(loader)
         tr.start("train")
@@ -269,10 +275,17 @@ class PartitionedTrainer:
             batch = self.put_batch(batch)
             rng, sub = jax.random.split(rng)
             t0 = time.perf_counter() if _telemetry is not None else 0.0
+            # straggler injection inside the timed window, so the delay
+            # reaches on_step -> flight-recorder stall detection
+            faults.slow_step(self._host_step)
             state, metrics = self._train_step(state, batch, sub)
             if _telemetry is not None:
                 _telemetry.on_step(time.perf_counter() - t0)
             acc = self._acc_add(acc, metrics)
+            faults.kill_at_step(self._host_step)
+            faults.lose_host_at_step(self._host_step)
+            self._host_step += 1
+            elastic.note_step(self._host_step)
         loss, tasks = self._acc_read(acc)
         tr.stop("train")
         return state, rng, loss, tasks

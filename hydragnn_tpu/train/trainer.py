@@ -639,6 +639,7 @@ class Trainer(PredictMixin):
         return self.put_batch(group[0]), 1
 
     def train_epoch(self, state, loader, rng):
+        from hydragnn_tpu.train import elastic
         from hydragnn_tpu.utils import faults
 
         acc = None
@@ -662,6 +663,13 @@ class Trainer(PredictMixin):
                 rng = subs[0]
                 tr.start("train_step")
                 t0 = time.perf_counter() if _telemetry is not None else 0.0
+                # straggler injection INSIDE the timed window (after t0):
+                # the delay must reach on_step -> flight recorder, or the
+                # stall detection the fault exists to exercise never sees
+                # it. Every step id the K-group covers gets its check,
+                # same as the kill loop below.
+                for s in range(self._host_step, self._host_step + count):
+                    faults.slow_step(s)
                 state, metrics = self._train_multi(state, dev, subs[1:])
                 if _telemetry is not None:
                     # the full per-step hook: metrics + flight recorder
@@ -671,8 +679,10 @@ class Trainer(PredictMixin):
                 acc = self._acc_add(acc, metrics, multi=True)
                 first = self._host_step
                 self._host_step += count
+                elastic.note_step(self._host_step)
                 for s in range(first, self._host_step):
                     faults.kill_at_step(s)
+                    faults.lose_host_at_step(s)
             else:
                 if faults.nan_at_step(self._host_step):
                     dev = dev.replace(x=dev.x * jnp.nan)
@@ -680,6 +690,8 @@ class Trainer(PredictMixin):
                 rng, sub = jax.random.split(rng)
                 tr.start("train_step")
                 t0 = time.perf_counter() if _telemetry is not None else 0.0
+                # inside the timed window — see the multi-step branch
+                faults.slow_step(self._host_step)
                 state, metrics = self._train_step(state, dev, sub)
                 if _telemetry is not None:
                     _telemetry.on_step(time.perf_counter() - t0)
@@ -699,7 +711,9 @@ class Trainer(PredictMixin):
                         guard.bad_streak = 0
                     acc = self._acc_add(acc, metrics, multi=False)
                 faults.kill_at_step(self._host_step)
+                faults.lose_host_at_step(self._host_step)
                 self._host_step += 1
+                elastic.note_step(self._host_step)
         loss, tasks = self._acc_read(acc)  # the epoch's one readback
         tr.stop("train")
         return state, rng, loss, tasks

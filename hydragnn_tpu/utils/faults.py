@@ -19,6 +19,16 @@ is a cheap no-op, so the harness costs nothing outside tests. The points
 - ``HYDRAGNN_FAULT_NAN_AT_STEP=SPEC`` — poison the training batch with
   NaNs at the optimizer steps named by ``SPEC`` (``"3"``, ``"3,5,9"`` or
   ``"4:9"`` half-open range). Exercises the divergence guard.
+- ``HYDRAGNN_FAULT_LOSE_HOST_AT_STEP=RANK:N`` — hard-kill the process
+  whose ``jax.process_index()`` is ``RANK`` at its optimizer step ``N``
+  (bare ``N`` targets rank 0). The multi-host preemption injection:
+  exactly one host of the world disappears mid-epoch, exercising the
+  elastic lease/watchdog/re-mesh path (``train/elastic.py``).
+- ``HYDRAGNN_FAULT_SLOW_STEP=SPEC@SECONDS`` — sleep ``SECONDS`` before
+  dispatching each optimizer step covered by ``SPEC`` (same grammar as
+  NAN_AT_STEP; ``SECONDS`` defaults to 0.25). The straggler injection:
+  exercises the flight-recorder stall detection and the HPO launcher's
+  heartbeat-staleness early kill without any host actually dying.
 
 Counters are process-global and monotonic; :func:`reset` exists for tests
 that exercise several scenarios in one process.
@@ -26,6 +36,7 @@ that exercise several scenarios in one process.
 
 import os
 import threading
+import time
 
 _lock = threading.Lock()
 _counters = {"ckpt_writes": 0, "flaky_reads": 0}
@@ -63,6 +74,40 @@ def kill_at_step(step: int) -> None:
         return
     if int(spec) == int(step):
         os._exit(KILL_EXIT_CODE)
+
+
+def lose_host_at_step(step: int) -> None:
+    """Multi-host preemption injection: hard-exit THIS process when it is
+    the targeted rank and ``step`` hits the configured value. Spec is
+    ``"RANK:N"`` (bare ``"N"`` = rank 0). Same no-cleanup ``os._exit``
+    semantics as :func:`kill_at_step` — the host just vanishes."""
+    spec = os.getenv("HYDRAGNN_FAULT_LOSE_HOST_AT_STEP")
+    if spec is None:
+        return
+    rank_s, _, step_s = spec.rpartition(":")
+    target_rank = int(rank_s) if rank_s else 0
+    if int(step_s) != int(step):
+        return
+    import jax  # lazy: the no-op path must not initialize a backend
+
+    try:
+        rank = jax.process_index()
+    except Exception:
+        rank = 0
+    if rank == target_rank:
+        os._exit(KILL_EXIT_CODE)
+
+
+def slow_step(step: int) -> None:
+    """Straggler injection: sleep before dispatching a covered step.
+    Spec is ``"SPEC@SECONDS"`` (``"12@0.3"``, ``"4:9@0.05"``); a bare
+    ``"SPEC"`` sleeps the 0.25 s default."""
+    spec = os.getenv("HYDRAGNN_FAULT_SLOW_STEP")
+    if spec is None:
+        return
+    member, _, secs = spec.partition("@")
+    if _parse_step_spec(member)(int(step)):
+        time.sleep(float(secs) if secs else 0.25)
 
 
 def nan_at_step(step: int) -> bool:

@@ -1,0 +1,107 @@
+"""CI elastic kill-and-rejoin smoke (standalone, NOT a pytest module).
+
+The bounded-wall-time version of the e2e in ``tests/test_elastic.py``:
+2 agent-supervised CPU processes, one fault-killed mid-epoch, survivor
+re-meshes to world 1 and finishes; the produced event stream is validated
+against the documented schema, the measured recovery time is printed, and
+the post-resize trajectory is checked bitwise against a clean 1-process
+restart from the same rolling checkpoint.
+
+Usage: python tests/_elastic_smoke.py <workdir>
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _elastic_worker  # noqa: E402
+
+
+def main(workdir):
+    os.makedirs(workdir, exist_ok=True)
+    rcs = _elastic_worker.run_elastic(
+        workdir,
+        n_hosts=2,
+        extra_env={
+            "HYDRAGNN_FAULT_LOSE_HOST_AT_STEP": "1:3",
+            "HYDRAGNN_FAULT_SLOW_STEP": "0:@0.3",
+        },
+        timeout=240,
+    )
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.utils.faults import KILL_EXIT_CODE
+
+    assert rcs[1] == KILL_EXIT_CODE, f"killed host agent rc: {rcs}"
+    assert rcs[0] == 0, f"survivor agent rc: {rcs}"
+
+    result = json.load(open(os.path.join(workdir, "result.json")))
+    num_epoch = _elastic_worker.NUM_EPOCH
+    assert result["world"] == 1 and result["gen"] >= 1, result
+    resumed = result["resumed_from_epoch"]
+    assert resumed is not None and 1 <= resumed < num_epoch, result
+    assert result["epochs_run"] == list(range(resumed, num_epoch)), result
+
+    recs = validate_events(
+        os.path.join(workdir, "logs", "elastic", "events.jsonl"),
+        require=["host_lost", "world_resize", "checkpoint_saved"],
+    )
+    resize = [r for r in recs if r["event"] == "world_resize"][-1]
+    assert resize["old_world"] == 2 and resize["new_world"] == 1, resize
+    assert 0.0 < resize["recovery_s"] < 240.0, resize
+    n_async = sum(
+        1 for r in recs
+        if r["event"] == "checkpoint_saved" and r.get("async")
+    )
+    assert n_async > 0, "async checkpointing never engaged"
+
+    # trajectory acceptance: a clean 1-process restart from the rolling
+    # checkpoint the resized world resumed from lands on the identical
+    # final parameters
+    from hydragnn_tpu.train import checkpoint as ck
+
+    roll_by_epoch = {}
+    for p in ck.rolling_checkpoints(
+        "elastic", path=os.path.join(workdir, "logs")
+    ):
+        meta = ck.pop_train_meta(
+            ck._parse_checkpoint_bytes(open(p, "rb").read(), p)
+        )
+        roll_by_epoch.setdefault(int(meta["epoch"]), p)
+    refdir = os.path.join(workdir, "ref")
+    ref_ck = os.path.join(refdir, "logs", "elastic")
+    os.makedirs(ref_ck, exist_ok=True)
+    with open(roll_by_epoch[resumed - 1], "rb") as src, open(
+        os.path.join(ref_ck, "elastic.pk"), "wb"
+    ) as dst:
+        dst.write(src.read())
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("HYDRAGNN_FAULT_", "HYDRAGNN_ELASTIC_",
+                             "HYDRAGNN_TPU_"))
+    }
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "_elastic_worker.py"
+    )
+    ref = subprocess.run(
+        [sys.executable, worker, "worker", refdir], env=env, timeout=240
+    )
+    assert ref.returncode == 0, f"reference restart rc {ref.returncode}"
+    ref_res = json.load(open(os.path.join(refdir, "result.json")))
+    assert ref_res["resumed_from_epoch"] == resumed, ref_res
+    assert ref_res["final_params_digest"] == result["final_params_digest"], (
+        "post-resize trajectory diverged from the clean restart"
+    )
+    print(
+        "elastic smoke OK: 2->1 re-mesh, resumed at epoch "
+        f"{resumed}, recovery {resize['recovery_s']:.2f}s, "
+        f"{n_async} async checkpoint saves, trajectory == clean restart"
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,171 @@
+"""Direct unit pins for checkpoint load-path promises PR 1 made but never
+tested head-on: ``rolling_checkpoints`` ordering, ``pop_train_meta`` on
+v1/legacy/odd inputs, and the rolling-copy fsync durability fix.
+"""
+
+import binascii
+import os
+import struct
+import tempfile
+
+import numpy as np
+
+from hydragnn_tpu.train import checkpoint as ck
+from hydragnn_tpu.train.checkpoint import (
+    load_state_dict,
+    pop_train_meta,
+    restore_into,
+    rolling_checkpoints,
+    save_model,
+)
+
+
+def _state_dict_fixture(step=5):
+    return {
+        "params": {"w": np.arange(4, dtype=np.float32)},
+        "batch_stats": {},
+        "opt_state": {},
+        "step": np.int32(step),
+    }
+
+
+# ---- rolling_checkpoints ordering -----------------------------------------
+
+
+def pytest_rolling_order_is_numeric_not_lexicographic():
+    """Sequence numbers must sort as integers: roll-10 is NEWER than
+    roll-9 even though it sorts lower as a string — and out-of-pattern
+    files in the directory are ignored, not mis-ordered."""
+    with tempfile.TemporaryDirectory() as tmp:
+        out_dir = os.path.join(tmp, "m")
+        os.makedirs(out_dir)
+        for seq in (2, 9, 10, 100):
+            open(
+                os.path.join(out_dir, f"m.roll-{seq:06d}.pk"), "wb"
+            ).write(b"x")
+        # same name with a hand-made UNPADDED seq (an operator cp) still
+        # ranks by numeric value
+        open(os.path.join(out_dir, "m.roll-42.pk"), "wb").write(b"x")
+        # noise that must not be picked up
+        open(os.path.join(out_dir, "m.roll-5.pk.tmp"), "wb").write(b"x")
+        open(os.path.join(out_dir, "m.pk"), "wb").write(b"x")
+        rolls = rolling_checkpoints("m", path=tmp)
+        seqs = [
+            int(os.path.basename(p).split("roll-")[1].split(".")[0])
+            for p in rolls
+        ]
+        assert seqs == [100, 42, 10, 9, 2]
+
+
+def pytest_rolling_sequence_continues_across_restarts():
+    """A resumed run must append AFTER the existing history: seq picks up
+    from the newest retained file, never recycling numbers (which would
+    make pruning eat the wrong copies)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        for ep in range(3):
+            save_model(_state_dict_fixture(ep), "m", path=tmp,
+                       train_meta={"epoch": ep}, keep_last=2)
+        first = rolling_checkpoints("m", path=tmp)
+        # keep_last=2: seqs 1 and 2 retained (0 pruned)
+        assert [os.path.basename(p) for p in first] == [
+            "m.roll-000002.pk", "m.roll-000001.pk",
+        ]
+        # "restart": a fresh process appends seq 3
+        save_model(_state_dict_fixture(3), "m", path=tmp,
+                   train_meta={"epoch": 3}, keep_last=2)
+        after = rolling_checkpoints("m", path=tmp)
+        assert [os.path.basename(p) for p in after] == [
+            "m.roll-000003.pk", "m.roll-000002.pk",
+        ]
+        meta = pop_train_meta(
+            ck._parse_checkpoint_bytes(open(after[0], "rb").read(), after[0])
+        )
+        assert int(meta["epoch"]) == 3
+
+
+# ---- pop_train_meta on v1 / legacy / odd inputs ---------------------------
+
+
+def pytest_pop_train_meta_v1_header_returns_none():
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(_state_dict_fixture(), "m", path=tmp)  # v2, no meta
+        fname = os.path.join(tmp, "m", "m.pk")
+        raw = open(fname, "rb").read()
+        blob = raw[16:]
+        v1 = ck._MAGIC + struct.pack(
+            "<II", 1, binascii.crc32(blob) & 0xFFFFFFFF
+        ) + blob
+        open(fname, "wb").write(v1)
+        restored = load_state_dict("m", path=tmp)
+        assert pop_train_meta(restored) is None
+        # and restore_into on the meta-less dict reconstructs the leaves
+        rebuilt = restore_into(_state_dict_fixture(), restored)
+        np.testing.assert_array_equal(rebuilt["params"]["w"],
+                                      np.arange(4, dtype=np.float32))
+
+
+def pytest_pop_train_meta_legacy_headerless_returns_none():
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(_state_dict_fixture(7), "m", path=tmp)
+        fname = os.path.join(tmp, "m", "m.pk")
+        blob = open(fname, "rb").read()[16:]
+        open(fname, "wb").write(blob)  # pre-header era file
+        restored = load_state_dict("m", path=tmp)
+        assert pop_train_meta(restored) is None
+        assert int(restored["step"]) == 7
+
+
+def pytest_pop_train_meta_detaches_and_is_idempotent():
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(_state_dict_fixture(), "m", path=tmp,
+                   train_meta={"epoch": 9})
+        restored = load_state_dict("m", path=tmp)
+        meta = pop_train_meta(restored)
+        assert int(meta["epoch"]) == 9
+        assert ck.TRAIN_META_KEY not in restored
+        assert pop_train_meta(restored) is None  # second pop: nothing
+
+
+def pytest_pop_train_meta_non_dict_input_returns_none():
+    assert pop_train_meta(None) is None
+    assert pop_train_meta([1, 2, 3]) is None
+
+
+# ---- rolling-copy durability (the _retain_rolling fsync fix) --------------
+
+
+def pytest_rolling_copy_is_fsynced_before_rename(monkeypatch):
+    """The durability bug this PR fixes: the rolling tmp file must be
+    flushed + fsync'd before ``os.replace`` — exactly like the primary —
+    or a crash can leave EMPTY fallback copies, which are read precisely
+    when the primary is already lost."""
+    synced_then_renamed = []
+    synced_fds = set()
+    real_fsync = os.fsync
+    real_replace = os.replace
+
+    def spy_fsync(fd):
+        synced_fds.add(True)
+        return real_fsync(fd)
+
+    def spy_replace(src, dst):
+        if dst.endswith(".pk"):
+            synced_then_renamed.append((dst, bool(synced_fds)))
+            synced_fds.clear()
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(os, "replace", spy_replace)
+    with tempfile.TemporaryDirectory() as tmp:
+        save_model(_state_dict_fixture(), "m", path=tmp,
+                   train_meta={"epoch": 0}, keep_last=2)
+    # two renames (primary + rolling copy), EACH preceded by its own fsync
+    assert len(synced_then_renamed) == 2
+    assert all(synced for _, synced in synced_then_renamed), (
+        synced_then_renamed
+    )
+    kinds = sorted(
+        "roll" if ".roll-" in dst else "primary"
+        for dst, _ in synced_then_renamed
+    )
+    assert kinds == ["primary", "roll"]

@@ -128,6 +128,187 @@ def pytest_launcher_failure_is_inf(tmp_path):
     assert launcher.run(t) == float("inf")
 
 
+def _trial_events(log_dir):
+    from hydragnn_tpu.obs.events import validate_events
+
+    return [
+        r
+        for r in validate_events(
+            os.path.join(str(log_dir), "trials.jsonl"), require=["hpo_trial"]
+        )
+        if r["event"] == "hpo_trial"
+    ]
+
+
+def pytest_garbled_output_is_failed_with_structured_event(tmp_path,
+                                                          monkeypatch):
+    """A trial that exits 0 but prints no parseable metric must be marked
+    FAILED by a schema-valid ``hpo_trial`` event (reason: garbled_output)
+    and score +inf — never be silently treated as a score."""
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    script = tmp_path / "garbled.py"
+    script.write_text("print('Vol Less: 0.3 (typo, not a metric)')\n")
+    logs = tmp_path / "logs"
+    launcher = TrialLauncher(str(script), log_dir=str(logs))
+    study = create_study(sampler="random", seed=0)
+    t = study.ask()
+    t.suggest_float("x", 0.0, 1.0)
+    assert launcher.run(t) == float("inf")
+    evs = _trial_events(logs)
+    assert evs[-1]["status"] == "failed"
+    assert evs[-1]["reason"] == "garbled_output"
+    assert evs[-1]["trial"] == t.number
+    # ...and through the concurrent driver the trial is TOLD as failed,
+    # releasing its node block for the next trial
+    launcher2 = TrialLauncher(str(script), log_dir=str(logs))
+    study2 = create_study(sampler="random", seed=0)
+    optimize_concurrent_kwargs = dict(
+        n_trials=2, max_concurrent=1, nodes=["nodeA"],
+    )
+    from hydragnn_tpu.hpo import optimize_concurrent
+
+    try:
+        optimize_concurrent(
+            study2, launcher2, lambda tr: tr.suggest_float("x", 0, 1),
+            **optimize_concurrent_kwargs,
+        )
+    except Exception:
+        pass  # every trial failed -> best_trial may not exist
+    assert sum(1 for tr in study2.trials if tr.state == "failed") == 2
+    assert len(_trial_events(logs)) >= 3
+
+
+def pytest_completed_trial_emits_event_with_score(tmp_path, monkeypatch):
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    script = tmp_path / "ok.py"
+    script.write_text("print('Val Loss: 0.125')\n")
+    logs = tmp_path / "logs"
+    launcher = TrialLauncher(str(script), log_dir=str(logs))
+    study = create_study(sampler="random", seed=0)
+    t = study.ask()
+    t.suggest_float("x", 0.0, 1.0)
+    assert launcher.run(t, nodelist=["n1", "n2"]) == 0.125
+    ev = _trial_events(logs)[-1]
+    assert ev["status"] == "completed"
+    assert ev["val_loss"] == 0.125
+    assert ev["nodes"] == ["n1", "n2"]
+
+
+def pytest_heartbeat_stale_trial_is_early_killed(tmp_path, monkeypatch):
+    """The elastic early-kill signal: a trial whose heartbeat lease goes
+    stale (hung collective / wedged host) is killed well before the hard
+    timeout, marked ``killed:heartbeat_timeout``, and scored +inf."""
+    import textwrap
+    import time
+
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    script = tmp_path / "hung.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import json, os, time
+            # one heartbeat, then the 'collective' wedges forever
+            with open(os.environ["HYDRAGNN_HEARTBEAT_FILE"], "w") as f:
+                json.dump({"ts": time.time(), "step": 1}, f)
+            time.sleep(600)
+            print("Val Loss: 0.0")
+            """
+        )
+    )
+    logs = tmp_path / "logs"
+    launcher = TrialLauncher(
+        str(script), log_dir=str(logs), timeout=120, heartbeat_timeout=1.0
+    )
+    study = create_study(sampler="random", seed=0)
+    t = study.ask()
+    t.suggest_float("x", 0.0, 1.0)
+    t0 = time.time()
+    assert launcher.run(t) == float("inf")
+    assert time.time() - t0 < 60  # killed by the lease, not the timeout
+    ev = _trial_events(logs)[-1]
+    assert ev["status"] == "killed"
+    assert ev["reason"] == "heartbeat_timeout"
+
+
+def pytest_diverging_trial_is_early_killed(tmp_path, monkeypatch):
+    """The divergence-guard early kill: a trial whose heartbeat reports
+    guard restores past the budget is killed and marked
+    ``killed:divergence`` — freeing its nodes instead of burning the
+    remaining epochs on a diverging config."""
+    import textwrap
+
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    script = tmp_path / "diverge.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import json, os, time
+            # keep the lease FRESH while reporting ever-more restores:
+            # only the divergence budget can kill this trial
+            path = os.environ["HYDRAGNN_HEARTBEAT_FILE"]
+            for i in range(600):
+                with open(path, "w") as f:
+                    json.dump({"ts": time.time(), "step": i,
+                               "guard_restores": i}, f)
+                time.sleep(0.1)
+            print("Val Loss: 0.0")
+            """
+        )
+    )
+    logs = tmp_path / "logs"
+    launcher = TrialLauncher(
+        str(script), log_dir=str(logs), timeout=120,
+        heartbeat_timeout=30.0, max_guard_restores=3,
+    )
+    study = create_study(sampler="random", seed=0)
+    t = study.ask()
+    t.suggest_float("x", 0.0, 1.0)
+    assert launcher.run(t) == float("inf")
+    ev = _trial_events(logs)[-1]
+    assert ev["status"] == "killed"
+    assert ev["reason"] == "divergence"
+
+
+def pytest_hung_collective_detected_through_fresh_lease(tmp_path):
+    """The real wiring's hang shape: the lease DAEMON keeps stamping
+    ``ts`` while the training thread is wedged, so ``progress_ts`` (only
+    advanced by real optimizer steps) is the staleness signal — a fresh
+    ``ts`` with stale ``progress_ts`` must still kill; a fresh lease with
+    NO progress yet (compile/data load) must not."""
+    import json
+    import time
+
+    launcher = TrialLauncher(
+        "unused", log_dir=str(tmp_path / "logs"), heartbeat_timeout=5.0
+    )
+    hb = tmp_path / "hb.json"
+    now = time.time()
+    # wedged training thread, live daemon: stale progress, fresh ts
+    hb.write_text(json.dumps({"ts": now, "progress_ts": now - 100}))
+    assert launcher._kill_reason(str(hb), started=now) == "heartbeat_timeout"
+    # compiling trial: fresh ts, no progress reported yet -> alive
+    hb.write_text(json.dumps({"ts": now, "progress_ts": 0.0}))
+    assert launcher._kill_reason(str(hb), started=now) is None
+    # wedged HOST: everything stale -> killed via the ts fallback
+    hb.write_text(json.dumps({"ts": now - 100}))
+    assert launcher._kill_reason(str(hb), started=now) == "heartbeat_timeout"
+
+
+def pytest_launcher_early_kill_knobs_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPO_HEARTBEAT_TIMEOUT_S", "7.5")
+    monkeypatch.setenv("HPO_MAX_GUARD_RESTORES", "4")
+    launcher = TrialLauncher("unused", log_dir=str(tmp_path / "logs"))
+    assert launcher.heartbeat_timeout == 7.5
+    assert launcher.max_guard_restores == 4
+    # explicit args beat the env
+    launcher2 = TrialLauncher(
+        "unused", log_dir=str(tmp_path / "logs"),
+        heartbeat_timeout=1.0, max_guard_restores=1,
+    )
+    assert launcher2.heartbeat_timeout == 1.0
+    assert launcher2.max_guard_restores == 1
+
+
 def pytest_concurrent_trials_overlap(tmp_path, monkeypatch):
     """optimize_concurrent keeps N trials in flight (the reference's
     DeepHyper multi-node scheduler shape): with 4-way concurrency the

@@ -1,0 +1,119 @@
+"""Direct unit coverage for ``utils/retry.py`` — previously exercised
+only through the resilience e2e: jittered-backoff bounds, the attempt
+cap, non-retryable passthrough, and the env-knob defaults.
+"""
+
+import os
+
+import pytest
+
+from hydragnn_tpu.utils import retry
+from hydragnn_tpu.utils.retry import retry_io
+
+
+def _always_fail(record):
+    def fn():
+        record.append(1)
+        raise OSError("transient")
+
+    return fn
+
+
+def pytest_backoff_delays_doubled_with_bounded_jitter(monkeypatch):
+    """Delay i must be ``base * 2**i`` stretched by the uniform jitter
+    factor in [1.0, 1.5) — never shorter (a stampede re-sync) and never
+    past the +50% bound."""
+    delays = []
+    monkeypatch.setattr(retry.time, "sleep", delays.append)
+    calls = []
+    base = 0.05
+    with pytest.raises(OSError):
+        retry_io(_always_fail(calls), attempts=4, base_delay=base)
+    assert len(calls) == 4
+    assert len(delays) == 3  # no sleep after the final attempt
+    for i, d in enumerate(delays):
+        lo = base * (2.0 ** i)
+        assert lo <= d <= lo * 1.5, (i, d)
+    # the jitter draw actually varies (not a fixed multiplier)
+    monkeypatch.setattr(
+        retry.random, "uniform", lambda a, b: 0.5
+    )
+    delays2 = []
+    monkeypatch.setattr(retry.time, "sleep", delays2.append)
+    with pytest.raises(OSError):
+        retry_io(_always_fail([]), attempts=3, base_delay=base)
+    assert delays2 == [base * 1.5, base * 2 * 1.5]
+
+
+def pytest_attempt_cap_is_exact(monkeypatch):
+    monkeypatch.setattr(retry.time, "sleep", lambda s: None)
+    for attempts in (1, 2, 5):
+        calls = []
+        with pytest.raises(OSError, match="transient"):
+            retry_io(_always_fail(calls), attempts=attempts,
+                     base_delay=0.001)
+        assert len(calls) == attempts
+    # nonsensical budgets clamp to one attempt, not zero (which would
+    # re-raise a stale/None error)
+    calls = []
+    with pytest.raises(OSError):
+        retry_io(_always_fail(calls), attempts=0, base_delay=0.001)
+    assert len(calls) == 1
+
+
+def pytest_success_after_transient_failures(monkeypatch):
+    monkeypatch.setattr(retry.time, "sleep", lambda s: None)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+        return "data"
+
+    assert retry_io(flaky, attempts=5, base_delay=0.001) == "data"
+    assert state["n"] == 3
+
+
+def pytest_non_retryable_exceptions_pass_through(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(retry.time, "sleep", sleeps.append)
+
+    # FileNotFoundError: an OSError subclass, but a wrong path is not
+    # transient — one attempt, zero sleeps
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        retry_io(missing, attempts=5, base_delay=0.001)
+    assert len(calls) == 1 and sleeps == []
+
+    # non-OSError exceptions (bad data, logic bugs) propagate immediately
+    calls = []
+
+    def corrupt():
+        calls.append(1)
+        raise ValueError("bad payload")
+
+    with pytest.raises(ValueError, match="bad payload"):
+        retry_io(corrupt, attempts=5, base_delay=0.001)
+    assert len(calls) == 1 and sleeps == []
+
+
+def pytest_env_knobs_default_the_budget(monkeypatch):
+    monkeypatch.setattr(retry.time, "sleep", lambda s: None)
+    monkeypatch.setenv("HYDRAGNN_IO_RETRIES", "2")
+    monkeypatch.setenv("HYDRAGNN_IO_RETRY_BASE_S", "0.001")
+    calls = []
+    with pytest.raises(OSError):
+        retry_io(_always_fail(calls))  # attempts=None reads the env
+    assert len(calls) == 2
+    # explicit argument beats the env
+    calls = []
+    with pytest.raises(OSError):
+        retry_io(_always_fail(calls), attempts=3, base_delay=0.001)
+    assert len(calls) == 3
+    assert os.getenv("HYDRAGNN_IO_RETRIES") == "2"
