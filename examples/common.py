@@ -202,6 +202,60 @@ def train_with_loaders(config, trainset, valset, testset, log_name, seed=0):
     return state, trainer, float(val_loss)
 
 
+def train_with_stream(config, sources, valset, testset, log_name,
+                      weights=None, seed=0):
+    """:func:`train_with_loaders`'s streaming twin: the TRAIN split never
+    materializes — ``sources`` are :class:`~hydragnn_tpu.data.stream.
+    StreamSource`\\ s fed through the weighted mix, the auto-tuned bucket
+    planner replaces the hand ``batch_buckets`` table, and config
+    derivation runs over a cursor-neutral probe window (docs/data.md)."""
+    from hydragnn_tpu.data.stream import assemble_stream_loaders
+    from hydragnn_tpu.obs import runtime as obs
+
+    setup_distributed()
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+    suffix = example_arg("log_name_suffix")
+    if suffix:
+        log_name = f"{log_name}_{suffix}"
+    print_utils.setup_log(log_name)
+
+    training = config["NeuralNetwork"]["Training"]
+    scfg = config.get("Dataset", {}).get("streaming", {})
+    train_loader, val_loader, test_loader, probe_loader = (
+        assemble_stream_loaders(
+            sources, weights, training["batch_size"], scfg, valset,
+            testset, num_buckets=training.get("batch_buckets"),
+        )
+    )
+    if train_loader.plan_event:
+        obs.emit("bucket_plan", **train_loader.plan_event)
+    config = update_config(config, probe_loader, val_loader, test_loader)
+    save_config(config, log_name)
+
+    arch = dict(config["NeuralNetwork"]["Architecture"])
+    arch["loss_function_type"] = training.get("loss_function_type", "mse")
+    arch["conv_checkpointing"] = training.get("conv_checkpointing", False)
+    model = create_model_config(arch, verbosity)
+    trainer = Trainer(model, training, mesh=default_mesh(),
+                      verbosity=verbosity)
+    state = trainer.init_state(train_loader.example_batch(), seed=seed)
+
+    state = train_validate_test(
+        trainer,
+        state,
+        train_loader,
+        val_loader,
+        test_loader,
+        config["NeuralNetwork"],
+        log_name,
+        verbosity,
+    )
+    save_model(state, log_name)
+    val_loss, _ = trainer.evaluate(state, val_loader)
+    print(f"Val Loss: {val_loss}")
+    return state, trainer, float(val_loss)
+
+
 # ---------------------------------------------------------------------------
 # Synthetic molecule/crystal builders shared by several examples.
 # ---------------------------------------------------------------------------

@@ -10,6 +10,14 @@ semantics the reference gets from joining datasets.
 
 ``--num_samples`` (per family) supports the reference's weak-scaling knob
 (``train.py:56-66``).
+
+``--stream`` trains through the shard-native streaming data plane
+(``hydragnn_tpu/data/stream/``, docs/data.md) instead of materializing
+the union: each family's shard store becomes a lazy ``ShardStoreSource``,
+the ``Dataset.streaming`` section of gfm.json sets per-family weights and
+the shard window, and the bucket plan is auto-tuned from the streamed
+size histogram — the production path when the families do not fit in
+host RAM.
 """
 
 import os
@@ -84,6 +92,30 @@ def main():
                 arch["max_neighbours"], rank, world,
             )
             print(f"rank {rank}: family {name} written")
+        return
+
+    if example_arg("stream"):
+        from common import train_with_stream
+
+        from hydragnn_tpu.data.stream import ShardStoreSource
+
+        scfg = config.get("Dataset", {}).get("streaming", {})
+        fam_weights = scfg.get("weights", {})
+        sources = [
+            ShardStoreSource(f"dataset/{f}_trainset", name=f)
+            for f in FAMILIES
+        ]
+        weights = [float(fam_weights.get(f, 1.0)) for f in FAMILIES]
+        valset = ConcatDataset(
+            [ShardDataset(f"dataset/{f}_valset") for f in FAMILIES]
+        )
+        testset = ConcatDataset(
+            [ShardDataset(f"dataset/{f}_testset") for f in FAMILIES]
+        )
+        train_with_stream(
+            config, sources, valset, testset,
+            log_name="gfm_multidataset_stream", weights=weights,
+        )
         return
 
     splits = []

@@ -17,6 +17,7 @@ import numpy as np
 
 from hydragnn_tpu.data.dataobj import GraphData
 from hydragnn_tpu.graph.batch import _round_up, collate_graphs, pad_sizes_for
+from hydragnn_tpu.utils.envparse import env_int
 
 
 @dataclass
@@ -267,6 +268,53 @@ def _layout_from_maxima(
     )
 
 
+def budget_bucket_layout(
+    nodes: np.ndarray,
+    edges: np.ndarray,
+    trips: np.ndarray,
+    batch_size: int,
+    mult: int,
+    device_multiple: int,
+    head_types,
+    head_dims,
+    need_triplets: bool = False,
+    need_neighbors: bool = False,
+    k_in: int = 1,
+    k_out: int = 1,
+) -> BatchLayout:
+    """One bucket's layout sized at ``batch_size x bucket MEAN`` (not
+    max): the loader packs graphs greedily under these budgets, so every
+    batch fits by construction and padding waste is the distance from the
+    budget to the last graph that did not fit, not max-vs-mean. ``g_pad``
+    allows however many of the bucket's smallest graphs fit the node
+    budget. Shared by :func:`compute_layout`'s bucketed path and the
+    streaming :class:`~hydragnn_tpu.data.stream.planner.BucketPlanner`
+    (one sizing rule — the auto-tuned plan cannot drift from the
+    materialized path's)."""
+    n_budget = int(max(batch_size * float(nodes.mean()), nodes.max()) + 1)
+    e_budget = int(max(batch_size * float(edges.mean()), edges.max(), 1))
+    n_pad = _round_up(n_budget, mult)
+    e_pad = _round_up(e_budget, mult)
+    g_cap = max(batch_size, n_pad // max(int(nodes.min()), 1))
+    g_pad = _round_up(g_cap + 1, max(device_multiple, 1))
+    t_pad = 0
+    if need_triplets and not need_neighbors:
+        t_budget = int(max(batch_size * float(trips.mean()), trips.max(), 1))
+        t_pad = _round_up(t_budget, mult)
+    return BatchLayout(
+        n_pad=n_pad,
+        e_pad=e_pad,
+        g_pad=g_pad,
+        head_types=head_types,
+        head_dims=head_dims,
+        need_triplets=need_triplets,
+        t_pad=t_pad,
+        need_neighbors=need_neighbors,
+        k_in=max(int(k_in), 1),
+        k_out=max(int(k_out), 1),
+    )
+
+
 def compute_layout(
     datasets: List[List[GraphData]],
     batch_size: int,
@@ -308,35 +356,12 @@ def compute_layout(
         )
 
     def build_budget(mask) -> BatchLayout:
-        """Bucket layout sized at ``batch_size x bucket MEAN`` (not max):
-        the loader packs graphs greedily under these budgets, so every
-        batch fits by construction and padding waste is the distance from
-        the budget to the last graph that did not fit, not max-vs-mean.
-        ``g_pad`` allows however many of the bucket's smallest graphs fit
-        the node budget."""
-        mn, me = nodes[mask], edges[mask]
-        mt = trips_n[mask]
-        n_budget = int(max(batch_size * float(mn.mean()), mn.max()) + 1)
-        e_budget = int(max(batch_size * float(me.mean()), me.max(), 1))
-        n_pad = _round_up(n_budget, mult)
-        e_pad = _round_up(e_budget, mult)
-        g_cap = max(batch_size, n_pad // max(int(mn.min()), 1))
-        g_pad = _round_up(g_cap + 1, max(device_multiple, 1))
-        t_pad = 0
-        if need_triplets and not need_neighbors:
-            t_budget = int(max(batch_size * float(mt.mean()), mt.max(), 1))
-            t_pad = _round_up(t_budget, mult)
-        return BatchLayout(
-            n_pad=n_pad,
-            e_pad=e_pad,
-            g_pad=g_pad,
-            head_types=head_types,
-            head_dims=head_dims,
-            need_triplets=need_triplets,
-            t_pad=t_pad,
-            need_neighbors=need_neighbors,
-            k_in=max(int(kis[mask].max()) if len(kis) else 1, 1),
-            k_out=max(int(kos[mask].max()) if len(kos) else 1, 1),
+        return budget_bucket_layout(
+            nodes[mask], edges[mask], trips_n[mask],
+            batch_size, mult, device_multiple, head_types, head_dims,
+            need_triplets, need_neighbors,
+            k_in=int(kis[mask].max()) if len(kis) else 1,
+            k_out=int(kos[mask].max()) if len(kos) else 1,
         )
 
     everything = np.ones(len(nodes), bool)
@@ -519,7 +544,9 @@ class GraphLoader:
         self.num_shards = world if num_shards is None else num_shards
         self.shard_id = rank if shard_id is None else shard_id
         if prefetch is None:
-            prefetch = int(os.getenv("HYDRAGNN_PREFETCH", "0"))
+            # validated parse: a typo'd HYDRAGNN_PREFETCH must name the
+            # variable, not raise a bare int() ValueError mid-construction
+            prefetch = env_int("HYDRAGNN_PREFETCH", 0)
         self.prefetch = prefetch
         self._plan_cache = None  # (epoch, plan) — packing is O(dataset)
         # contiguous_buckets: shuffle samples within buckets and the ORDER
@@ -763,7 +790,7 @@ class GraphLoader:
         # + sched_setaffinity design (``load_data.py:94-204``, worker_init
         # ``:118-154``). Matters on many-core TPU-VM hosts feeding
         # multiple processes; pointless on a 1-core box.
-        workers = int(os.getenv("HYDRAGNN_NUM_WORKERS", "1"))
+        workers = env_int("HYDRAGNN_NUM_WORKERS", 1)
         if workers > 1:
             yield from prefetch_iter(
                 self._batch_tasks(),
@@ -835,7 +862,8 @@ def _affinity_places():
 
 
 def prefetch_iter(
-    source, depth: int, fn=None, name: str = "prefetch", workers: int = 1
+    source, depth: int, fn=None, name: str = "prefetch", workers: int = 1,
+    probe=None,
 ):
     """Bounded background pipeline stage: applies ``fn`` (identity if
     None) to each item of ``source`` on worker thread(s), up to ``depth``
@@ -844,6 +872,11 @@ def prefetch_iter(
     ``workers > 1`` fans ``fn`` over an ordered thread pool (the
     reference HydraDataLoader's num_workers model); each worker pins to
     its OMP_PLACES place when ``HYDRAGNN_AFFINITY=1``.
+
+    ``probe``, when given, is called with the queue depth (ready items
+    ahead of the consumer) at every consumer-side get — the streaming
+    telemetry's ``stream_queue_depth`` gauge feed. Single-worker path
+    only; the pool path's in-flight window is not a readiness signal.
 
     Shared by the loader's collation prefetch and the trainer's
     double-buffered device transfers. The shutdown protocol matters: puts
@@ -893,6 +926,8 @@ def prefetch_iter(
     t.start()
     try:
         while True:
+            if probe is not None:
+                probe(q.qsize())
             item = q.get()
             if item is sentinel:
                 break
@@ -986,9 +1021,7 @@ def create_dataloaders(
     ``steps_per_dispatch`` can stack them (env override parsed inside
     ``GraphLoader``). ``HYDRAGNN_BATCH_BUCKETS`` overrides whatever the
     caller passes — the ONE place that env var's precedence lives."""
-    num_buckets = int(
-        os.getenv("HYDRAGNN_BATCH_BUCKETS", str(num_buckets or 1))
-    )
+    num_buckets = env_int("HYDRAGNN_BATCH_BUCKETS", num_buckets or 1, minimum=1)
     layout = compute_layout(
         [trainset, valset, testset],
         batch_size,

@@ -151,6 +151,34 @@ class ShardWriter:
                 )
 
 
+def read_pack_sample(r: PackReader, i: int) -> GraphData:
+    """Decode one sample out of an open :class:`PackReader` — THE gpk
+    sample wire format, shared by :class:`ShardDataset` and the streaming
+    shard source (``hydragnn_tpu/data/stream/source.py``) so the two
+    paths cannot diverge."""
+    d = GraphData()
+    d.x = r.read("x", i)
+    if "pos" in r.vars:
+        d.pos = r.read("pos", i)
+    d.edge_index = r.read("edge_index", i).T
+    if "edge_attr" in r.vars:
+        d.edge_attr = r.read("edge_attr", i)
+    if "y" in r.vars:
+        d.y = r.read("y", i).ravel()
+    if "supercell_size" in r.vars:
+        d.supercell_size = r.read("supercell_size", i).reshape(3, 3)
+    ih = 0
+    d.target_types = []
+    while f"target{ih}" in r.vars:
+        t = r.read(f"target{ih}", i)
+        # variable-dim target vars (dims[0] == -1) are node heads
+        is_node = r.vars[f"target{ih}"][2][0] == -1
+        d.targets.append(t if is_node else t.reshape(-1))
+        d.target_types.append("node" if is_node else "graph")
+        ih += 1
+    return d
+
+
 class ShardDataset:
     """Reads every shard under ``label/``; presents a flat global index.
 
@@ -233,27 +261,7 @@ class ShardDataset:
     def _get_once(self, idx: int) -> GraphData:
         faults.flaky_read(f"{self.label}[{idx}]")
         r, i = self._locate(idx)
-        d = GraphData()
-        d.x = r.read("x", i)
-        if "pos" in r.vars:
-            d.pos = r.read("pos", i)
-        d.edge_index = r.read("edge_index", i).T
-        if "edge_attr" in r.vars:
-            d.edge_attr = r.read("edge_attr", i)
-        if "y" in r.vars:
-            d.y = r.read("y", i).ravel()
-        if "supercell_size" in r.vars:
-            d.supercell_size = r.read("supercell_size", i).reshape(3, 3)
-        ih = 0
-        d.target_types = []
-        while f"target{ih}" in r.vars:
-            t = r.read(f"target{ih}", i)
-            # variable-dim target vars (dims[0] == -1) are node heads
-            is_node = r.vars[f"target{ih}"][2][0] == -1
-            d.targets.append(t if is_node else t.reshape(-1))
-            d.target_types.append("node" if is_node else "graph")
-            ih += 1
-        return d
+        return read_pack_sample(r, i)
 
     def __getitem__(self, idx: int) -> GraphData:
         if self.subset is not None:

@@ -74,6 +74,11 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # first optimizer step; recovery_s spans loss detection -> first step
     # (teardown + re-bootstrap + checkpoint restore + recompile)
     "world_resize": ("old_world", "new_world", "gen", "recovery_s"),
+    # streaming bucket planner (data/stream/planner.py): an auto-tuned
+    # bucket plan was built from a streamed size histogram — bounds are
+    # the inclusive node-count bucket boundaries, est_waste the simulated
+    # padding-waste ratio of the plan over the scanned samples
+    "bucket_plan": ("num_buckets", "bounds", "samples_scanned", "est_waste"),
     # HPO trial lifecycle (hpo/launcher.py trials.jsonl): status is
     # completed|failed|killed, reason names the failure/kill cause
     # (garbled_output, heartbeat_timeout, divergence, timeout, exit_<rc>)

@@ -157,6 +157,33 @@ class TrainingMetrics:
             "aggregation_kernel",
             "Chosen aggregation kernel family per bucket (1 = active)",
         )
+        # streaming data plane (data/stream/): per-epoch pipeline health
+        # — queue depth at last consumer get, seconds the step loop spent
+        # blocked on the data plane, ingestion bandwidth, and the
+        # shard-window residency high-waters the RAM bound rests on
+        r.gauge("stream_queue_depth", "Collated batches ready ahead of the consumer")
+        r.gauge(
+            "stream_stall_seconds",
+            "Seconds the consumer waited on the stream pipeline last epoch",
+        )
+        r.gauge("stream_bytes_per_second", "Streamed sample bytes/sec last epoch")
+        r.gauge(
+            "stream_open_shards_peak",
+            "Most shards any source held resident at once",
+        )
+        r.gauge(
+            "stream_resident_bytes_peak",
+            "Peak host bytes pinned by stream window buffers",
+        )
+        r.counter("stream_samples_total", "Samples drawn from the stream mix")
+        r.counter(
+            "stream_oversize_dropped_total",
+            "Samples dropped because no bucket of the plan could hold them",
+        )
+        r.labeled_gauge(
+            "stream_source_fraction",
+            "Fraction of last epoch's draws per mix source",
+        )
         # live device memory, polled from device 0's memory_stats() at
         # scrape time (stays 0 on backends that report none, e.g. CPU)
         r.gauge("device_bytes_in_use", "Live device memory in use")
@@ -647,6 +674,41 @@ def checkpoint_restored(name: str, source: str):
     if t is None:
         return
     t.emit("checkpoint_restored", name=name, source=source)
+
+
+def stream_epoch_stats(
+    queue_depth: int = 0,
+    stall_s: float = 0.0,
+    bytes_per_sec: float = 0.0,
+    open_shards_peak: int = 0,
+    resident_bytes_peak: int = 0,
+    samples: int = 0,
+    oversize_dropped: int = 0,
+    source_counts: Optional[Dict[str, int]] = None,
+):
+    """One epoch of the streaming data plane completed (data/stream/):
+    refresh the ``stream_*`` gauge family. No event — the epoch event
+    already carries the loss/throughput story; these are live-health
+    series."""
+    t = _active
+    if t is None:
+        return
+    r = t.metrics.registry
+    r.set("stream_queue_depth", float(queue_depth))
+    r.set("stream_stall_seconds", float(stall_s))
+    r.set("stream_bytes_per_second", float(bytes_per_sec))
+    r.set("stream_open_shards_peak", float(open_shards_peak))
+    r.set("stream_resident_bytes_peak", float(resident_bytes_peak))
+    if samples:
+        r.inc("stream_samples_total", int(samples))
+    if oversize_dropped:
+        r.inc("stream_oversize_dropped_total", int(oversize_dropped))
+    if source_counts:
+        total = max(sum(source_counts.values()), 1)
+        for name, n in source_counts.items():
+            r.set_labeled(
+                "stream_source_fraction", n / total, source=name
+            )
 
 
 def world_resized(old_world: int, new_world: int, gen: int,

@@ -164,11 +164,29 @@ def run_training_impl(config):
     tr.initialize()
     verbosity = config.get("Verbosity", {}).get("level", 0)
 
-    train_loader, val_loader, test_loader = dataset_loading_and_splitting(config)
-    config = update_config(config, train_loader, val_loader, test_loader)
-    train_loader, val_loader, test_loader = make_partitioned_loaders(
-        config, train_loader, val_loader, test_loader
+    from hydragnn_tpu.data.stream import (
+        build_stream_loaders,
+        streaming_requested,
     )
+
+    probe_loader = None
+    if streaming_requested(config):
+        # streaming data plane (docs/data.md): the train split never
+        # materializes — config derivation (output dims, PNA degrees,
+        # graph-size variability) runs over a cursor-neutral probe window
+        # instead of the whole dataset
+        train_loader, val_loader, test_loader, probe_loader = (
+            build_stream_loaders(config)
+        )
+        config = update_config(config, probe_loader, val_loader, test_loader)
+    else:
+        train_loader, val_loader, test_loader = (
+            dataset_loading_and_splitting(config)
+        )
+        config = update_config(config, train_loader, val_loader, test_loader)
+        train_loader, val_loader, test_loader = make_partitioned_loaders(
+            config, train_loader, val_loader, test_loader
+        )
     log_name = get_log_name_config(config)
     setup_log(log_name)
     save_config(config, log_name)
@@ -176,11 +194,18 @@ def run_training_impl(config):
     # live /metrics+/healthz endpoint when HYDRAGNN_OBS_PORT or
     # config["Telemetry"]["port"] opts in; HYDRAGNN_TELEMETRY=0 disables
     telemetry = obs.init_run_telemetry(config, log_name)
+    if getattr(train_loader, "plan_event", None):
+        # the bucket plan was built before telemetry existed; land its
+        # record now that the event stream is live
+        obs.emit("bucket_plan", **train_loader.plan_event)
 
     writer = None
     try:
+        # the streaming train loader's __iter__ advances the mix cursor —
+        # the probe loader (same layout, materialized window) feeds
+        # init_state's example batch instead
         model, trainer, state = _build_model_and_trainer(
-            config, train_loader, verbosity
+            config, probe_loader or train_loader, verbosity
         )
 
         training = config["NeuralNetwork"]["Training"]

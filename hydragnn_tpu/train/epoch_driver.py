@@ -44,15 +44,22 @@ _FIT_SCHED_FIELDS = (
 )
 
 
-def _build_train_meta(epoch, rng, scheduler, early, ckpt, guard, sched=None):
+def _build_train_meta(epoch, rng, scheduler, early, ckpt, guard, sched=None,
+                      stream=None):
     """Checkpoint-v2 training-loop state: everything a preempted job needs
-    to resume at epoch ``epoch + 1`` instead of epoch 0."""
+    to resume at epoch ``epoch + 1`` instead of epoch 0. ``stream`` is
+    the streaming loader's mix cursor (data/stream/mix.py) — present only
+    on streaming runs, it pins per-source shard/offset positions so the
+    resumed run draws the exact sample sequence the uninterrupted run
+    would have."""
     meta = {
         "format": 2,
         "epoch": int(epoch),
         "rng": np.asarray(rng),
         "plateau": scheduler.state_dict(),
     }
+    if stream is not None:
+        meta["stream"] = stream
     if early is not None:
         meta["early"] = early.state_dict()
     if ckpt is not None:
@@ -148,6 +155,12 @@ def train_validate_test(
     # Drained at end of run (and by the elastic watchdog on preemption).
     ckpt_writer = resolve_async_writer(training)
 
+    def _stream_state():
+        """Streaming loaders expose their mix cursor; everything else
+        contributes no ``stream`` section to the resume meta."""
+        sd = getattr(train_loader, "state_dict", None)
+        return sd() if callable(sd) else None
+
     # the driver's end-of-run save reuses the newest loop state; seed it
     # with the incoming meta so a continue-of-a-finished-run (no epochs
     # left) does not strip resume state from the checkpoint.
@@ -178,6 +191,13 @@ def train_validate_test(
                 "nothing left to train",
             )
             start_epoch = num_epoch
+        if resume_meta.get("stream") is not None and hasattr(
+            train_loader, "load_state_dict"
+        ):
+            # restore the streaming mix cursor BEFORE the first epoch so
+            # the resumed run draws the exact sample sequence the
+            # uninterrupted one would have (bitwise-identical trajectory)
+            train_loader.load_state_dict(resume_meta["stream"])
         print_distributed(
             verbosity,
             f"Resuming training at epoch {start_epoch} "
@@ -455,7 +475,7 @@ def train_validate_test(
                     early.early_stop = bool(np.asarray(sched.stopped))
                 fit_meta = _build_train_meta(
                     epoch0 - 1, rng, scheduler, early, ckpt, guard,
-                    sched=sched,
+                    sched=sched, stream=_stream_state(),
                 )
                 save_model(
                     state, log_name, checkpoint_path,
@@ -600,7 +620,10 @@ def train_validate_test(
             or stopping
             or epoch == num_epoch - 1
         ):
-            meta = _build_train_meta(epoch, rng, scheduler, early, ckpt, guard)
+            meta = _build_train_meta(
+                epoch, rng, scheduler, early, ckpt, guard,
+                stream=_stream_state(),
+            )
             save_model(
                 state, log_name, checkpoint_path,
                 train_meta=meta, keep_last=keep_last,
@@ -623,7 +646,8 @@ def train_validate_test(
             # matters — save one even off the resume_every cadence
             if resume_every > 0 and not trainer.final_state_saved:
                 meta = _build_train_meta(
-                    epoch, rng, scheduler, early, ckpt, guard
+                    epoch, rng, scheduler, early, ckpt, guard,
+                    stream=_stream_state(),
                 )
                 save_model(
                     state, log_name, checkpoint_path,

@@ -78,11 +78,11 @@ class Trainer(PredictMixin):
         self._stacked_sharding = None
         # one dispatch runs this many optimizer steps via lax.scan (1 = the
         # plain per-batch path); settable in config or HYDRAGNN_STEPS_PER_DISPATCH
-        self.steps_per_dispatch = int(
-            os.getenv(
-                "HYDRAGNN_STEPS_PER_DISPATCH",
-                str(training_config.get("steps_per_dispatch", 1)),
-            )
+        from hydragnn_tpu.utils.envparse import env_int
+
+        self.steps_per_dispatch = env_int(
+            "HYDRAGNN_STEPS_PER_DISPATCH",
+            int(training_config.get("steps_per_dispatch", 1)),
         )
         # streaming double-buffering: keep this many batches' H2D transfers
         # in flight AHEAD of the step consuming them, issued from a
@@ -94,11 +94,9 @@ class Trainer(PredictMixin):
         # (0.64x); jax's async dispatch already overlaps transfer and
         # compute when the host link is not the bottleneck. Enable on
         # production TPU-VM hosts via config or HYDRAGNN_DEVICE_PREFETCH.
-        self.device_prefetch = int(
-            os.getenv(
-                "HYDRAGNN_DEVICE_PREFETCH",
-                str(training_config.get("device_prefetch", 0)),
-            )
+        self.device_prefetch = env_int(
+            "HYDRAGNN_DEVICE_PREFETCH",
+            int(training_config.get("device_prefetch", 0)),
         )
         # divergence guard (train/guard.py): skip non-finite steps, restore
         # last-good with halved LR after N consecutive bad ones. Opt-in —

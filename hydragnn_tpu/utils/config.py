@@ -25,7 +25,10 @@ def update_config(config, train_loader, val_loader, test_loader):
     else:
         graph_size_variable = bool(int(env))
 
-    if "Dataset" in config:
+    ds = config.get("Dataset", {})
+    if "graph_features" in ds or "node_features" in ds:
+        # a Dataset section without declared feature dims (e.g. one that
+        # only carries the `streaming` spec) has nothing to cross-check
         check_output_dim_consistent(train_loader.dataset[0], config)
 
     config["NeuralNetwork"] = update_config_NN_outputs(

@@ -1,0 +1,33 @@
+"""Validated ``HYDRAGNN_*`` environment-knob parsing.
+
+Every numeric env knob routes through here so a typo'd value fails with
+an error naming the VARIABLE and the offending text, not a bare
+``ValueError: invalid literal for int()`` from deep inside a loader
+thread (where the traceback points at the queue machinery, not at the
+shell line that caused it).
+"""
+
+import os
+from typing import Optional
+
+
+def env_int(
+    name: str, default: int, minimum: Optional[int] = 0
+) -> int:
+    """Integer env knob: unset/empty -> ``default``; non-integer or
+    below-``minimum`` values raise a ``ValueError`` that names the
+    variable. ``minimum=None`` skips the range check."""
+    raw = os.getenv(name)
+    if raw is None or raw.strip() == "":
+        return int(default)
+    try:
+        value = int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r}"
+        ) from None
+    if minimum is not None and value < minimum:
+        raise ValueError(
+            f"{name} must be >= {minimum}, got {value}"
+        )
+    return value

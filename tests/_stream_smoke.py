@@ -1,0 +1,287 @@
+"""CI streaming smoke driver (NOT a pytest module).
+
+Usage: ``python tests/_stream_smoke.py <outdir>``
+
+Exercises the streaming data plane end to end in subprocesses:
+
+1. an UNINTERRUPTED 4-epoch run of a two-source weighted mix through the
+   real epoch driver, telemetry active — records per-epoch stream
+   cursors, final params digest, and leaves a schema-checked
+   ``events.jsonl`` carrying the auto-tuned ``bucket_plan`` event;
+2. the same run HARD-KILLED mid-epoch-2 (``HYDRAGNN_FAULT_KILL_AT_STEP``),
+   leaving only the fsync'd checkpoint with the stream cursor in its
+   ``train_meta``;
+3. a resume from that checkpoint — the orchestrator asserts the saved
+   cursor equals the uninterrupted run's post-epoch-1 cursor (cursor
+   equality) and the resumed final params match the uninterrupted run's
+   BITWISE (trajectory equality).
+
+(Underscore-prefixed: a driver script; the pytest twin with the
+in-process variants is tests/test_stream.py.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "")
+     + " --xla_force_host_platform_device_count=1").strip(),
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+NUM_EPOCH = 4
+LOG_NAME = "streamsmoke"
+KILL_STEP = 20  # ~8 batches/epoch at 32 samples, bs 4 -> mid-epoch-2
+
+
+def make_varied(num, seed, n_lo=4, n_hi=20):
+    """make_samples with VARIABLE graph sizes — the two sources must
+    spread the size histogram or the bucket planner degenerates to one
+    bucket and the smoke stops exercising mixed-shape streaming."""
+    import numpy as np
+
+    from hydragnn_tpu.data.dataobj import GraphData
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        n = int(rng.integers(n_lo, n_hi + 1))
+        g = GraphData()
+        g.x = rng.random((n, 1)).astype(np.float32)
+        g.pos = rng.random((n, 3)).astype(np.float32)
+        src = np.arange(n)
+        dst = (src + 1) % n
+        g.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        g.targets = [np.array([g.x.sum()], np.float32), g.x.copy()]
+        g.target_types = ["graph", "node"]
+        out.append(g)
+    return out
+
+
+def build(num_epoch):
+    from _resilience_worker import make_samples
+
+    from hydragnn_tpu.data.loaders import GraphLoader
+    from hydragnn_tpu.data.stream import (
+        BucketPlanner,
+        ListSource,
+        StreamLoader,
+        WeightedMix,
+    )
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {"num_headlayers": 1, "dim_headlayers": [8],
+                     "type": "mlp"},
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {
+        "num_epoch": num_epoch,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "resume_every": 1,
+        "checkpoint_keep_last": 3,
+    }
+    src_a = ListSource(make_samples(40, seed=1), shard_size=8, name="qm9ish")
+    src_b = ListSource(
+        make_varied(60, seed=2, n_lo=8, n_hi=24), shard_size=8,
+        name="oc20ish",
+    )
+    mix = WeightedMix(
+        [src_a, src_b], [2.0, 1.0], seed=7, samples_per_epoch=32,
+        num_shards=1, shard_id=0, window=2,
+    )
+    layout = BucketPlanner(mix.sources, batch_size=4, num_buckets=2).plan()
+    train_loader = StreamLoader(mix, 4, layout)
+    evals = make_samples(8, seed=30)
+    val_loader = GraphLoader(evals[:4], 4, layout, shuffle=False,
+                             num_shards=1, shard_id=0)
+    test_loader = GraphLoader(evals[4:], 4, layout, shuffle=False,
+                              num_shards=1, shard_id=0)
+    model = create_model_config(arch)
+    trainer = Trainer(model, training)
+    state = trainer.init_state(train_loader.example_batch(), seed=0)
+    return trainer, state, (train_loader, val_loader, test_loader), training
+
+
+def worker(workdir, mode):
+    os.chdir(workdir)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from hydragnn_tpu.obs import runtime as obs_rt
+    from hydragnn_tpu.train.checkpoint import (
+        checkpoint_exists,
+        load_state_dict,
+        pop_train_meta,
+        restore_into,
+    )
+    from hydragnn_tpu.train.epoch_driver import train_validate_test
+
+    telem = obs_rt.activate(
+        obs_rt.RunTelemetry(LOG_NAME, os.path.join("logs", LOG_NAME))
+    )
+    trainer, state, loaders, training = build(NUM_EPOCH)
+    train_loader = loaders[0]
+
+    resume_meta = None
+    if mode == "resume":
+        if not checkpoint_exists(LOG_NAME):
+            raise FileNotFoundError("resume requested but no checkpoint")
+        restored = load_state_dict(LOG_NAME)
+        resume_meta = pop_train_meta(restored)
+        state = trainer.place_state(restore_into(state, restored))
+
+    # capture the stream cursor after every trained epoch (the full run's
+    # trace is the killed run's cursor-equality reference)
+    cursors = []
+    orig = trainer.train_epoch
+
+    def tracing_train_epoch(state, loader, rng):
+        out = orig(state, loader, rng)
+        cursors.append({"epoch": loader.epoch,
+                        "cursor": loader.state_dict()})
+        return out
+
+    trainer.train_epoch = tracing_train_epoch
+
+    config_nn = {
+        "Training": training,
+        "Variables_of_interest": {"output_names": ["sum", "x"]},
+    }
+    state = train_validate_test(
+        trainer, state, *loaders, config_nn, LOG_NAME, verbosity=0,
+        resume_meta=resume_meta,
+    )
+    obs_rt.deactivate()
+
+    result = {
+        "mode": mode,
+        "cursors": cursors,
+        "padding": train_loader.epoch_padding_stats(),
+        "residency": train_loader.mix.residency_stats(),
+        "final_params": [
+            np.asarray(leaf, np.float64).tolist()
+            for leaf in jax.tree_util.tree_leaves(
+                jax.device_get(state.params)
+            )
+        ],
+    }
+    with open("result.json", "w") as f:
+        json.dump(result, f)
+
+
+def _run_worker(workdir, mode, extra_env=None):
+    os.makedirs(workdir, exist_ok=True)
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "worker",
+         os.path.abspath(workdir), mode],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def main(outdir):
+    os.makedirs(outdir, exist_ok=True)
+
+    # phase 1: uninterrupted reference (telemetry + bucket_plan event)
+    full = _run_worker(os.path.join(outdir, "full"), "run")
+    assert full.returncode == 0, full.stderr[-3000:]
+    ref = json.load(open(os.path.join(outdir, "full", "result.json")))
+    assert len(ref["cursors"]) == NUM_EPOCH
+
+    from hydragnn_tpu.obs.events import validate_events
+
+    events_path = os.path.join(
+        outdir, "full", "logs", LOG_NAME, "events.jsonl"
+    )
+    recs = validate_events(events_path, require=["bucket_plan", "epoch"])
+    plan = [r for r in recs if r["event"] == "bucket_plan"][0]
+    assert plan["num_buckets"] >= 1 and plan["samples_scanned"] > 0
+    print(f"bucket_plan event schema-valid: {plan['num_buckets']} buckets, "
+          f"est_waste {plan['est_waste']}")
+
+    # the RAM bound, asserted on the reference run's own accounting
+    res = ref["residency"]
+    assert res["open_shards_peak"] <= 2, res
+    print(f"residency bounded by window: {res}")
+
+    # phase 2: hard kill mid-epoch-2
+    killdir = os.path.join(outdir, "kill")
+    killed = _run_worker(
+        killdir, "run", {"HYDRAGNN_FAULT_KILL_AT_STEP": str(KILL_STEP)}
+    )
+    from hydragnn_tpu.utils import faults
+
+    assert killed.returncode == faults.KILL_EXIT_CODE, (
+        killed.returncode, killed.stderr[-3000:],
+    )
+    assert not os.path.exists(os.path.join(killdir, "result.json"))
+
+    # cursor equality: the killed run's checkpointed cursor == the
+    # uninterrupted run's post-epoch-1 cursor
+    from hydragnn_tpu.train.checkpoint import load_state_dict, pop_train_meta
+
+    restored = load_state_dict(
+        LOG_NAME, path=os.path.join(killdir, "logs")
+    )
+    meta = pop_train_meta(restored)
+    assert meta is not None and meta.get("stream") is not None
+
+    def canon(x):
+        if isinstance(x, dict):
+            return {k: canon(v) for k, v in x.items()}
+        try:
+            return int(x)
+        except (TypeError, ValueError):
+            return x
+
+    saved_epoch = int(meta["epoch"])
+    want = canon(ref["cursors"][saved_epoch]["cursor"])
+    got = canon(meta["stream"])
+    assert got == want, f"cursor mismatch:\n saved {got}\n ref   {want}"
+    print(f"kill->checkpoint cursor equals reference post-epoch-{saved_epoch}"
+          " cursor")
+
+    # phase 3: resume -> bitwise-identical final params
+    resumed = _run_worker(killdir, "resume")
+    assert resumed.returncode == 0, resumed.stderr[-3000:]
+    res_out = json.load(open(os.path.join(killdir, "result.json")))
+    assert res_out["final_params"] == ref["final_params"], (
+        "resumed trajectory diverged from uninterrupted run"
+    )
+    print("kill->resume final params bitwise-identical to uninterrupted run")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "worker":
+        worker(sys.argv[2], sys.argv[3])
+    else:
+        sys.exit(main(sys.argv[1]))

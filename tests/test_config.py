@@ -55,8 +55,14 @@ def pytest_example_config_schema(config_file):
     for key in ("batch_size", "num_epoch"):
         assert key in training, f"Missing Training.{key}"
     if "Dataset" in config:
-        for key in ("name", "format"):
-            assert key in config["Dataset"], f"Missing Dataset.{key}"
+        assert "name" in config["Dataset"], "Missing Dataset.name"
+        # streaming-only Dataset sections (docs/data.md) name their
+        # formats per source; `format` governs the raw->serialized path
+        if "streaming" not in config["Dataset"]:
+            assert "format" in config["Dataset"], "Missing Dataset.format"
+        else:
+            for src in config["Dataset"]["streaming"].get("sources", []):
+                assert "train" in src, "streaming source missing train path"
 
 
 class _Sample:

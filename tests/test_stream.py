@@ -1,0 +1,823 @@
+"""Streaming data plane (hydragnn_tpu/data/stream/): shard-granular
+sources, deterministic weighted mixing with checkpointable cursors,
+distributed window shuffle, the auto-tuned bucket planner, and the
+kill->resume + RAM-bound acceptance e2e."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _resilience_worker import make_samples  # noqa: E402
+from test_bucketed_layouts import _oc20_shaped  # noqa: E402
+
+from hydragnn_tpu.data.loaders import (  # noqa: E402
+    BucketedLayout,
+    GraphLoader,
+    compute_layout,
+)
+from hydragnn_tpu.data.stream import (  # noqa: E402
+    BucketPlanner,
+    ExtxyzSource,
+    ListSource,
+    MPTrjSource,
+    QM9RawSource,
+    ShardStoreSource,
+    StreamLoader,
+    WeightedMix,
+    sample_nbytes,
+)
+
+
+def _mix(seed=7, world=1, rank=0, window=2, weights=(2.0, 1.0), n=(40, 60),
+         samples_per_epoch=None):
+    a = ListSource(make_samples(n[0], seed=1), shard_size=8, name="a")
+    b = ListSource(make_samples(n[1], seed=2), shard_size=8, name="b")
+    return WeightedMix(
+        [a, b], list(weights), seed=seed, num_shards=world, shard_id=rank,
+        window=window, samples_per_epoch=samples_per_epoch,
+    )
+
+
+def _stream_loader(**kw):
+    mix = _mix(**kw)
+    planner = BucketPlanner(mix.sources, batch_size=4, num_buckets=2)
+    return StreamLoader(mix, 4, planner.plan(emit=False))
+
+
+# ---- sources --------------------------------------------------------------
+
+
+def pytest_shard_store_source_matches_shard_dataset(tmp_path):
+    """Lazy shard reads decode byte-identically to the materialized
+    ShardDataset path (shared read_pack_sample), and the index-only size
+    scan matches real sample sizes."""
+    from hydragnn_tpu.data.shard_store import ShardDataset, ShardWriter
+
+    samples = make_samples(20, seed=3)
+    label = str(tmp_path / "store")
+    w0 = ShardWriter(label, rank=0)
+    w0.add(samples[:12])
+    w0.save()
+    w1 = ShardWriter(label, rank=1)
+    w1.add(samples[12:])
+    w1.save()
+
+    src = ShardStoreSource(label)
+    ds = ShardDataset(label)
+    assert src.num_shards() == 2
+    assert src.num_samples() == 20 == len(ds)
+    got = src.read_shard(0) + src.read_shard(1)
+    for d_stream, d_mat in zip(got, ds):
+        np.testing.assert_array_equal(d_stream.x, d_mat.x)
+        np.testing.assert_array_equal(d_stream.edge_index, d_mat.edge_index)
+        for t1, t2 in zip(d_stream.targets, d_mat.targets):
+            np.testing.assert_array_equal(t1, t2)
+    nodes, edges = src.size_scan()
+    np.testing.assert_array_equal(
+        nodes, [d.num_nodes for d in samples]
+    )
+    np.testing.assert_array_equal(
+        edges, [d.num_edges for d in samples]
+    )
+    ds.close()
+
+
+def _periodic_frames(num, seed=0):
+    """Small periodic cells (some spanning the boundary) with energies +
+    forces — extxyz round-trippable."""
+    rng = np.random.default_rng(seed)
+    frames = []
+    for _ in range(num):
+        n = int(rng.integers(4, 9))
+        # cell > 2 x cutoff on every axis: the PBC builder's duplicate-
+        # image guard must stay quiet while boundary pairs still connect
+        cell = np.diag(rng.uniform(6.5, 8.0, 3))
+        pos = rng.uniform(0, 1, (n, 3)) @ cell
+        frames.append(
+            {
+                "z": np.full(n, 6, np.int64),
+                "pos": pos,
+                "cell": cell,
+                "pbc": np.array([True, True, True]),
+                "info": {"energy": float(rng.normal())},
+                "arrays": {"forces": rng.normal(size=(n, 3))},
+            }
+        )
+    return frames
+
+
+def pytest_extxyz_stream_pbc_matches_materialized(tmp_path):
+    """Satellite: a periodic cell spanning two STREAMED shards produces
+    the same neighbor lists as the materialized path — on-the-fly PBC
+    radius graphs in the builder stage are bit-equal to
+    ``frame_to_graph``'s."""
+    from hydragnn_tpu.data.extxyz import load_extxyz_dir, write_extxyz
+
+    frames = _periodic_frames(8, seed=5)
+    d = tmp_path / "xyz"
+    d.mkdir()
+    # the dataset splits across two shard FILES mid-trajectory
+    write_extxyz(str(d / "a.extxyz"), frames[:4])
+    write_extxyz(str(d / "b.extxyz"), frames[4:])
+
+    materialized = load_extxyz_dir(str(d), radius=3.0, max_neighbours=12)
+    src = ExtxyzSource(dirpath=str(d), radius=3.0, max_neighbours=12)
+    streamed = []
+    for i in range(src.num_shards()):
+        for s in src.read_shard(i):
+            streamed.append(src.graph_builder(s))
+    assert len(streamed) == len(materialized) == 8
+    for s, m in zip(streamed, materialized):
+        np.testing.assert_array_equal(s.edge_index, m.edge_index)
+        np.testing.assert_allclose(s.edge_attr, m.edge_attr, rtol=0, atol=0)
+        np.testing.assert_array_equal(s.x, m.x)
+        for t1, t2 in zip(s.targets, m.targets):
+            np.testing.assert_array_equal(t1, t2)
+        assert s.edge_index.shape[1] > 0  # PBC edges actually formed
+
+
+def pytest_mptrj_source_matches_load_mptrj(tmp_path):
+    from hydragnn_tpu.data.mptrj import load_mptrj, write_mptrj_json
+
+    rng = np.random.default_rng(11)
+    records = []
+    for i in range(6):
+        n = int(rng.integers(3, 7))
+        records.append(
+            {
+                "mp_id": f"mp-{i}",
+                "frame_id": f"{i}_0_{i}",
+                "z": rng.integers(1, 30, n),
+                "pos": rng.uniform(0, 4, (n, 3)),
+                "lattice": np.eye(3) * 8.0,
+                "energy": float(rng.normal()),
+                "forces": rng.normal(size=(n, 3)),
+            }
+        )
+    path = str(tmp_path / "mptrj.json")
+    write_mptrj_json(path, records)
+
+    materialized = load_mptrj(path, radius=3.0, max_neighbours=10)
+    src = MPTrjSource(path, entries_per_shard=2, radius=3.0, max_neighbours=10)
+    assert src.num_shards() == 3
+    streamed = []
+    for i in range(3):
+        for s in src.read_shard(i):
+            streamed.append(src.graph_builder(s))
+    assert len(streamed) == len(materialized)
+    for s, m in zip(streamed, materialized):
+        np.testing.assert_array_equal(s.edge_index, m.edge_index)
+        np.testing.assert_allclose(s.x, m.x, rtol=0, atol=0)
+        for t1, t2 in zip(s.targets, m.targets):
+            np.testing.assert_array_equal(t1, t2)
+
+
+def pytest_qm9_source_matches_dataset(tmp_path):
+    from hydragnn_tpu.data.qm9_raw import QM9RawDataset, write_qm9_sdf
+
+    rng = np.random.default_rng(4)
+    mols = []
+    for _ in range(10):
+        n = int(rng.integers(3, 6))
+        syms = ["C"] * n
+        mols.append((syms, rng.uniform(0, 3, (n, 3))))
+    targets = rng.normal(size=(10, 19))
+    write_qm9_sdf(str(tmp_path), mols, targets, skips=[2])
+
+    materialized = QM9RawDataset(str(tmp_path), radius=3.0, max_neighbours=4)
+    src = QM9RawSource(
+        str(tmp_path), molecules_per_shard=4, radius=3.0, max_neighbours=4
+    )
+    assert src.num_shards() == 3
+    assert src.num_samples() == 9  # one skipped
+    streamed = []
+    for i in range(3):
+        for s in src.read_shard(i):
+            streamed.append(src.graph_builder(s))
+    assert len(streamed) == len(materialized) == 9
+    for s, m in zip(streamed, materialized):
+        np.testing.assert_allclose(s.x, m.x)
+        np.testing.assert_array_equal(s.edge_index, m.edge_index)
+        np.testing.assert_allclose(s.targets[0], m.targets[0])
+
+
+# ---- mix determinism / weights / distribution -----------------------------
+
+
+def pytest_mix_deterministic_and_weighted():
+    seq1 = [(k, d.x.tobytes()) for k, d in _mix(seed=9)]
+    seq2 = [(k, d.x.tobytes()) for k, d in _mix(seed=9)]
+    assert seq1 == seq2  # same seed -> bitwise-identical draw sequence
+    seq3 = [(k, d.x.tobytes()) for k, d in _mix(seed=10)]
+    assert seq1 != seq3
+    draws = np.bincount([k for k, _ in seq1], minlength=2)
+    frac = draws / draws.sum()
+    assert abs(frac[0] - 2 / 3) < 0.15, frac  # ~2:1 weighting
+
+
+def pytest_mix_epochs_advance_cursors():
+    """Sources cycle ACROSS epochs: two epochs of a 2:1 mix draw more
+    unique source-a samples than one epoch can cover of source b."""
+    mix = _mix(seed=3, samples_per_epoch=30)
+    seen_epoch0 = {d.x.tobytes() for _, d in mix}
+    mix.set_epoch(1)
+    seen_epoch1 = {d.x.tobytes() for _, d in mix}
+    # a fresh epoch continues the streams, it does not replay epoch 0
+    assert seen_epoch0 != seen_epoch1
+
+
+def pytest_mix_rank_partition():
+    """World-of-2 ranks draw equal counts from disjoint shard windows
+    (per-pass), and both derive the plan with no communication."""
+    r0 = [(k, d.x.tobytes()) for k, d in _mix(world=2, rank=0)]
+    r1 = [(k, d.x.tobytes()) for k, d in _mix(world=2, rank=1)]
+    assert len(r0) == len(r1) == 50  # ceil(100 / 2)
+    # within the first pass the two ranks' sample sets are disjoint
+    first0 = {x for _, x in r0[:20]}
+    first1 = {x for _, x in r1[:20]}
+    assert not (first0 & first1)
+
+
+def pytest_mix_weight_validation():
+    a = ListSource(make_samples(8, seed=1), shard_size=4, name="a")
+    with pytest.raises(ValueError, match="weights"):
+        WeightedMix([a], [0.0], num_shards=1, shard_id=0)
+    with pytest.raises(ValueError, match="weights"):
+        WeightedMix([a], [1.0, 2.0], num_shards=1, shard_id=0)
+
+
+def pytest_mix_schema_mismatch_raises():
+    a = ListSource(make_samples(8, seed=1), shard_size=4, name="a")
+    bad = make_samples(8, seed=2)
+    for d in bad:
+        d.targets = [d.targets[0]]
+        d.target_types = ["graph"]  # drops the node head
+    b = ListSource(bad, shard_size=4, name="b")
+    mix = WeightedMix([a, b], seed=1, num_shards=1, shard_id=0)
+    with pytest.raises(ValueError, match="head schema"):
+        for _ in mix:
+            pass
+
+
+# ---- cursor resume --------------------------------------------------------
+
+
+def pytest_cursor_resume_replays_bitwise():
+    """Restoring the epoch-boundary cursor into a FRESH pipeline replays
+    the next epoch's batch stream bitwise — the resume contract the
+    checkpoint meta relies on."""
+    L1 = _stream_loader(seed=7)
+    L1.set_epoch(0)
+    for _ in L1:
+        pass
+    cursor = L1.state_dict()
+    L1.set_epoch(1)
+    ep1 = [b.x.copy() for b in L1]
+
+    L2 = _stream_loader(seed=7)
+    L2.load_state_dict(cursor)
+    L2.set_epoch(1)
+    ep1b = [b.x.copy() for b in L2]
+    assert len(ep1) == len(ep1b)
+    for x, y in zip(ep1, ep1b):
+        np.testing.assert_array_equal(x, y)
+
+
+def pytest_cursor_seed_mismatch_refused():
+    L1 = _stream_loader(seed=7)
+    sd = L1.state_dict()
+    L2 = _stream_loader(seed=8)
+    with pytest.raises(ValueError, match="seed"):
+        L2.load_state_dict(sd)
+
+
+def pytest_cursor_window_mismatch_refused():
+    """A changed shard window silently changes the data order — refused
+    like a seed mismatch."""
+    m1 = _mix(seed=7, window=2)
+    sd = m1.state_dict()
+    m2 = _mix(seed=7, window=3)
+    with pytest.raises(ValueError, match="window"):
+        m2.load_state_dict(sd)
+
+
+def pytest_cursor_world_resize_rederives():
+    """Elastic world resize: the cursor's rank partition no longer
+    exists — per-source positions re-derive (fresh), epoch is kept, and
+    no error blocks the recovery (PR 8 shard semantics)."""
+    m2 = _mix(seed=7, world=2, rank=0)
+    for _ in m2:
+        pass
+    sd = m2.state_dict()
+    assert any(
+        s["ptr"] or s["offset"] or s["passno"]
+        for s in sd["sources"].values()
+    )
+    m1 = _mix(seed=7, world=1, rank=0)
+    with pytest.warns(UserWarning, match="world"):
+        m1.load_state_dict(sd)
+    assert m1.epoch == m2.epoch
+    fresh = _mix(seed=7, world=1, rank=0)
+    assert m1.state_dict()["sources"] == fresh.state_dict()["sources"]
+
+
+def pytest_cursor_msgpack_roundtrip(tmp_path):
+    """The cursor survives the checkpoint's msgpack train_meta format
+    (ints and string keys only)."""
+    from flax import serialization
+
+    L = _stream_loader(seed=7)
+    L.set_epoch(0)
+    for _ in L:
+        pass
+    sd = L.state_dict()
+    blob = serialization.msgpack_serialize(
+        serialization.to_state_dict(sd)
+    )
+    restored = serialization.msgpack_restore(blob)
+    L2 = _stream_loader(seed=7)
+    L2.load_state_dict(restored)
+    L.set_epoch(1)
+    L2.set_epoch(1)
+    for x, y in zip(L, L2):
+        np.testing.assert_array_equal(x.x, y.x)
+
+
+# ---- RAM residency bound --------------------------------------------------
+
+
+def pytest_window_bounds_host_residency():
+    """The acceptance RAM bound, asserted: the pipeline's peak buffered
+    bytes stay within the shard window's capacity — per source, window x
+    its largest shard — while the dataset is far larger."""
+    window = 2
+    mix = _mix(seed=13, window=window, n=(160, 240))
+    planner = BucketPlanner(mix.sources, batch_size=4, num_buckets=2)
+    loader = StreamLoader(mix, 4, planner.plan(emit=False))
+    loader.set_epoch(0)
+    for _ in loader:
+        pass
+    res = mix.residency_stats()
+    assert res["open_shards_peak"] <= window
+
+    def shard_bytes(src):
+        return max(
+            sum(sample_nbytes(d) for d in src.read_shard(i))
+            for i in range(src.num_shards())
+        )
+
+    capacity = sum(window * shard_bytes(s) for s in mix.sources)
+    total = sum(
+        sample_nbytes(d) for s in mix.sources for d in s.samples
+    )
+    assert res["resident_bytes_peak"] <= capacity
+    # the bound is meaningful: the whole dataset would not have fit it
+    assert total > capacity
+
+
+# ---- planner --------------------------------------------------------------
+
+
+def _hand_table(samples, batch_size, num_buckets):
+    """A plausible hand-written bucket table: equal-width node-count
+    bounds (what an operator eyeballing the histogram writes down)."""
+    from hydragnn_tpu.data.loaders import budget_bucket_layout, _lcm
+
+    nodes = np.array([d.num_nodes for d in samples])
+    edges = np.array([d.num_edges for d in samples])
+    lo, hi = int(nodes.min()), int(nodes.max())
+    step = max((hi - lo) // num_buckets, 1)
+    bounds = [min(lo + step * (i + 1), hi) for i in range(num_buckets - 1)]
+    bounds.append(hi)
+    bounds = sorted(set(bounds))
+    head_types = tuple(samples[0].target_types)
+    head_dims = tuple(
+        t.shape[-1] if t.ndim > 1 else t.shape[0]
+        for t in samples[0].targets
+    )
+    import jax
+
+    mult = _lcm(8, jax.device_count())
+    layouts, kept, prev = [], [], 0
+    for b in bounds:
+        mask = (nodes > prev) & (nodes <= b)
+        prev = b
+        if not mask.any():
+            continue
+        kept.append(b)
+        layouts.append(
+            budget_bucket_layout(
+                nodes[mask], edges[mask], np.zeros(int(mask.sum())),
+                batch_size, mult, jax.device_count(), head_types, head_dims,
+            )
+        )
+    return BucketedLayout(layouts=layouts, node_bounds=kept)
+
+
+def pytest_auto_plan_beats_hand_table_on_oc20_mix():
+    """Acceptance: on an OC20-shaped synthetic mix the auto-tuned plan's
+    padding waste (via the existing epoch_padding_stats accounting) is
+    <= both a hand-written equal-width bucket table and the single
+    max-sized layout."""
+    samples = _oc20_shaped(400, seed=21)
+    batch_size = 16
+
+    def measured_waste(layout):
+        loader = GraphLoader(
+            samples, batch_size, layout, shuffle=False, num_shards=1,
+            shard_id=0,
+        )
+        real, padded = loader.epoch_padding_stats()
+        return 1.0 - real / padded
+
+    src = ListSource(samples, shard_size=32, name="oc20")
+    planner = BucketPlanner([src], batch_size, num_buckets=4)
+    auto = planner.plan(emit=False)
+    assert isinstance(auto, BucketedLayout)
+
+    hand = _hand_table(samples, batch_size, num_buckets=4)
+    single = compute_layout([samples], batch_size)
+
+    w_auto = measured_waste(auto)
+    w_hand = measured_waste(hand)
+    w_single = measured_waste(single)
+    assert w_auto <= w_hand + 1e-9, (w_auto, w_hand)
+    assert w_auto < w_single, (w_auto, w_single)
+    # the planner's own estimate tracks the measured integrals
+    est = planner.estimate_waste(auto)
+    assert abs(est - w_auto) < 0.1, (est, w_auto)
+
+
+def pytest_bucket_plan_event_schema(tmp_path):
+    from hydragnn_tpu.obs import runtime as obs_rt
+    from hydragnn_tpu.obs.events import validate_events
+
+    src = ListSource(_oc20_shaped(60, seed=2), shard_size=16, name="oc20")
+    telem = obs_rt.activate(
+        obs_rt.RunTelemetry("plan", str(tmp_path / "logs"))
+    )
+    try:
+        BucketPlanner([src], batch_size=8, num_buckets=3).plan()
+    finally:
+        obs_rt.deactivate()
+    recs = validate_events(
+        str(tmp_path / "logs" / "events.jsonl"), require=["bucket_plan"]
+    )
+    plan = [r for r in recs if r["event"] == "bucket_plan"][0]
+    assert plan["num_buckets"] == len(plan["bounds"])
+    assert plan["samples_scanned"] == 60
+    assert 0.0 <= plan["est_waste"] < 1.0
+    assert plan["per_source"] == {"oc20": 60}
+
+
+def pytest_planner_size_scan_cap():
+    src = ListSource(_oc20_shaped(64, seed=3), shard_size=8, name="s")
+    planner = BucketPlanner([src], batch_size=8, num_buckets=2,
+                            plan_shards=2)
+    assert planner.scan()["nodes"].size == 16  # 2 shards x 8
+
+
+# ---- stream loader mechanics ----------------------------------------------
+
+
+def pytest_oversize_samples_dropped_warned_and_counted(tmp_path):
+    from hydragnn_tpu.obs import runtime as obs_rt
+
+    samples = make_samples(24, seed=5)
+    big = make_samples(1, seed=6)[0]
+    big.x = np.random.default_rng(0).random((4000, 1)).astype(np.float32)
+    big.edge_index = np.zeros((2, 1), np.int64)
+    big.targets = [np.array([1.0], np.float32), big.x.copy()]
+    big.target_types = ["graph", "node"]
+    src = ListSource(samples + [big], shard_size=8, name="a")
+    mix = WeightedMix([src], seed=1, num_shards=1, shard_id=0)
+    planner = BucketPlanner([src], batch_size=4, num_buckets=1,
+                            plan_shards=3)  # the scan never sees `big`
+    loader = StreamLoader(mix, 4, planner.plan(emit=False))
+    loader.set_epoch(0)
+    telem = obs_rt.activate(
+        obs_rt.RunTelemetry("ovs", str(tmp_path / "logs"), events=False)
+    )
+    try:
+        with pytest.warns(UserWarning, match="fit no bucket"):
+            n = sum(1 for _ in loader)
+        assert n > 0
+        assert loader._epoch_stats["oversize_dropped"] >= 1
+        # size-biased data loss is a visible series, not a private dict
+        assert telem.metrics.snapshot()[
+            "stream_oversize_dropped_total"
+        ] >= 1
+    finally:
+        obs_rt.deactivate()
+
+
+def pytest_plan_covers_eval_splits():
+    """An eval graph LARGER than any train graph still gets a bucket:
+    the assembled plan folds the materialized splits' sizes into the
+    histogram, so evaluation cannot hit the collator's overflow."""
+    from hydragnn_tpu.data.stream import assemble_stream_loaders
+
+    train = make_samples(24, seed=1)  # all 6-node graphs
+    big_eval = _oc20_shaped(8, seed=2)  # 20-250 nodes
+    src = ListSource(train, shard_size=8, name="a")
+    _, val_loader, _, _ = assemble_stream_loaders(
+        [src], None, 4, {"num_buckets": 2, "seed": 3},
+        big_eval, make_samples(4, seed=4),
+    )
+    batches = list(val_loader)  # collates without overflow
+    assert sum(int(b.graph_mask.sum()) for b in batches) == len(big_eval)
+
+
+def pytest_prefetch_path_identical_to_inline():
+    inline = _stream_loader(seed=17)
+    inline.prefetch = 0
+    inline.set_epoch(0)
+    seq_inline = [b.x.copy() for b in inline]
+    threaded = _stream_loader(seed=17)
+    threaded.prefetch = 3
+    threaded.set_epoch(0)
+    seq_threaded = [b.x.copy() for b in threaded]
+    assert len(seq_inline) == len(seq_threaded)
+    for x, y in zip(seq_inline, seq_threaded):
+        np.testing.assert_array_equal(x, y)
+
+
+def pytest_stream_gauges_populated(tmp_path):
+    from hydragnn_tpu.obs import runtime as obs_rt
+
+    telem = obs_rt.activate(
+        obs_rt.RunTelemetry("gauges", str(tmp_path / "logs"))
+    )
+    try:
+        loader = _stream_loader(seed=19)
+        loader.set_epoch(0)
+        for _ in loader:
+            pass
+        snap = telem.metrics.snapshot()
+        assert snap["stream_samples_total"] == 100
+        assert snap["stream_open_shards_peak"] >= 1
+        assert snap["stream_resident_bytes_peak"] > 0
+        rendered = telem.metrics.render_prometheus()
+        assert "hydragnn_train_stream_source_fraction" in rendered
+    finally:
+        obs_rt.deactivate()
+
+
+def pytest_example_batch_does_not_advance_cursor():
+    loader = _stream_loader(seed=23)
+    before = loader.state_dict()
+    loader.example_batch()
+    assert loader.state_dict() == before
+
+
+# ---- env knob validation --------------------------------------------------
+
+
+def pytest_env_knob_validation(monkeypatch):
+    """Satellite: numeric env knobs fail with the VARIABLE named, not a
+    bare int() ValueError."""
+    from hydragnn_tpu.utils.envparse import env_int
+
+    monkeypatch.setenv("HYDRAGNN_PREFETCH", "two")
+    with pytest.raises(ValueError, match="HYDRAGNN_PREFETCH"):
+        GraphLoader(
+            make_samples(8, seed=1), 4,
+            compute_layout([make_samples(8, seed=1)], 4),
+            num_shards=1, shard_id=0,
+        )
+    monkeypatch.setenv("HYDRAGNN_PREFETCH", "-3")
+    with pytest.raises(ValueError, match="HYDRAGNN_PREFETCH"):
+        GraphLoader(
+            make_samples(8, seed=1), 4,
+            compute_layout([make_samples(8, seed=1)], 4),
+            num_shards=1, shard_id=0,
+        )
+    monkeypatch.delenv("HYDRAGNN_PREFETCH")
+
+    monkeypatch.setenv("HYDRAGNN_STREAM_WINDOW", "0")
+    with pytest.raises(ValueError, match="HYDRAGNN_STREAM_WINDOW"):
+        _mix(window=None)
+    monkeypatch.setenv("HYDRAGNN_STREAM_WINDOW", "x")
+    with pytest.raises(ValueError, match="HYDRAGNN_STREAM_WINDOW"):
+        _mix(window=None)
+    monkeypatch.delenv("HYDRAGNN_STREAM_WINDOW")
+
+    monkeypatch.setenv("HYDRAGNN_STREAM_QUEUE", "1.5")
+    mix = _mix()
+    layout = BucketPlanner(mix.sources, 4, num_buckets=1).plan(emit=False)
+    with pytest.raises(ValueError, match="HYDRAGNN_STREAM_QUEUE"):
+        StreamLoader(mix, 4, layout)
+    monkeypatch.delenv("HYDRAGNN_STREAM_QUEUE")
+
+    assert env_int("HYDRAGNN_NOT_SET_ANYWHERE", 5) == 5
+
+
+# ---- train e2e: weighted mix + kill->resume bitwise -----------------------
+
+
+def _build_stream_training(num_epoch, seed=7):
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {"num_headlayers": 1, "dim_headlayers": [8],
+                     "type": "mlp"},
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {
+        "num_epoch": num_epoch,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "resume_every": 1,
+        "checkpoint_keep_last": 3,
+    }
+    mix = _mix(seed=seed, samples_per_epoch=32)
+    planner = BucketPlanner(mix.sources, batch_size=4, num_buckets=2)
+    layout = planner.plan(emit=False)
+    train_loader = StreamLoader(mix, 4, layout)
+    evals = make_samples(8, seed=30)
+    val_loader = GraphLoader(evals[:4], 4, layout, shuffle=False,
+                             num_shards=1, shard_id=0)
+    test_loader = GraphLoader(evals[4:], 4, layout, shuffle=False,
+                              num_shards=1, shard_id=0)
+    model = create_model_config(arch)
+    trainer = Trainer(model, training)
+    state = trainer.init_state(train_loader.example_batch(), seed=0)
+    return trainer, state, (train_loader, val_loader, test_loader), training
+
+
+def _leaves(state):
+    import jax
+
+    return [
+        np.asarray(x)
+        for x in jax.tree_util.tree_leaves(jax.device_get(state.params))
+    ]
+
+
+def pytest_stream_train_resume_bitwise(tmp_path, monkeypatch):
+    """Acceptance e2e: a two-source weighted mix trains through the real
+    epoch driver; a run stopped at epoch 1 and resumed through the
+    checkpoint's train_meta (stream cursor included) reaches the SAME
+    final parameters, bitwise, as the uninterrupted run."""
+    from hydragnn_tpu.train.checkpoint import (
+        load_state_dict,
+        pop_train_meta,
+        restore_into,
+    )
+    from hydragnn_tpu.train.epoch_driver import train_validate_test
+
+    config_vars = {"output_names": ["sum", "x"]}
+
+    # uninterrupted 4-epoch reference
+    monkeypatch.chdir(tmp_path)
+    os.makedirs("full", exist_ok=True)
+    monkeypatch.chdir(tmp_path / "full")
+    trainer, state, loaders, training = _build_stream_training(4)
+    state_full = train_validate_test(
+        trainer, state, *loaders, {"Training": training,
+                                   "Variables_of_interest": config_vars},
+        "streamrun", verbosity=0,
+    )
+
+    # stopped-at-2 run, then resume 2->4 with a FRESH pipeline
+    monkeypatch.chdir(tmp_path)
+    os.makedirs("killed", exist_ok=True)
+    monkeypatch.chdir(tmp_path / "killed")
+    trainer, state, loaders, training = _build_stream_training(2)
+    train_validate_test(
+        trainer, state, *loaders, {"Training": training,
+                                   "Variables_of_interest": config_vars},
+        "streamrun", verbosity=0,
+    )
+    trainer2, state2, loaders2, training2 = _build_stream_training(4)
+    restored = load_state_dict("streamrun")
+    meta = pop_train_meta(restored)
+    assert meta is not None and meta.get("stream") is not None
+    # cursor equality with the reference run's post-epoch-1 position
+    state2 = trainer2.place_state(restore_into(state2, restored))
+    state_resumed = train_validate_test(
+        trainer2, state2, *loaders2, {"Training": training2,
+                                      "Variables_of_interest": config_vars},
+        "streamrun", verbosity=0, resume_meta=meta,
+    )
+
+    for a, b in zip(_leaves(state_full), _leaves(state_resumed)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---- driver path: Dataset.streaming config --------------------------------
+
+
+def pytest_driver_streaming_config_e2e(tmp_path, monkeypatch):
+    """``Dataset.streaming`` routes run_training through the stream
+    builders: config derivation over the probe window, auto bucket plan,
+    training + checkpoint, and the cursor landing in train_meta."""
+    import hydragnn_tpu
+    from hydragnn_tpu.data.shard_store import ShardWriter
+    from hydragnn_tpu.train.checkpoint import (
+        load_state_dict,
+        pop_train_meta,
+    )
+
+    monkeypatch.chdir(tmp_path)
+    for fam, seed in (("fam_a", 1), ("fam_b", 2)):
+        samples = make_samples(24, seed=seed)
+        for split, chunk in (
+            ("trainset", samples[:16]),
+            ("valset", samples[16:20]),
+            ("testset", samples[20:]),
+        ):
+            w = ShardWriter(f"dataset/{fam}_{split}", rank=0)
+            w.add(chunk)
+            w.save()
+
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "streamdrv",
+            "streaming": {
+                "sources": [
+                    {
+                        "format": "shard_store",
+                        "train": f"dataset/{fam}_trainset",
+                        "validate": f"dataset/{fam}_valset",
+                        "test": f"dataset/{fam}_testset",
+                        "weight": wgt,
+                    }
+                    for fam, wgt in (("fam_a", 2.0), ("fam_b", 1.0))
+                ],
+                "window_shards": 2,
+                "num_buckets": 2,
+                "samples_per_epoch": 16,
+                "seed": 5,
+            },
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 10,
+                "periodic_boundary_conditions": False,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [8],
+                    },
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 2,
+                "perc_train": 0.7,
+                "loss_function_type": "mse",
+                "batch_size": 4,
+                "resume_every": 1,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+            },
+        },
+        "Visualization": {"create_plots": False},
+    }
+    hydragnn_tpu.run_training(config)
+    log_name = [d for d in os.listdir("logs") if "streamdrv" in d]
+    assert log_name, os.listdir("logs")
+    meta = pop_train_meta(load_state_dict(log_name[0]))
+    assert meta is not None and meta.get("stream") is not None
+    assert int(np.asarray(meta["epoch"])) == 1
+    # the plan record lands in the run's event stream even though the
+    # loaders were built before telemetry activated
+    from hydragnn_tpu.obs.events import validate_events
+
+    validate_events(
+        os.path.join("logs", log_name[0], "events.jsonl"),
+        require=["bucket_plan", "epoch", "run_manifest"],
+    )
