@@ -528,8 +528,24 @@ def bench_headline_mxu():
     return float(row["graphs_per_sec"])
 
 
+def bench_mesh(mesh_arg: str):
+    """``bench.py --mesh d,m``: the OC20 headline config on a 2-D
+    ("data", "model") mesh — ONE JSON row with graphs/sec and per-axis
+    collective result bytes, so the first real-TPU run can A/B the 1-D
+    and 2-D layouts on communication as well as wall. ``--mesh 8,1`` is
+    the 1-D baseline at identical padding."""
+    from benchmarks.model_bench import bench_model
+
+    d, m = (int(v) for v in mesh_arg.split(","))
+    row = bench_model(**MXU_HEADLINE, iters=8, mesh=(d, m))
+    print(json.dumps(row, separators=(",", ":")))
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if "--mesh" in sys.argv:
+        bench_mesh(sys.argv[sys.argv.index("--mesh") + 1])
+        return
     # primary headline FIRST: a failure in the (much longer) legacy
     # measurement must not cost the round its recorded number
     ours = bench_headline_mxu()

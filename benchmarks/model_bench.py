@@ -136,12 +136,17 @@ def _arch(model_type, hidden, layers, nodes, input_dim=1):
     }
 
 
-def _collate(samples, num_graphs, nodes, degree, with_triplets):
+def _collate(samples, num_graphs, nodes, degree, with_triplets,
+             device_multiple=1):
     from hydragnn_tpu.graph import collate_graphs, pad_sizes_for
     from hydragnn_tpu.graph.batch import pack_triplets
     from hydragnn_tpu.models import compute_triplets
 
-    n_pad, e_pad, g_pad = pad_sizes_for(nodes, nodes * degree, num_graphs)
+    d = max(int(device_multiple), 1)
+    n_pad, e_pad, g_pad = pad_sizes_for(
+        nodes, nodes * degree, num_graphs,
+        node_multiple=8 * d, edge_multiple=8 * d, graph_multiple=d,
+    )
     batch = collate_graphs(
         samples, n_pad, e_pad, g_pad,
         head_types=("graph", "node"), head_dims=(1, 1),
@@ -200,12 +205,16 @@ def bench_model(
     seed=0,
     remat=False,
     input_dim=1,
+    mesh=None,
 ):
     """Measure one jitted train step. Returns a dict with fence-true
     ms/step, graphs/sec, XLA-counted TFLOP/s, and MFU vs the chip's peak.
     ``remat`` enables conv checkpointing (recompute conv activations in the
     backward pass — the memory lever for OOM-prone widths); ``input_dim``
-    widens node features (CGCNN's effective conv width)."""
+    widens node features (CGCNN's effective conv width). ``mesh=(d, m)``
+    runs the step on a 2-D ("data", "model") mesh (bench.py ``--mesh``):
+    the row gains per-axis collective result bytes from the compiled HLO
+    so 1-D vs 2-D A/B runs compare communication, not just wall."""
     if iters < 1:
         raise ValueError(f"iters must be >= 1, got {iters}")
     import jax
@@ -215,9 +224,20 @@ def bench_model(
     from hydragnn_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
+    device_mesh = None
+    if mesh is not None:
+        from hydragnn_tpu.parallel.mesh import make_mesh2d
+
+        # deliberately NOT registered as the ambient mesh: padding comes
+        # from the explicit device_multiple below and the row's collective
+        # bytes from the explicit HLO parse — no process-global state to
+        # leak into the next bench_model call
+        device_mesh = make_mesh2d(int(mesh[0]), int(mesh[1]))
     samples = make_graphs(num_graphs, nodes, degree, seed, input_dim=input_dim)
     batch = _collate(
-        samples, num_graphs, nodes, degree, with_triplets=model_type == "DimeNet"
+        samples, num_graphs, nodes, degree,
+        with_triplets=model_type == "DimeNet",
+        device_multiple=1 if mesh is None else int(mesh[0]),
     )
     if dense:
         from hydragnn_tpu.ops.dense_agg import attach_neighbor_lists
@@ -233,6 +253,7 @@ def bench_model(
             "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
             "mixed_precision": bool(bf16),
         },
+        mesh=device_mesh,
     )
     state = trainer.init_state(batch)
     dbatch = trainer.put_batch(batch)
@@ -243,13 +264,21 @@ def bench_model(
     from hydragnn_tpu.obs.introspect import normalize_cost_analysis
 
     flops = None
+    collectives = None
     try:
-        cost = normalize_cost_analysis(
-            trainer._train_step.lower(state, dbatch, rng)
-            .compile()
-            .cost_analysis()
-        )
+        compiled = trainer._train_step.lower(state, dbatch, rng).compile()
+        cost = normalize_cost_analysis(compiled.cost_analysis())
         flops = cost.get("flops") or None
+        if device_mesh is not None:
+            from hydragnn_tpu.parallel.collectives import (
+                collective_bytes_by_axis,
+            )
+
+            collectives = collective_bytes_by_axis(
+                compiled.as_text(),
+                tuple(device_mesh.axis_names),
+                tuple(device_mesh.devices.shape),
+            )
     except Exception as e:  # cost model availability varies by backend
         print(f"cost_analysis unavailable: {e}", file=sys.stderr)
 
@@ -279,6 +308,14 @@ def bench_model(
         "mfu_pct": round(100 * tflops / peak, 2) if tflops else None,
         "device_kind": kind,
         "peak_tflops_assumed": peak,
+        **(
+            {}
+            if mesh is None
+            else {
+                "mesh": f"{int(mesh[0])}x{int(mesh[1])}",
+                "collective_bytes": collectives or {},
+            }
+        ),
     }
 
 

@@ -334,9 +334,13 @@ def compute_layout(
     per bucket."""
     if device_multiple is None:
         try:
-            import jax
+            # the mesh's DATA axis, not the raw device count: on a 2-D
+            # ("data", "model") mesh only the data axis shards batch
+            # leading dims (and on a best-fit elastic mesh — e.g. (3, 2)
+            # on a 7-device world — the device count does not even divide)
+            from hydragnn_tpu.parallel.mesh import data_axis_multiple
 
-            device_multiple = jax.device_count()
+            device_multiple = data_axis_multiple()
         except Exception:
             device_multiple = 1
     mult = _lcm(8, max(device_multiple, 1))

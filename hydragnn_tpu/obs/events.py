@@ -72,8 +72,21 @@ EVENT_FIELDS: Dict[str, tuple] = {
     "host_lost": ("host",),
     # elastic training: the world re-formed at a new size and took its
     # first optimizer step; recovery_s spans loss detection -> first step
-    # (teardown + re-bootstrap + checkpoint restore + recompile)
+    # (teardown + re-bootstrap + checkpoint restore + recompile). 2-D
+    # runs also carry mesh_shape=[d, m] (parallel/mesh.py re-derivation)
     "world_resize": ("old_world", "new_world", "gen", "recovery_s"),
+    # mesh resolution (parallel/mesh.py): the run's device mesh — axis
+    # names, [d, m] shape ([] when running unmeshed on one device), and
+    # the visible device count the shape was derived from
+    "mesh_shape": ("axes", "shape", "devices"),
+    # partition-rule placement summary (parallel/rules.py): how many
+    # train-state leaves (and bytes) the rule engine sharded vs
+    # replicated — "everything silently replicated" regressions are
+    # visible from the event stream alone
+    "param_sharding": (
+        "total_leaves", "sharded", "replicated", "sharded_bytes",
+        "replicated_bytes",
+    ),
     # streaming bucket planner (data/stream/planner.py): an auto-tuned
     # bucket plan was built from a streamed size histogram — bounds are
     # the inclusive node-count bucket boundaries, est_waste the simulated

@@ -148,6 +148,40 @@ def bucket_label(name: str, key: Tuple) -> str:
     return f"{name}/{digest}"
 
 
+# ambient mesh context (parallel/mesh.py registers it): lets the capture
+# attribute collective bytes in the compiled HLO to mesh axes
+_mesh_axes: Optional[Tuple[str, ...]] = None
+_mesh_shape: Optional[Tuple[int, ...]] = None
+
+
+def set_mesh_context(axes, shape):
+    """Register (or clear, with Nones) the active mesh's axis names and
+    shape for collective-byte attribution."""
+    global _mesh_axes, _mesh_shape
+    _mesh_axes = tuple(axes) if axes else None
+    _mesh_shape = tuple(int(s) for s in shape) if shape else None
+
+
+def mesh_context():
+    return _mesh_axes, _mesh_shape
+
+
+def _collective_bytes(compiled) -> Dict[str, float]:
+    """Per-axis collective result bytes of one compiled executable ({}
+    without a registered mesh or on parse failure — accounting must
+    never break a capture)."""
+    if _mesh_axes is None or _mesh_shape is None:
+        return {}
+    try:
+        from hydragnn_tpu.parallel.collectives import collective_bytes_by_axis
+
+        return collective_bytes_by_axis(
+            compiled.as_text(), _mesh_axes, _mesh_shape
+        )
+    except Exception:
+        return {}
+
+
 # process-global record of every captured compile — serving and benches
 # read this even with no telemetry run active
 _captured: List[Dict] = []
@@ -230,6 +264,7 @@ class InstrumentedJit:
                 "bucket": bucket_label(self._name, key),
                 "cost": cost,
                 "memory": mem,
+                "collectives": _collective_bytes(compiled),
             }
             _record(rec)
             if self._on_capture is not None:

@@ -157,6 +157,14 @@ class TrainingMetrics:
             "aggregation_kernel",
             "Chosen aggregation kernel family per bucket (1 = active)",
         )
+        # 2-D mesh collective accounting (parallel/collectives.py):
+        # per-dispatch collective result bytes attributed to each mesh
+        # axis, summed over every captured compiled program — a reshard
+        # regression (all-gather storm) moves this before it moves wall
+        r.labeled_gauge(
+            "collective_bytes",
+            "Compiled-program collective result bytes per mesh axis",
+        )
         # streaming data plane (data/stream/): per-epoch pipeline health
         # — queue depth at last consumer get, seconds the step loop spent
         # blocked on the data plane, ingestion bandwidth, and the
@@ -383,6 +391,8 @@ class RunTelemetry:
         self._profile_steps = int(os.getenv("HYDRAGNN_PROFILE_STEPS", "3"))
         self.current_epoch = 0
         self._step_in_epoch = 0
+        # per-axis collective-bytes running totals (record_compile)
+        self._collective_totals: Dict[str, float] = {}
         self._compile_events_at_step = _compile_events
         _register_compile_listener()
         if port is not None:
@@ -476,6 +486,7 @@ class RunTelemetry:
         per-bucket cost/memory gauges (obs/introspect.py calls this)."""
         cost = rec.get("cost") or {}
         mem = rec.get("memory") or {}
+        coll = rec.get("collectives") or {}
         bucket = rec["bucket"]
         if cost.get("flops"):
             self.metrics.registry.set_labeled(
@@ -485,9 +496,20 @@ class RunTelemetry:
             self.metrics.registry.set_labeled(
                 "hbm_peak_bytes", float(mem["peak_bytes"]), bucket=bucket
             )
+        for axis, nbytes in coll.items():
+            # cumulative across captured programs: the run's collective
+            # footprint per axis, not the last bucket's
+            self._collective_totals[axis] = (
+                self._collective_totals.get(axis, 0.0) + float(nbytes)
+            )
+            self.metrics.registry.set_labeled(
+                "collective_bytes",
+                self._collective_totals[axis],
+                axis=axis,
+            )
         self.emit(
             "compile", name=rec["name"], bucket=bucket, cost=cost,
-            memory=mem,
+            memory=mem, **({"collectives": coll} if coll else {}),
         )
 
     def profile(self, steps: int) -> Dict:

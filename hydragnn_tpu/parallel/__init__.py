@@ -7,7 +7,22 @@ from hydragnn_tpu.parallel.distributed import (
     print_peak_memory,
     setup_distributed,
 )
-from hydragnn_tpu.parallel.mesh import default_mesh, make_mesh, shard_optimizer_state
+from hydragnn_tpu.parallel.mesh import (
+    best_mesh_shape,
+    data_axis_multiple,
+    default_mesh,
+    make_mesh,
+    make_mesh2d,
+    mesh_shape_list,
+    resolve_mesh,
+    shard_optimizer_state,
+)
+from hydragnn_tpu.parallel.rules import (
+    DEFAULT_PARAM_RULES,
+    match_partition_rules,
+    state_shardings,
+    summarize_shardings,
+)
 from hydragnn_tpu.parallel.graph_partition import (
     PartitionInfo,
     halo_extend,

@@ -1,0 +1,143 @@
+"""Per-axis collective-byte accounting from compiled HLO.
+
+The cost/memory analyses captured by ``obs/introspect.py`` say how much a
+compiled program computes and holds — but not how much it COMMUNICATES,
+which is the number a 2-D mesh lives or dies by (a bad partition rule
+shows up as an all-gather storm long before it shows up in step time on a
+small config). XLA's cost model has no collective breakdown, so this
+module reads the compiled module text instead: every
+``all-reduce``/``all-gather``/``all-to-all``/``reduce-scatter`` op's
+result bytes are attributed to the mesh axis its ``replica_groups``
+reduce over, and the per-axis totals land in the ``compile`` event and
+the ``hydragnn_train_collective_bytes{axis=...}`` gauges.
+
+Attribution: for a row-major ``(d, m)`` mesh, device ``i`` sits at
+``(i // m, i % m)`` — groups of ``m`` consecutive ids are a ``model``
+reduction, groups of ``d`` ids strided by ``m`` are ``data``, one group
+of everything is ``global``; anything else reports as ``other`` (a
+subset-mesh program, a permute). Both replica-group spellings XLA emits
+are parsed: explicit ``{{0,2},{1,3}}`` lists and the iota form
+``[G,S]<=[dims]T(perm)``.
+"""
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_OP_RE = re.compile(
+    r"=\s+(?P<type>[^=]*?)\s+"
+    r"(?P<op>all-reduce|all-gather|all-to-all|reduce-scatter)"
+    r"(?P<start>-start)?\("
+)
+_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+)\[([0-9,]*)\]")
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{(\{[0-9, {}]*\})\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(?P<gs>[0-9,]+)\]<=\[(?P<dims>[0-9,]+)\]"
+    r"(?:T\((?P<perm>[0-9,]+)\))?"
+)
+
+
+def _type_bytes(type_str: str, is_start: bool = False) -> int:
+    """Result bytes of one op's printed type. Async ``*-start`` ops print
+    a tuple of (operand..., result...) buffers — counting the whole tuple
+    would double-count vs the sync spelling, so only the result half
+    (the trailing shapes) is summed for them."""
+    sizes = []
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        sizes.append(n * _DTYPE_BYTES[dtype])
+    if is_start and len(sizes) >= 2 and len(sizes) % 2 == 0:
+        sizes = sizes[len(sizes) // 2 :]
+    elif is_start and len(sizes) >= 2:
+        sizes = sizes[-1:]
+    return sum(sizes)
+
+
+def _parse_groups(line: str) -> Optional[List[Tuple[int, ...]]]:
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        gshape = [int(v) for v in m.group("gs").split(",")]
+        dims = [int(v) for v in m.group("dims").split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group("perm"):
+            ids = ids.transpose([int(v) for v in m.group("perm").split(",")])
+        groups = ids.reshape(gshape[0], -1)
+        return [tuple(int(v) for v in g) for g in groups]
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        groups = []
+        for part in re.findall(r"\{([0-9, ]*)\}", m.group(1)):
+            ids = [int(v) for v in part.replace(" ", "").split(",") if v != ""]
+            if ids:
+                groups.append(tuple(ids))
+        return groups or None
+    return None
+
+
+def axis_groups(axes: Sequence[str], shape: Sequence[int]) -> Dict[str, set]:
+    """Canonical replica groups per mesh axis: group = the devices that
+    vary along that axis with every other coordinate fixed."""
+    shape = tuple(int(s) for s in shape)
+    ids = np.arange(int(np.prod(shape))).reshape(shape)
+    out: Dict[str, set] = {}
+    for i, name in enumerate(axes):
+        rows = np.moveaxis(ids, i, -1).reshape(-1, shape[i])
+        out[str(name)] = {frozenset(int(v) for v in r) for r in rows}
+    return out
+
+
+def classify_groups(
+    groups: List[Tuple[int, ...]], axes: Sequence[str], shape: Sequence[int]
+) -> str:
+    """Mesh-axis name for one op's replica groups; ``global`` for one
+    group spanning the mesh, ``other`` when no axis matches."""
+    total = int(np.prod([int(s) for s in shape]))
+    got = {frozenset(g) for g in groups}
+    if got == {frozenset(range(total))}:
+        # a full-mesh reduction IS the single non-trivial axis when the
+        # others are degenerate; otherwise it is a cross-axis global
+        nontrivial = [a for a, s in zip(axes, shape) if int(s) > 1]
+        return str(nontrivial[0]) if len(nontrivial) == 1 else "global"
+    for name, canonical in axis_groups(axes, shape).items():
+        if got == canonical:
+            return name
+    return "other"
+
+
+def collective_bytes_by_axis(
+    hlo_text: str, axes: Sequence[str], shape: Sequence[int]
+) -> Dict[str, float]:
+    """``{axis: result_bytes_per_device_per_dispatch}`` summed over every
+    collective in one compiled module. Result bytes (the op's output
+    shape), not wire bytes — a stable, backend-independent proxy the
+    1-D/2-D A/B in ``bench.py --mesh`` compares on."""
+    totals: Dict[str, float] = {}
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        nbytes = _type_bytes(
+            m.group("type"), is_start=m.group("start") is not None
+        )
+        if nbytes == 0:
+            continue
+        groups = _parse_groups(line)
+        axis = (
+            classify_groups(groups, axes, shape)
+            if groups is not None
+            else "other"
+        )
+        totals[axis] = totals.get(axis, 0.0) + float(nbytes)
+    return totals

@@ -16,7 +16,12 @@ Design:
   locality-preserving reorder (Morton/Z-curve over positions, so radius-graph
   neighbors tend to share a shard and the halo stays small). Every directed
   edge is owned by its *receiver's* shard, so all receiver-side aggregations
-  (the message-passing hot path) are shard-local segment ops.
+  (the message-passing hot path) are shard-local segment ops. On the 2-D
+  ``("data", "model")`` mesh (``parallel/mesh.py``) ownership lives on the
+  ``model`` axis: each model group holds one graph's shards, and the batch
+  placement + in-program ``with_sharding_constraint`` on the node table,
+  edge features and halo buffers let XLA place the all_to_all/psum
+  collectives against that layout instead of replicating.
 * **Halo exchange** (``halo_extend``) — before every conv layer, each shard
   gathers the rows remote peers need (a host-precomputed, statically padded
   send list) and trades them with ONE ``lax.all_to_all`` over ICI. Convs run
@@ -497,6 +502,22 @@ def _batch_spec(batch, axis):
     return jax.tree_util.tree_map(lambda _: P(axis), batch)
 
 
+def _constrain_partitioned(batch, mesh, axis):
+    """Pin the partitioned batch's placement INSIDE the jitted program:
+    ``with_sharding_constraint`` on every leading-axis-stacked leaf — the
+    node table (``x``/``pos``), the edge features/indices, and the halo
+    send tables — so XLA places the shard_map's all_to_all/psum
+    collectives against the declared layout instead of replicating first
+    and resharding at the shard_map boundary. On the 2-D mesh the
+    partition axis is ``model``; unmentioned axes (``data``) replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.with_sharding_constraint(a, sharding), batch
+    )
+
+
 def _put_global(a, sharding):
     """Place an array (present in full on every process) under a global
     sharding. device_put cannot target non-addressable devices, so on
@@ -547,6 +568,8 @@ def make_partitioned_apply(model, mesh, axis: str = "graph"):
     from jax.sharding import PartitionSpec as P
 
     def fwd(variables, batch):
+        batch = _constrain_partitioned(batch, mesh, axis)
+
         def shard_fn(variables, batch):
             return model.apply(variables, batch, train=False)
 
@@ -577,6 +600,8 @@ def make_partitioned_train_step(model, tx, mesh, axis: str = "graph"):
     axis_size = int(mesh.shape[axis])
 
     def step(state, batch, rng):
+        batch = _constrain_partitioned(batch, mesh, axis)
+
         def shard_fn(params, batch_stats, opt_state, step_no, batch, rng):
             # decorrelate dropout masks across shards (rng enters replicated)
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
@@ -647,6 +672,8 @@ def make_partitioned_eval_step(model, mesh, axis: str = "graph"):
     from jax.sharding import PartitionSpec as P
 
     def eval_step(params, batch_stats, batch):
+        batch = _constrain_partitioned(batch, mesh, axis)
+
         def shard_fn(params, batch_stats, batch):
             variables = {"params": params}
             if batch_stats:

@@ -1,20 +1,96 @@
 """Device mesh construction & sharding policy.
 
-The scaling design (SURVEY.md §2.3/§5): data parallelism is a 1-D ``data``
-axis over all devices — batch leading axes sharded, parameters replicated,
-gradient all-reduce inserted by XLA over ICI (intra-slice) / DCN (across
-slices). Optimizer-state sharding (ZeRO parity) shards the optimizer moments
-over the same axis.
+The scaling design (SURVEY.md §2.3/§5) grew from a 1-D ``("data",)`` mesh
+to a 2-D ``("data", "model")`` mesh (docs/parallelism.md):
 
-On a multi-host TPU pod, ``jax.devices()`` spans every host; each host feeds
-its local shard of the batch (the loaders shard sample indices per process,
-DistributedSampler-style) and ``make_array_from_process_local_data`` builds
-the global sharded batch.
+* ``data`` — batch leading axes sharded, gradient all-reduce inserted by
+  XLA over ICI (intra-slice) / DCN (across slices);
+* ``model`` — hidden/head matmul weights column-split per the regex rule
+  engine (``parallel/rules.py``), and graph-partition mode's node/edge
+  ownership (``parallel/graph_partition.py``) — one graph's message
+  passing spans the chips of a model group.
+
+``resolve_mesh`` is the driver's single entry point: it honors
+``HYDRAGNN_MESH="d,m"`` / ``Training.model_parallel`` and derives the
+largest ``(d, m)`` factorization that fits the available devices
+(:func:`best_mesh_shape`) — the SAME derivation the elastic re-mesh runs
+against the surviving world, so a 2-D world heals exactly the way the
+1-D one does.
+
+On a multi-host TPU pod, ``jax.devices()`` spans every host; each host
+feeds its local shard of the batch (the loaders shard sample indices per
+process, DistributedSampler-style) and
+``make_array_from_process_local_data`` builds the global sharded batch.
 """
 
-from typing import Optional
+import os
+from typing import Optional, Tuple
 
 import numpy as np
+
+# the driver-resolved mesh, consulted by the loaders (leading-axis padding
+# must divide the DATA axis, not the raw device count) and by the obs
+# introspection layer (collective-bytes axis attribution)
+_active_mesh = None
+# mesh generation: starts at the resumed checkpoint's recorded value and
+# increments on every re-derive, so successive elastic shrinks emit
+# distinguishable world_resize events (the 1-D elastic path's gen analog).
+# Recorded back into the train meta by epoch_driver._build_train_meta.
+_mesh_gen = 0
+
+
+def current_mesh_gen() -> int:
+    return _mesh_gen
+
+
+def set_active_mesh(mesh):
+    """Register the run's mesh as ambient context (loaders' padding
+    multiple, introspection's collective-axis attribution). Idempotent;
+    pass None to clear."""
+    global _active_mesh
+    _active_mesh = mesh
+    try:
+        from hydragnn_tpu.obs import introspect
+
+        if mesh is None:
+            introspect.set_mesh_context(None, None)
+        else:
+            introspect.set_mesh_context(
+                tuple(mesh.axis_names), tuple(mesh.devices.shape)
+            )
+    except Exception:
+        pass
+
+
+def active_mesh():
+    return _active_mesh
+
+
+def data_axis_multiple() -> int:
+    """The divisor batch leading axes must honor: the active mesh's
+    ``data`` axis size when one is registered, else every local device
+    (the historical default — identical when the default 1-D mesh is in
+    use, and the only safe answer when no mesh was resolved yet)."""
+    if _active_mesh is not None:
+        return int(dict(_active_mesh.shape).get("data", 1))
+    import jax
+
+    try:
+        return jax.device_count()
+    except Exception:
+        return 1
+
+
+def best_mesh_shape(n_devices: int, model_parallel: int) -> Tuple[int, int]:
+    """Largest ``(data, model)`` factorization fitting ``n_devices`` while
+    preserving the requested model width — the elastic re-mesh rule. The
+    model axis is a CAPACITY requirement (params/graph shards must fit a
+    model group), so a shrunken world keeps ``m`` and drops data replicas:
+    8 devices at m=2 -> (4, 2); a 7-survivor world -> (3, 2) on 6 devices,
+    never (7, 1)."""
+    m = max(1, min(int(model_parallel), int(n_devices)))
+    d = max(1, int(n_devices) // m)
+    return d, m
 
 
 def default_mesh(min_devices: int = 2):
@@ -39,22 +115,106 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
     return Mesh(np.asarray(devices), (axis,))
 
 
-def shard_over_data_axis(tree, mesh):
-    """Shard tree leaves over the data axis where dim 0 divides, replicate
-    the rest. ONE placement rule for every ZeRO stage — optimizer moments
-    (stage 1/2) and parameters (stage 3) must agree on which leaves shard
-    or the update step pays avoidable reshards."""
+def make_mesh2d(data: int, model: int, axes: Tuple[str, str] = ("data", "model")):
+    """2-D ``(data, model)`` mesh over the first ``data*model`` devices.
+    Device order is row-major — one model group is ``model`` CONSECUTIVE
+    devices (the ICI-nearest neighbors on a TPU slice, where the
+    latency-sensitive halo/all-gather traffic belongs)."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
 
-    axis_size = mesh.shape["data"]
+    d, m = int(data), int(model)
+    devices = jax.devices()
+    if d * m > len(devices):
+        raise ValueError(
+            f"mesh {d}x{m} needs {d * m} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices[: d * m]).reshape(d, m), axes)
 
-    def place(leaf):
-        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] % axis_size == 0:
-            return jax.device_put(leaf, NamedSharding(mesh, P("data")))
-        return jax.device_put(leaf, NamedSharding(mesh, P()))
 
-    return jax.tree_util.tree_map(place, tree)
+def mesh_shape_list(mesh):
+    """``[d, m]`` for events/checkpoint metadata (1-D meshes report
+    ``[d, 1]``); None for no mesh."""
+    if mesh is None:
+        return None
+    shape = dict(mesh.shape)
+    return [int(shape.get("data", 1)), int(shape.get("model", shape.get("graph", 1)))]
+
+
+def requested_mesh(training_config: Optional[dict]):
+    """(d_or_None, m) requested via ``HYDRAGNN_MESH="d,m"`` (env wins) or
+    ``Training.model_parallel`` / ``Training.mesh_shape`` ([d, m])."""
+    env = os.getenv("HYDRAGNN_MESH")
+    if env and env.strip():
+        parts = [p.strip() for p in env.split(",")]
+        try:
+            if len(parts) == 1:
+                return None, int(parts[0])
+            if len(parts) == 2:
+                return int(parts[0]), int(parts[1])
+        except ValueError:
+            pass
+        raise ValueError(
+            f'HYDRAGNN_MESH={env!r} is not "d,m" or a bare model width'
+        )
+    cfg = training_config or {}
+    shape = cfg.get("mesh_shape")
+    if shape:
+        if len(shape) != 2:
+            raise ValueError(
+                f"Training.mesh_shape must be [data, model], got {shape!r}"
+            )
+        return int(shape[0]), int(shape[1])
+    return None, int(cfg.get("model_parallel", 1) or 1)
+
+
+def resolve_mesh(training_config: Optional[dict] = None, min_devices: int = 2):
+    """The driver's mesh: 2-D when model parallelism is requested, the
+    historical 1-D data mesh otherwise, None on a single device. A
+    requested shape that no longer fits (elastic shrink, a smaller dev
+    box) re-derives via :func:`best_mesh_shape` instead of failing —
+    that IS the re-mesh path. The result is registered as the active
+    ambient mesh (:func:`set_active_mesh`)."""
+    import jax
+
+    n = len(jax.devices())
+    d_req, m_req = requested_mesh(training_config)
+    if m_req <= 1 and d_req is not None:
+        # an EXPLICIT 1-D width ("4,1") is honored, not widened to every
+        # device — a deliberately narrow benchmark layout must not
+        # silently train on a different world size
+        d = min(int(d_req), n)
+        mesh = make_mesh(d) if d >= 2 else None
+    elif m_req <= 1:
+        mesh = default_mesh(min_devices)
+    else:
+        d, m = best_mesh_shape(n, m_req)
+        if d_req is not None and d_req * m <= n:
+            d = int(d_req)
+        if d * m < 2:
+            mesh = None  # single device: jit without a mesh is optimal
+        elif m == 1:
+            mesh = make_mesh(d * m)
+        else:
+            mesh = make_mesh2d(d, m)
+    set_active_mesh(mesh)
+    return mesh
+
+
+def shard_over_data_axis(tree, mesh):
+    """Shard ``tree`` over the data axis — compat shim over the rule
+    engine (``parallel/rules.py``, docs/MIGRATION.md).
+
+    The old shape heuristic sharded ANY leaf whose dim 0 divided the
+    axis size, so a size-8 bias on an 8-way mesh sharded silently —
+    tiny latency-bound all-gathers at every use and a layout no other
+    placement decision agreed on. Placement now routes through
+    :func:`~hydragnn_tpu.parallel.rules.zero_data_shardings`: weight-like
+    leaves (ndim >= 2, dim 0 divisible) shard, 1-D leaves and anything a
+    ``replicate`` rule names stay replicated."""
+    from hydragnn_tpu.parallel import rules
+
+    return rules.put_tree(tree, rules.zero_data_shardings(tree, mesh))
 
 
 def shard_optimizer_state(opt_state, mesh):
@@ -73,3 +233,62 @@ def shard_parameters(params, mesh):
     bytes are tiny next to activations, so this is a parity/completeness
     knob, not a memory necessity)."""
     return shard_over_data_axis(params, mesh)
+
+
+def announce_mesh(mesh, trainer=None, resume_meta=None, started_ts=None):
+    """Emit the run's ``mesh_shape`` event (+ ``param_sharding`` when the
+    trainer has a placement summary), and — when a resumed checkpoint
+    recorded a DIFFERENT mesh — the re-derive ``world_resize`` with the
+    new shape: the 2-D analog of the elastic 1-D re-shard, measured from
+    process start to the emission (teardown + restore + re-derivation).
+    No-ops when telemetry is inactive (the obs hook contract)."""
+    import time
+
+    import jax
+
+    from hydragnn_tpu.obs import runtime as obs
+
+    shape = mesh_shape_list(mesh)
+    obs.emit(
+        "mesh_shape",
+        axes=list(mesh.axis_names) if mesh is not None else [],
+        shape=shape or [],
+        devices=len(jax.devices()),
+    )
+    summary = getattr(trainer, "sharding_summary", lambda: None)()
+    if summary:
+        obs.emit("param_sharding", **summary)
+
+    def _meta_shape(v):
+        # flax state-dict restore turns lists into {'0': ..., '1': ...}
+        if v is None:
+            return None
+        if isinstance(v, dict):
+            return [int(v[k]) for k in sorted(v, key=int)]
+        return [int(x) for x in v]
+
+    global _mesh_gen
+    old = _meta_shape((resume_meta or {}).get("mesh"))
+    _mesh_gen = int((resume_meta or {}).get("mesh_gen", 0) or 0)
+    if old and shape and list(old) != list(shape):
+        from hydragnn_tpu.train import elastic
+
+        elastic.note_mesh_shape(shape)
+        recovery = (
+            max(time.monotonic() - started_ts, 0.0)
+            if started_ts is not None
+            else 0.0
+        )
+        _mesh_gen += 1
+        obs.world_resized(
+            old_world=int(np.prod(old)),
+            new_world=int(np.prod(shape)),
+            gen=_mesh_gen,
+            recovery_s=round(recovery, 3),
+            mesh_shape=shape,
+            source="re-derive",
+        )
+    elif shape:
+        from hydragnn_tpu.train import elastic
+
+        elastic.note_mesh_shape(shape)

@@ -15,7 +15,7 @@ import numpy as np
 from hydragnn_tpu.data.loaders import dataset_loading_and_splitting
 from hydragnn_tpu.models.create import create_model_config
 from hydragnn_tpu.parallel.distributed import setup_distributed
-from hydragnn_tpu.parallel.mesh import default_mesh
+from hydragnn_tpu.parallel.mesh import announce_mesh, resolve_mesh
 from hydragnn_tpu.train.checkpoint import (
     checkpoint_exists,
     load_state_dict,
@@ -61,7 +61,10 @@ def _build_model_and_trainer(config, train_loader, verbosity):
     if arch.get("partition_axis"):
         return _build_partitioned(config, arch, train_loader, verbosity)
     model = create_model_config(arch, verbosity)
-    mesh = default_mesh()
+    # 2-D ("data", "model") when Training.model_parallel / HYDRAGNN_MESH
+    # asks for it, the historical 1-D data mesh otherwise; a shape that
+    # no longer fits the visible devices re-derives (parallel/mesh.py)
+    mesh = resolve_mesh(config["NeuralNetwork"]["Training"])
     trainer = Trainer(
         model,
         config["NeuralNetwork"]["Training"],
@@ -77,18 +80,66 @@ def _build_model_and_trainer(config, train_loader, verbosity):
     return model, trainer, state
 
 
+def _partition_geometry(config) -> tuple:
+    """``(num_parts, axis)`` for graph-partition mode. With model
+    parallelism configured (``Training.model_parallel`` / HYDRAGNN_MESH),
+    node/edge ownership lives on the 2-D mesh's ``model`` axis and each
+    graph splits into one model group's worth of shards; otherwise the
+    legacy 1-D partition mesh spans every device under the config's
+    ``partition_axis`` name."""
+    import jax
+
+    from hydragnn_tpu.parallel.mesh import best_mesh_shape, requested_mesh
+
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"].get("Training", {})
+    _, m_req = requested_mesh(training)
+    if m_req > 1:
+        _, m = best_mesh_shape(len(jax.devices()), m_req)
+        return m, "model"
+    return len(jax.devices()), arch.get("partition_axis") or "graph"
+
+
 def _build_partitioned(config, arch, train_loader, verbosity):
-    """Giant-graph mode: every sample is ONE graph sharded over all devices
-    (``Architecture.partition_axis`` names the mesh axis)."""
-    from hydragnn_tpu.parallel.mesh import make_mesh
+    """Giant-graph mode: every sample is ONE graph sharded node-wise over
+    the partition axis — the ``model`` axis of the 2-D mesh when model
+    parallelism is configured (``_partition_geometry``), else the legacy
+    1-D mesh over every device named by ``Architecture.partition_axis``."""
+    import jax
+
+    from hydragnn_tpu.parallel.mesh import (
+        best_mesh_shape,
+        make_mesh,
+        make_mesh2d,
+        set_active_mesh,
+    )
     from hydragnn_tpu.train.partitioned import PartitionedTrainer
 
-    axis = arch["partition_axis"]
+    parts, axis = _partition_geometry(config)
     ref_arch = dict(arch)
     ref_arch.pop("partition_axis")
+    arch = dict(arch)
+    arch["partition_axis"] = axis
     model = create_model_config(arch, verbosity)
     ref_model = create_model_config(ref_arch, verbosity)
-    mesh = make_mesh(None, axis)  # every device
+    if axis == "model":
+        d, m = best_mesh_shape(len(jax.devices()), parts)
+        mesh = make_mesh2d(d, m)
+        if d > 1:
+            import warnings
+
+            warnings.warn(
+                f"graph-partition mode on a {d}x{m} mesh: each graph "
+                f"splits across the {m}-wide model axis and the {d} data "
+                "rows run REPLICATED work (one giant graph per step has "
+                "no batch to shard). If the graph fits fewer shards than "
+                "devices, prefer the 1-D partition mesh "
+                "(model_parallel unset) to split it over every device",
+                stacklevel=2,
+            )
+    else:
+        mesh = make_mesh(None, axis)  # every device
+    set_active_mesh(mesh)
     trainer = PartitionedTrainer(
         model,
         ref_model,
@@ -109,15 +160,15 @@ def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
     arch = config["NeuralNetwork"]["Architecture"]
     if not arch.get("partition_axis"):
         return train_loader, val_loader, test_loader
-    import jax
-
     from hydragnn_tpu.train.partitioned import PartitionedLoader, scan_budgets
 
     head_types = tuple(arch["output_type"])
     head_dims = tuple(arch["output_dim"])
     need_triplets = arch["model_type"] == "DimeNet"
     need_neighbors = bool(arch.get("dense_aggregation"))
-    n_dev = len(jax.devices())
+    # shards-per-graph = the partition axis size (the 2-D mesh's model
+    # axis under model parallelism, every device on the legacy 1-D mesh)
+    n_dev, part_axis = _partition_geometry(config)
     # ONE budget union across splits -> one compiled executable for all
     budgets = scan_budgets(
         [train_loader.dataset, val_loader.dataset, test_loader.dataset],
@@ -142,7 +193,7 @@ def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
                 need_triplets=need_triplets,
                 need_neighbors=need_neighbors,
                 shuffle=shuffle,
-                axis=arch["partition_axis"],
+                axis=part_axis,
                 budgets=budgets,
             )
         )
@@ -150,10 +201,18 @@ def make_partitioned_loaders(config, train_loader, val_loader, test_loader):
 
 
 def run_training_impl(config):
+    import time as _time
+
+    started_ts = _time.monotonic()
     timer = Timer("run_training")
     timer.start()
     enable_compile_cache()
     setup_distributed()
+    # resolve the mesh BEFORE data loading: the loaders' leading-axis
+    # padding must divide the mesh's DATA axis (parallel/mesh.py
+    # data_axis_multiple), which on a 2-D mesh is smaller than the raw
+    # device count. _build_model_and_trainer re-resolves the same shape.
+    resolve_mesh(config["NeuralNetwork"]["Training"])
     # elastic/heartbeat runtime (train/elastic.py): started right after
     # the distributed bootstrap so the lease exists before the long
     # data-load/compile phases — None unless HYDRAGNN_ELASTIC_DIR or
@@ -225,6 +284,16 @@ def run_training_impl(config):
                 if model_name == log_name:
                     resume_meta = meta
                 state = trainer.place_state(restore_into(state, restored))
+
+        # mesh_shape + param_sharding run events; when the resumed
+        # checkpoint recorded a DIFFERENT mesh (elastic shrink: the
+        # surviving world re-derived the largest fitting (d, m)), this
+        # also emits the world_resize with the new shape — the 2-D
+        # analog of PR 8's 1-D re-shard
+        announce_mesh(
+            trainer.mesh, trainer=trainer, resume_meta=resume_meta,
+            started_ts=started_ts,
+        )
 
         writer = _get_summary_writer(log_name)
         vis_cfg = config.get("Visualization", {})

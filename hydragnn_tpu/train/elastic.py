@@ -100,6 +100,17 @@ DEFAULT_LEASE_S = 6.0
 _progress = {"step": 0, "epoch": 0, "guard_restores": 0, "progress_ts": 0.0}
 _beating = False  # one global read gates every hook (the faults.py pattern)
 _runtime: Optional["ElasticRuntime"] = None
+# the worker's device-mesh shape [d, m] (parallel/mesh.py announces it):
+# rides the heartbeat payload and the world_resize event, so a 2-D
+# world's re-mesh is observable as a MESH change, not just a world count
+_mesh_shape: Optional[List[int]] = None
+
+
+def note_mesh_shape(shape):
+    """The run resolved its device mesh (``[d, m]`` or None) — recorded
+    for heartbeats and the next ``world_resize`` emission."""
+    global _mesh_shape
+    _mesh_shape = None if shape is None else [int(v) for v in shape]
 
 
 def note_step(step: Optional[int] = None):
@@ -425,6 +436,8 @@ class ElasticRuntime:
         p = dict(_progress)
         p.update(host=self.host, rank=self.rank, gen=self.gen,
                  world=self.world, done=self._done)
+        if _mesh_shape is not None:
+            p["mesh"] = _mesh_shape
         return p
 
     def start(self) -> "ElasticRuntime":
@@ -464,6 +477,7 @@ class ElasticRuntime:
             new_world=self.world,
             gen=self.gen,
             recovery_s=round(recovery, 3),
+            **({} if _mesh_shape is None else {"mesh_shape": _mesh_shape}),
         )
 
     def stop(self):

@@ -44,14 +44,25 @@ _FIT_SCHED_FIELDS = (
 )
 
 
+def _mesh_meta(trainer):
+    """``[d, m]`` of the trainer's mesh for the checkpoint meta — the
+    record the elastic re-mesh compares the re-derived shape against
+    (``parallel/mesh.py:announce_mesh``). None when unmeshed."""
+    from hydragnn_tpu.parallel.mesh import mesh_shape_list
+
+    return mesh_shape_list(getattr(trainer, "mesh", None))
+
+
 def _build_train_meta(epoch, rng, scheduler, early, ckpt, guard, sched=None,
-                      stream=None):
+                      stream=None, mesh=None):
     """Checkpoint-v2 training-loop state: everything a preempted job needs
     to resume at epoch ``epoch + 1`` instead of epoch 0. ``stream`` is
     the streaming loader's mix cursor (data/stream/mix.py) — present only
     on streaming runs, it pins per-source shard/offset positions so the
     resumed run draws the exact sample sequence the uninterrupted run
-    would have."""
+    would have. ``mesh`` is the run's ``[d, m]`` mesh shape — a resumed
+    run on a shrunken world diffs it against its re-derived mesh and
+    emits the ``world_resize``."""
     meta = {
         "format": 2,
         "epoch": int(epoch),
@@ -60,6 +71,11 @@ def _build_train_meta(epoch, rng, scheduler, early, ckpt, guard, sched=None,
     }
     if stream is not None:
         meta["stream"] = stream
+    if mesh is not None:
+        from hydragnn_tpu.parallel.mesh import current_mesh_gen
+
+        meta["mesh"] = [int(v) for v in mesh]
+        meta["mesh_gen"] = current_mesh_gen()
     if early is not None:
         meta["early"] = early.state_dict()
     if ckpt is not None:
@@ -476,6 +492,7 @@ def train_validate_test(
                 fit_meta = _build_train_meta(
                     epoch0 - 1, rng, scheduler, early, ckpt, guard,
                     sched=sched, stream=_stream_state(),
+                    mesh=_mesh_meta(trainer),
                 )
                 save_model(
                     state, log_name, checkpoint_path,
@@ -622,7 +639,7 @@ def train_validate_test(
         ):
             meta = _build_train_meta(
                 epoch, rng, scheduler, early, ckpt, guard,
-                stream=_stream_state(),
+                stream=_stream_state(), mesh=_mesh_meta(trainer),
             )
             save_model(
                 state, log_name, checkpoint_path,
@@ -647,7 +664,7 @@ def train_validate_test(
             if resume_every > 0 and not trainer.final_state_saved:
                 meta = _build_train_meta(
                     epoch, rng, scheduler, early, ckpt, guard,
-                    stream=_stream_state(),
+                    stream=_stream_state(), mesh=_mesh_meta(trainer),
                 )
                 save_model(
                     state, log_name, checkpoint_path,

@@ -39,7 +39,62 @@ class CompiledSteps:
     )
 
 
-def build_steps(model, tx, training_config: dict) -> CompiledSteps:
+def _sharding_plan(mesh, state_shardings):
+    """Explicit in/out shardings for every compiled program on a mesh.
+
+    The programs used to ASSUME replicated params (no shardings: XLA
+    inherited whatever placement the committed inputs carried). On the
+    2-D mesh that assumption is wrong — params split over ``model`` per
+    the rule engine — so every program declares its contract: state at
+    the rule-engine placement, batches sharded over ``data`` (leading
+    axis; the scan axis of stacked data stays unsharded), scalars/rngs/
+    metrics replicated. Donated buffers keep identical in/out shardings,
+    so donation survives the declarations (the jaxlint missing-donate
+    gate stays clean by construction)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    batch = NamedSharding(mesh, P("data"))
+    stacked = NamedSharding(mesh, P(None, "data"))
+    st = state_shardings
+    return {
+        "train_step": dict(
+            in_shardings=(st, batch, rep), out_shardings=(st, rep)
+        ),
+        "train_multi": dict(
+            in_shardings=(st, stacked, rep), out_shardings=(st, rep)
+        ),
+        "epoch_scan": dict(
+            in_shardings=(st, stacked, rep, rep), out_shardings=(st, rep)
+        ),
+        "eval_epoch": dict(
+            in_shardings=(st.params, st.batch_stats, stacked),
+            out_shardings=rep,
+        ),
+        "predict_scan": dict(
+            in_shardings=(st.params, st.batch_stats, stacked),
+            out_shardings=rep,
+        ),
+        "fit_scan": dict(
+            in_shardings=(
+                st, st, rep, stacked, stacked, stacked, rep, rep, rep
+            ),
+            out_shardings=(st, st, rep, rep),
+        ),
+        "eval_step": dict(
+            in_shardings=(st.params, st.batch_stats, batch),
+            out_shardings=rep,
+        ),
+        "eval_multi": dict(
+            in_shardings=(st.params, st.batch_stats, stacked),
+            out_shardings=rep,
+        ),
+    }
+
+
+def build_steps(
+    model, tx, training_config: dict, mesh=None, state_shardings=None
+) -> CompiledSteps:
     # mixed precision (no reference counterpart — HydraGNN trains pure
     # f32): master params stay f32 for the optimizer; forward/backward
     # runs in bfloat16. Positions stay f32 (geometry — distances/angles
@@ -383,23 +438,26 @@ def build_steps(model, tx, training_config: dict) -> CompiledSteps:
     # once as a `compile` event + per-bucket gauges; otherwise the
     # wrappers are pure passthroughs (.lower() etc. still forward, so
     # benchmarks and the recompile sentinel see the jit they always saw)
+    plan = (
+        _sharding_plan(mesh, state_shardings)
+        if mesh is not None and state_shardings is not None
+        else {}
+    )
+
+    def _jit(name, fn, **kwargs):
+        return instrument(name, jax.jit(fn, **plan.get(name, {}), **kwargs))
+
     steps = CompiledSteps()
-    steps.train_step = instrument(
-        "train_step", jax.jit(train_step, donate_argnums=(0,))
+    steps.train_step = _jit("train_step", train_step, donate_argnums=(0,))
+    steps.train_multi = _jit(
+        "train_multi", multi_train_step, donate_argnums=(0,)
     )
-    steps.train_multi = instrument(
-        "train_multi", jax.jit(multi_train_step, donate_argnums=(0,))
-    )
-    steps.epoch_scan = instrument(
-        "epoch_scan", jax.jit(epoch_scan, donate_argnums=(0,))
-    )
-    steps.eval_epoch = instrument("eval_epoch", jax.jit(eval_epoch))
-    steps.predict_scan = instrument("predict_scan", jax.jit(predict_scan))
+    steps.epoch_scan = _jit("epoch_scan", epoch_scan, donate_argnums=(0,))
+    steps.eval_epoch = _jit("eval_epoch", eval_epoch)
+    steps.predict_scan = _jit("predict_scan", predict_scan)
     # donate state + sched; best_state is NOT donated (its initial value
     # may alias state's buffers)
-    steps.fit_scan = instrument(
-        "fit_scan", jax.jit(fit_scan, donate_argnums=(0, 2))
-    )
-    steps.eval_step = instrument("eval_step", jax.jit(eval_step))
-    steps.eval_multi = instrument("eval_multi", jax.jit(eval_multi))
+    steps.fit_scan = _jit("fit_scan", fit_scan, donate_argnums=(0, 2))
+    steps.eval_step = _jit("eval_step", eval_step)
+    steps.eval_multi = _jit("eval_multi", eval_multi)
     return steps

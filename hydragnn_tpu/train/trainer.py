@@ -76,6 +76,10 @@ class Trainer(PredictMixin):
         self._steps = None
         self._batch_sharding = None
         self._stacked_sharding = None
+        # rule-engine state placement (parallel/rules.py), computed by
+        # place_state and declared as the step programs' in/out shardings
+        self._state_shardings = None
+        self._sharding_summary = None
         # one dispatch runs this many optimizer steps via lax.scan (1 = the
         # plain per-batch path); settable in config or HYDRAGNN_STEPS_PER_DISPATCH
         from hydragnn_tpu.utils.envparse import env_int
@@ -184,49 +188,39 @@ class Trainer(PredictMixin):
         return state
 
     def place_state(self, state: TrainState) -> TrainState:
-        """Replicate the state onto the mesh with the step's input sharding —
-        used at init AND after checkpoint restore (a host-restored state fed
-        straight in costs a duplicate sharding-signature compile)."""
+        """Build the state DIRECTLY at the step programs' input shardings
+        — used at init AND after checkpoint restore (a host-restored
+        state fed straight in costs a duplicate sharding-signature
+        compile; on the 2-D mesh it would hard-error against the
+        explicit ``in_shardings``).
+
+        Placement is the rule engine's (``parallel/rules.py``): matmul
+        weights column-split over ``model``, biases/norms replicated,
+        ZeRO's ``data``-axis overlay on optimizer moments (stage >= 1)
+        and parameters (stage 3) — every leaf lands at its target
+        sharding in one hop, no host-side replicate-then-reshard (which
+        would transiently hold the full state on every device). The
+        multi-process path assembles each leaf's global array from the
+        identical host-local values (seeded init / restored checkpoint)."""
         if self.mesh is None:
             return jax.tree_util.tree_map(jnp.asarray, state)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from hydragnn_tpu.parallel import rules
 
-        if jax.process_count() > 1:
-            # replicated GLOBAL arrays assembled from the (identical)
-            # host-local values on every process. Note: under ZeRO the
-            # opt_state is transiently replicated here before resharding —
-            # multi-host direct placement would need per-leaf global
-            # assembly; single-process (below) places directly.
-            from jax.experimental import multihost_utils
+        self._state_shardings = rules.state_shardings(
+            state,
+            self.mesh,
+            zero_stage=self._zero_stage(),
+            rules=rules.resolve_rules(self.training_config),
+        )
+        self._sharding_summary = rules.summarize_shardings(
+            state, self._state_shardings
+        )
+        return rules.put_tree(state, self._state_shardings)
 
-            state = jax.tree_util.tree_map(np.asarray, state)
-            state = multihost_utils.host_local_array_to_global_array(
-                state, self.mesh, P()
-            )
-            return self._maybe_shard_zero(state)
-        if self._zero_enabled():
-            # place opt-state (and stage-3 params) DIRECTLY at their
-            # target sharding — replicate-then-reshard would transiently
-            # hold the full state on every device, defeating ZeRO at init
-            from hydragnn_tpu.parallel.mesh import (
-                shard_optimizer_state,
-                shard_parameters,
-            )
-
-            opt = shard_optimizer_state(state.opt_state, self.mesh)
-            rep = {"opt_state": None}
-            if self._zero_stage() >= 3:
-                rep["params"] = None
-            placed = jax.device_put(
-                state.replace(**rep), NamedSharding(self.mesh, P())
-            )
-            placed = placed.replace(opt_state=opt)
-            if self._zero_stage() >= 3:
-                placed = placed.replace(
-                    params=shard_parameters(state.params, self.mesh)
-                )
-            return placed
-        return jax.device_put(state, NamedSharding(self.mesh, P()))
+    def sharding_summary(self):
+        """Rule-engine placement report of the last ``place_state`` (the
+        ``param_sharding`` event payload); None before placement."""
+        return self._sharding_summary
 
     def _zero_stage(self) -> int:
         """Resolved ZeRO stage: ``Training.Optimizer.zero_stage`` (0-3,
@@ -247,23 +241,6 @@ class Trainer(PredictMixin):
         sharding decision, not a different optimizer — XLA inserts the
         all-gathers."""
         return self._zero_stage() >= 1
-
-    def _maybe_shard_zero(self, state: TrainState) -> TrainState:
-        if not self._zero_enabled():
-            return state
-        from hydragnn_tpu.parallel.mesh import (
-            shard_optimizer_state,
-            shard_parameters,
-        )
-
-        state = state.replace(
-            opt_state=shard_optimizer_state(state.opt_state, self.mesh)
-        )
-        if self._zero_stage() >= 3:
-            state = state.replace(
-                params=shard_parameters(state.params, self.mesh)
-            )
-        return state
 
     def _compact_for_transfer(
         self, batch: GraphBatch, allow_pos_placeholder: bool = True
@@ -366,7 +343,13 @@ class Trainer(PredictMixin):
 
     # ---- compiled steps ------------------------------------------------
     def _build_steps(self):
-        self._steps = build_steps(self.model, self.tx, self.training_config)
+        self._steps = build_steps(
+            self.model,
+            self.tx,
+            self.training_config,
+            mesh=self.mesh,
+            state_shardings=self._state_shardings,
+        )
 
     # ---- device-resident dataset --------------------------------------
     def stage_batches(self, batches) -> GraphBatch:

@@ -1,0 +1,156 @@
+"""CI smoke: 2-D mesh parity + elastic mesh re-derivation (NOT pytest).
+
+Four phases, each a subprocess of ``_mesh_worker.py``:
+
+1. **4x2 training** on the forced 8-device CPU backend (2 epochs, live
+   telemetry): schema-valid ``mesh_shape`` (shape [4, 2]) +
+   ``param_sharding`` events, per-epoch compile count flat (asserted in
+   the worker).
+2. **1x1 reference** (single forced device, no mesh): the 4x2 loss
+   trajectory must match it to float32 tolerance — 2-D sharding is
+   placement, not arithmetic.
+3. **Kill**: same 4x2 config with ``HYDRAGNN_FAULT_KILL_AT_STEP`` mid
+   epoch 2 — the worker dies hard (exit 113) leaving rolling
+   checkpoints whose train meta records mesh [4, 2].
+4. **Re-derive + resume** on SEVEN devices: ``resolve_mesh`` keeps the
+   model width and drops a data replica — (3, 2) on 6 of 7 devices —
+   the resumed run emits ``world_resize`` with the NEW mesh shape and
+   completes.
+
+Usage: python tests/_mesh_smoke.py <scratch-dir>
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+WORKER = os.path.join(HERE, "_mesh_worker.py")
+PHASE_TIMEOUT = 240
+
+
+def run_worker(workdir, mode, devices, env_extra=None, expect_rc=0):
+    os.makedirs(workdir, exist_ok=True)
+    env = dict(os.environ)
+    env.pop("HYDRAGNN_MESH", None)
+    env.pop("XLA_FLAGS", None)
+    env["MESH_SMOKE_DEVICES"] = str(devices)
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, WORKER, workdir, mode],
+        timeout=PHASE_TIMEOUT,
+        env=env,
+    )
+    assert proc.returncode == expect_rc, (
+        f"worker {mode} (devices={devices}) exited {proc.returncode}, "
+        f"expected {expect_rc}"
+    )
+    result = os.path.join(workdir, "result.json")
+    if expect_rc == 0:
+        with open(result) as f:
+            return json.load(f)
+    return None
+
+
+def load_events(workdir):
+    from hydragnn_tpu.obs.events import validate_events
+
+    return validate_events(
+        os.path.join(workdir, "logs", "mesh-smoke", "events.jsonl")
+    )
+
+
+def main(scratch):
+    shutil.rmtree(scratch, ignore_errors=True)
+    os.makedirs(scratch)
+
+    # ---- phase 1: every mesh shape on 8 devices ------------------------
+    runs = {}
+    for d, m in ((4, 2), (8, 1), (2, 4), (1, 8)):
+        workdir = os.path.join(scratch, f"mesh{d}x{m}")
+        r = run_worker(
+            workdir, "run", devices=8,
+            env_extra={"HYDRAGNN_MESH": f"{d},{m}"},
+        )
+        assert r["mesh"] == [d, m], r
+        runs[(d, m)] = r
+    # the event contract is checked on the 4x2 run (all shapes share it)
+    events = load_events(os.path.join(scratch, "mesh4x2"))
+    by_type = {}
+    for rec in events:
+        by_type.setdefault(rec["event"], rec)
+    assert by_type["mesh_shape"]["shape"] == [4, 2], by_type.get("mesh_shape")
+    assert by_type["mesh_shape"]["axes"] == ["data", "model"]
+    ps = by_type["param_sharding"]
+    assert ps["sharded"] > 0 and ps["sharded_bytes"] > 0, ps
+    print(
+        f"PHASE1 OK 4x2: losses={runs[(4, 2)]['epoch_losses']} "
+        f"compile_sizes={runs[(4, 2)]['compile_sizes']} "
+        f"sharded={ps['sharded']}/{ps['total_leaves']}"
+    )
+
+    # ---- phase 2: single-device reference, parity for EVERY shape ------
+    d_ref = os.path.join(scratch, "single")
+    r_ref = run_worker(d_ref, "run", devices=1)
+    assert r_ref["mesh"] is None, r_ref
+    b = r_ref["epoch_losses"]
+    for (d, m), r in runs.items():
+        a = r["epoch_losses"]
+        assert len(a) == len(b) and len(a) >= 2, (a, b)
+        for x, y in zip(a, b):
+            assert abs(x - y) <= 5e-4 * max(abs(y), 1.0), (
+                f"{d}x{m} trajectory diverged from single-device: "
+                f"{a} vs {b}"
+            )
+    print(f"PHASE2 OK parity across {sorted(runs)}: 1x1 losses={b}")
+
+    # ---- phase 3: kill mid-epoch-2 on 4x2 ------------------------------
+    d_el = os.path.join(scratch, "elastic")
+    run_worker(
+        d_el, "run", devices=8,
+        env_extra={
+            "MESH_SMOKE_MODEL_PARALLEL": "2",
+            "MESH_SMOKE_EPOCHS": "4",
+            # 4 steps/epoch at batch 4 over 16 train samples: step 6 is
+            # mid epoch 2 — after the epoch-1 resumable checkpoint
+            "HYDRAGNN_FAULT_KILL_AT_STEP": "6",
+        },
+        expect_rc=113,
+    )
+    assert not os.path.exists(os.path.join(d_el, "result.json"))
+    print("PHASE3 OK: killed at step 6 (exit 113), checkpoints on disk")
+
+    # ---- phase 4: resume on 7 devices -> re-derived (3, 2) -------------
+    r_res = run_worker(
+        d_el, "resume", devices=7,
+        env_extra={
+            "MESH_SMOKE_MODEL_PARALLEL": "2",
+            "MESH_SMOKE_EPOCHS": "4",
+        },
+    )
+    assert r_res["mesh"] == [3, 2], r_res
+    assert r_res["resumed_from_epoch"] is not None
+    events = load_events(d_el)
+    resizes = [e for e in events if e["event"] == "world_resize"]
+    assert resizes, "no world_resize event after mesh re-derivation"
+    wr = resizes[-1]
+    assert wr["mesh_shape"] == [3, 2], wr
+    assert wr["old_world"] == 8 and wr["new_world"] == 6, wr
+    assert wr["recovery_s"] >= 0
+    assert events[-1]["event"] == "run_end"
+    statuses = [e["status"] for e in events if e["event"] == "run_end"]
+    assert statuses[-1] == "complete", statuses
+    print(
+        f"PHASE4 OK re-derive: resumed at epoch "
+        f"{r_res['resumed_from_epoch']} on mesh {r_res['mesh']}, "
+        f"world_resize {wr['old_world']}->{wr['new_world']} "
+        f"mesh_shape={wr['mesh_shape']}"
+    )
+    print("MESH SMOKE OK")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/mesh-smoke")

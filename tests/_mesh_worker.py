@@ -1,0 +1,206 @@
+"""Worker for the 2-D mesh CI smoke (NOT a pytest module).
+
+One small deterministic training through the REAL epoch driver on
+whatever mesh the environment resolves (``HYDRAGNN_MESH`` /
+``Training.model_parallel`` via ``MESH_SMOKE_MODEL_PARALLEL``), with live
+telemetry so the parent can schema-validate the ``mesh_shape`` /
+``param_sharding`` / ``world_resize`` events. Modes::
+
+    python _mesh_worker.py <workdir> run      # fresh run
+    python _mesh_worker.py <workdir> resume   # Training.continue path
+
+``MESH_SMOKE_DEVICES`` sets the forced host-platform device count (the
+parent shrinks it to 7 for the elastic re-derivation phase). The worker
+asserts the per-epoch compile count stays FLAT after the first epoch and
+dumps ``result.json`` with the loss trajectory. A run killed by
+``HYDRAGNN_FAULT_KILL_AT_STEP`` exits hard and leaves only checkpoints.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("MESH_SMOKE_BASE_XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("MESH_SMOKE_DEVICES", "8")
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+NUM_EPOCH = int(os.environ.get("MESH_SMOKE_EPOCHS", "2"))
+LOG_NAME = "mesh-smoke"
+
+
+def make_samples(num=24, seed=11):
+    from hydragnn_tpu.data.dataobj import GraphData
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        n = 6
+        g = GraphData()
+        g.x = rng.random((n, 1)).astype(np.float32)
+        g.pos = rng.random((n, 3)).astype(np.float32)
+        src = np.arange(n)
+        dst = (src + 1) % n
+        g.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        g.edge_attr = None
+        g.targets = [np.array([g.x.sum()], np.float32), g.x.copy()]
+        g.target_types = ["graph", "node"]
+        out.append(g)
+    return out
+
+
+def build():
+    from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.parallel.mesh import resolve_mesh
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+                "type": "mlp",
+            },
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {
+        "num_epoch": NUM_EPOCH,
+        "perc_train": 0.7,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "resume_every": 1,
+        "checkpoint_keep_last": 4,
+    }
+    mp = os.environ.get("MESH_SMOKE_MODEL_PARALLEL")
+    if mp:
+        training["model_parallel"] = int(mp)
+    # the driver's order: resolve the mesh BEFORE layouts so padding
+    # divides the data axis (on 7 devices at m=2 the count itself would
+    # not divide anything)
+    mesh = resolve_mesh(training)
+    samples = make_samples()
+    layout = compute_layout([samples], batch_size=4, need_triplets=False)
+    train_loader = GraphLoader(samples[:16], 4, layout, shuffle=True, seed=7)
+    val_loader = GraphLoader(samples[16:20], 4, layout, shuffle=False)
+    test_loader = GraphLoader(samples[20:], 4, layout, shuffle=False)
+    model = create_model_config(arch)
+    trainer = Trainer(model, training, mesh=mesh)
+    state = trainer.init_state(next(iter(train_loader)), seed=0)
+    return trainer, state, (train_loader, val_loader, test_loader), training
+
+
+def main():
+    workdir, mode = sys.argv[1], sys.argv[2]
+    os.chdir(workdir)
+    started = time.monotonic()
+
+    from hydragnn_tpu.obs import runtime as obs
+    from hydragnn_tpu.parallel.mesh import announce_mesh, mesh_shape_list
+    from hydragnn_tpu.train.checkpoint import (
+        checkpoint_exists,
+        load_state_dict,
+        pop_train_meta,
+        restore_into,
+        rolling_checkpoints,
+    )
+    from hydragnn_tpu.train.epoch_driver import train_validate_test
+
+    trainer, state, loaders, training = build()
+
+    resume_meta = None
+    if mode == "resume":
+        if not (checkpoint_exists(LOG_NAME) or rolling_checkpoints(LOG_NAME)):
+            raise FileNotFoundError("resume requested but no checkpoint")
+        restored = load_state_dict(LOG_NAME)
+        resume_meta = pop_train_meta(restored)
+        state = trainer.place_state(restore_into(state, restored))
+
+    config = {"NeuralNetwork": {"Training": training}}
+    telemetry = obs.init_run_telemetry(config, LOG_NAME, path="./logs/")
+    # the driver's announce: mesh_shape + param_sharding events, and the
+    # re-derive world_resize when the checkpoint recorded another mesh
+    announce_mesh(
+        trainer.mesh, trainer=trainer, resume_meta=resume_meta,
+        started_ts=started,
+    )
+
+    # per-epoch compile-count record: flat after the warmup epoch
+    compile_sizes = []
+    epoch_losses = []
+    orig = trainer.train_epoch
+
+    def counting_train_epoch(st, loader, rng):
+        st, rng, loss, tasks = orig(st, loader, rng)
+        compile_sizes.append(int(trainer._train_step._cache_size()))
+        epoch_losses.append(float(loss))
+        return st, rng, loss, tasks
+
+    trainer.train_epoch = counting_train_epoch
+
+    config_nn = {
+        "Training": training,
+        "Variables_of_interest": {"output_names": ["sum", "x"]},
+    }
+    try:
+        state = train_validate_test(
+            trainer, state, *loaders, config_nn, LOG_NAME, verbosity=0,
+            resume_meta=resume_meta,
+        )
+    except BaseException:
+        obs.deactivate(status="failed")
+        raise
+    obs.deactivate(status="complete")
+
+    # uniform batch shapes: every signature compiles inside epoch 1, so
+    # the cache size must be FLAT across epochs (recompile = regression)
+    if len(compile_sizes) >= 2:
+        assert all(c == compile_sizes[0] for c in compile_sizes), (
+            "compile count grew across epochs: " + repr(compile_sizes)
+        )
+
+    with open("result.json", "w") as f:
+        json.dump(
+            {
+                "mode": mode,
+                "mesh": mesh_shape_list(trainer.mesh),
+                "devices": len(jax.devices()),
+                "epoch_losses": epoch_losses,
+                "compile_sizes": compile_sizes,
+                "resumed_from_epoch": (
+                    None
+                    if resume_meta is None
+                    else int(resume_meta["epoch"]) + 1
+                ),
+            },
+            f,
+        )
+
+
+if __name__ == "__main__":
+    main()

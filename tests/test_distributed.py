@@ -1,0 +1,179 @@
+"""Direct units for ``parallel/distributed.py`` (212 LoC that were only
+exercised incidentally): scheduler env detection and process-count/rank
+derivation, SLURM nodelist/timeleft parsing, the nearly-even local-shard
+split, host collectives' single-process identities, and the
+``make_array_from_process_local_data`` layout round-trip on the forced
+8-device mesh."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from hydragnn_tpu.parallel import distributed as dist
+
+
+# ---- process-count / rank derivation --------------------------------------
+
+
+def pytest_setup_distributed_single_process(monkeypatch):
+    """No cluster env -> (1, 0) with no jax.distributed.initialize."""
+    for var in (
+        "HYDRAGNN_TPU_COORDINATOR", "HYDRAGNN_TPU_NUM_PROCESSES",
+        "HYDRAGNN_TPU_PROCESS_ID", "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    called = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: called.setdefault("kw", kw),
+    )
+    world, rank = dist.setup_distributed()
+    assert (world, rank) == (1, 0)
+    assert "kw" not in called
+
+
+def pytest_setup_distributed_slurm_derivation(monkeypatch):
+    """SLURM env -> coordinator from the nodelist head + configured port,
+    process count/id from SLURM_NTASKS/SLURM_PROCID."""
+    monkeypatch.setattr(dist, "_initialized", False)
+    for var in ("HYDRAGNN_TPU_COORDINATOR", "OMPI_COMM_WORLD_SIZE"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    monkeypatch.setenv("SLURM_PROCID", "2")
+    monkeypatch.setenv("SLURM_NODELIST", "frontier[00007-00010]")
+    monkeypatch.setenv("HYDRAGNN_TPU_PORT", "23456")
+    called = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: called.update(kw)
+    )
+    dist.setup_distributed()
+    assert called["coordinator_address"] == "frontier00007:23456"
+    assert called["num_processes"] == 4
+    assert called["process_id"] == 2
+    monkeypatch.setattr(dist, "_initialized", False)
+
+
+def pytest_setup_distributed_openmpi_derivation(monkeypatch):
+    monkeypatch.setattr(dist, "_initialized", False)
+    for var in ("HYDRAGNN_TPU_COORDINATOR", "SLURM_NTASKS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    called = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: called.update(kw)
+    )
+    dist.setup_distributed()
+    assert called["num_processes"] == 2
+    assert called["process_id"] == 1
+    monkeypatch.setattr(dist, "_initialized", False)
+
+
+def pytest_get_comm_size_and_rank_single():
+    assert dist.get_comm_size_and_rank() == (1, 0)
+
+
+# ---- local-shard math ------------------------------------------------------
+
+
+def pytest_nsplit_nearly_even():
+    chunks = [list(c) for c in dist.nsplit(list(range(10)), 3)]
+    assert chunks == [[0, 1, 2, 3], [4, 5, 6], [7, 8, 9]]
+    # every element exactly once, sizes differ by at most one
+    sizes = [len(c) for c in chunks]
+    assert max(sizes) - min(sizes) <= 1
+    assert sorted(sum(chunks, [])) == list(range(10))
+    # more shards than items: trailing shards are empty, nothing is lost
+    chunks = [list(c) for c in dist.nsplit(list(range(2)), 4)]
+    assert sorted(sum(chunks, [])) == [0, 1]
+    assert len(chunks) == 4
+
+
+def pytest_parse_slurm_nodelist_forms():
+    assert dist.parse_slurm_nodelist("node1,node2") == ["node1", "node2"]
+    assert dist.parse_slurm_nodelist("frontier[00001-00003,00007]") == [
+        "frontier00001", "frontier00002", "frontier00003", "frontier00007",
+    ]
+
+
+def pytest_parse_slurm_timeleft_forms():
+    assert dist._parse_slurm_timeleft("1-02:03:04") == (
+        ((1 * 24 + 2) * 60 + 3) * 60 + 4
+    )
+    assert dist._parse_slurm_timeleft("02:03:04") == (2 * 60 + 3) * 60 + 4
+    assert dist._parse_slurm_timeleft("03:04") == 3 * 60 + 4
+    assert dist._parse_slurm_timeleft("59") == 59
+    assert dist._parse_slurm_timeleft("INVALID") is None
+    assert dist._parse_slurm_timeleft("") is None
+
+
+def pytest_check_remaining_non_slurm(monkeypatch):
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    assert dist.check_remaining(1e9) is True
+
+
+# ---- host collectives (single-process identities) --------------------------
+
+
+def pytest_host_allreduce_single_process_identity():
+    """On one process every op is the identity (the multi-process branch
+    needs real peers; test_multiprocess covers it)."""
+    arr = np.arange(6, dtype=np.float64).reshape(2, 3)
+    for op in ("sum", "max", "min"):
+        np.testing.assert_array_equal(dist.host_allreduce(arr, op), arr)
+
+
+def pytest_host_allgather_int_single():
+    assert dist.host_allgather_int(7) == [7]
+
+
+# ---- make_array_from_process_local_data layout round-trip ------------------
+
+
+def pytest_process_local_data_round_trip_1d():
+    """The multi-host batch-assembly primitive, on the forced 8-device
+    mesh: a P('data')-sharded assembly reads back bitwise, and each
+    device holds exactly its contiguous row block."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hydragnn_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    sharding = NamedSharding(mesh, P("data"))
+    host = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = jax.make_array_from_process_local_data(sharding, host)
+    np.testing.assert_array_equal(np.asarray(arr), host)
+    rows = 16 // mesh.shape["data"]
+    for shard in arr.addressable_shards:
+        lo = shard.index[0].start or 0
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), host[lo : lo + rows]
+        )
+
+
+def pytest_process_local_data_round_trip_2d():
+    """Same primitive on the 2-D mesh: P('data') shards rows over the
+    data axis only — every model-group replica of a row block is
+    identical (the layout put_batch relies on)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hydragnn_tpu.parallel.mesh import make_mesh2d
+
+    mesh = make_mesh2d(4, 2)
+    sharding = NamedSharding(mesh, P("data"))
+    host = np.arange(8 * 2, dtype=np.float32).reshape(8, 2)
+    arr = jax.make_array_from_process_local_data(sharding, host)
+    np.testing.assert_array_equal(np.asarray(arr), host)
+    # 4-way row split, each block present on BOTH model devices
+    seen = {}
+    for shard in arr.addressable_shards:
+        lo = shard.index[0].start or 0
+        seen.setdefault(lo, []).append(np.asarray(shard.data))
+    assert len(seen) == 4
+    for lo, copies in seen.items():
+        assert len(copies) == 2
+        np.testing.assert_array_equal(copies[0], copies[1])
+        np.testing.assert_array_equal(copies[0], host[lo : lo + 2])

@@ -1,0 +1,405 @@
+"""Partition-rule engine, 2-D mesh derivation, and collective accounting.
+
+Units for ``parallel/rules.py`` (regex -> placement, fail-loud unmatched,
+divisibility fallback, ZeRO overlay), ``parallel/mesh.py`` (best-fit
+(d, m) factorization — the elastic re-mesh rule — and the
+``shard_over_data_axis`` shim fix), and ``parallel/collectives.py`` (HLO
+collective bytes attributed to mesh axes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from hydragnn_tpu.parallel import rules as prules
+from hydragnn_tpu.parallel.mesh import (
+    best_mesh_shape,
+    data_axis_multiple,
+    make_mesh,
+    make_mesh2d,
+    mesh_shape_list,
+    set_active_mesh,
+    shard_over_data_axis,
+)
+
+
+def _mesh2d(d=4, m=2):
+    return make_mesh2d(d, m)
+
+
+# ---- rule matching --------------------------------------------------------
+
+
+def pytest_rules_kernel_cols_bias_replicated():
+    mesh = _mesh2d()
+    tree = {
+        "lin": {"kernel": np.zeros((16, 8), np.float32),
+                "bias": np.zeros((8,), np.float32)},
+        "bn": {"scale": np.zeros((16,), np.float32),
+               "mean": np.zeros((16,), np.float32)},
+    }
+    sh = prules.match_partition_rules(tree, mesh)
+    assert tuple(sh["lin"]["kernel"].spec) == (None, "model")
+    assert tuple(sh["lin"]["bias"].spec) == ()
+    assert tuple(sh["bn"]["scale"].spec) == ()
+    assert tuple(sh["bn"]["mean"].spec) == ()
+
+
+def pytest_rules_rank3_kernel_shards_last_dim():
+    """MLPNode stacked heads: kernel_0 is [1, in, out] — the cols action
+    must land on the LAST dim regardless of rank."""
+    mesh = _mesh2d()
+    tree = {"head": {"kernel_0": np.zeros((1, 16, 8), np.float32),
+                     "bias_0": np.zeros((1, 8), np.float32)}}
+    sh = prules.match_partition_rules(tree, mesh)
+    assert tuple(sh["head"]["kernel_0"].spec) == (None, None, "model")
+    assert tuple(sh["head"]["bias_0"].spec) == ()
+
+
+def pytest_rules_divisibility_fallback():
+    """A matched kernel whose output dim does not divide the model axis
+    replicates instead of erroring (uneven device_put is a hard error in
+    jax) — the fallback is visible in the summary."""
+    mesh = _mesh2d(4, 2)
+    tree = {"pre_nn": {"kernel": np.zeros((6, 3), np.float32)}}
+    sh = prules.match_partition_rules(tree, mesh)
+    assert tuple(sh["pre_nn"]["kernel"].spec) == ()
+
+
+def pytest_rules_unmatched_fails_loudly():
+    mesh = _mesh2d()
+    tree = {"mystery_weight": np.zeros((16, 8), np.float32)}
+    with pytest.raises(ValueError, match="mystery_weight"):
+        prules.match_partition_rules(tree, mesh)
+    # non-strict: replicates instead
+    sh = prules.match_partition_rules(tree, mesh, strict=False)
+    assert tuple(sh["mystery_weight"].spec) == ()
+
+
+def pytest_state_shardings_lenient_on_data_only_mesh():
+    """Strictness is load-bearing only where placement has a choice: an
+    unknown param name on a pure 1-D data mesh replicates (a working
+    config must not break), while the same state on a model-axis mesh
+    raises."""
+    from flax import struct
+
+    class FakeState(struct.PyTreeNode):
+        params: dict
+        batch_stats: dict
+        opt_state: dict
+        step: jnp.ndarray
+
+    state = FakeState(
+        params={"mystery_weight": np.zeros((16, 8), np.float32)},
+        batch_stats={}, opt_state={}, step=jnp.zeros((), jnp.int32),
+    )
+    sh = prules.state_shardings(state, make_mesh(), zero_stage=0)
+    assert tuple(sh.params["mystery_weight"].spec) == ()
+    with pytest.raises(ValueError, match="mystery_weight"):
+        prules.state_shardings(state, _mesh2d(), zero_stage=0)
+
+
+def pytest_rules_scalars_skip_matching():
+    """Scalars/size-1 leaves never consult the rules (so GIN's eps and
+    optax's count need no entry)."""
+    mesh = _mesh2d()
+    tree = {"eps": np.zeros((), np.float32),
+            "count": np.zeros((1,), np.int32)}
+    sh = prules.match_partition_rules(tree, mesh)
+    assert tuple(sh["eps"].spec) == ()
+    assert tuple(sh["count"].spec) == ()
+
+
+def pytest_rules_explicit_spec_exceeding_rank_replicates():
+    """An explicit PartitionSpec rule longer than a matched leaf's rank
+    falls back to replication (the 'matched leaves never error'
+    contract) instead of raising out of place_state."""
+    mesh = _mesh2d()
+    tree = {"att": np.zeros((128,), np.float32),
+            "w": np.zeros((16, 8), np.float32)}
+    table = ((r"(^|/)(att|w)$", P(None, "model")),)
+    sh = prules.match_partition_rules(tree, mesh, rules=table)
+    assert tuple(sh["att"].spec) == ()          # rank 1 < spec rank 2
+    assert tuple(sh["w"].spec) == (None, "model")
+
+
+def pytest_rules_config_override_precedes_defaults():
+    mesh = _mesh2d()
+    tree = {"lin": {"kernel": np.zeros((16, 8), np.float32)}}
+    table = prules.resolve_rules(
+        {"partition_rules": [[r"(^|/)kernel$", "replicate"]]}
+    )
+    sh = prules.match_partition_rules(tree, mesh, rules=table)
+    assert tuple(sh["lin"]["kernel"].spec) == ()
+    with pytest.raises(ValueError, match="unknown action"):
+        prules.resolve_rules({"partition_rules": [["x", "diagonal"]]})
+
+
+def pytest_rules_zero_overlay_composes_with_model_axis():
+    """ZeRO's data overlay lands on dim 0 ON TOP of the model spec:
+    P('data', 'model') for a divisible kernel moment."""
+    from flax import struct
+
+    class FakeState(struct.PyTreeNode):
+        params: dict
+        batch_stats: dict
+        opt_state: dict
+        step: jnp.ndarray
+
+    mesh = _mesh2d(4, 2)
+    state = FakeState(
+        params={"lin": {"kernel": np.zeros((16, 8), np.float32),
+                        "bias": np.zeros((8,), np.float32)}},
+        batch_stats={},
+        opt_state={"mu": {"lin": {"kernel": np.zeros((16, 8), np.float32),
+                                  "bias": np.zeros((8,), np.float32)}}},
+        step=jnp.zeros((), jnp.int32),
+    )
+    sh = prules.state_shardings(state, mesh, zero_stage=1)
+    assert tuple(sh.opt_state["mu"]["lin"]["kernel"].spec) == ("data", "model")
+    assert tuple(sh.opt_state["mu"]["lin"]["bias"].spec) == ()
+    assert tuple(sh.params["lin"]["kernel"].spec) == (None, "model")
+    sh3 = prules.state_shardings(state, mesh, zero_stage=3)
+    assert tuple(sh3.params["lin"]["kernel"].spec) == ("data", "model")
+
+
+def pytest_summarize_shardings_counts_bytes():
+    mesh = _mesh2d()
+    tree = {"lin": {"kernel": np.zeros((16, 8), np.float32),
+                    "bias": np.zeros((8,), np.float32)}}
+    sh = prules.match_partition_rules(tree, mesh)
+    s = prules.summarize_shardings(tree, sh)
+    assert s["total_leaves"] == 2
+    assert s["sharded"] == 1 and s["replicated"] == 1
+    assert s["sharded_bytes"] == 16 * 8 * 4
+    assert s["replicated_bytes"] == 8 * 4
+    assert s["axis_bytes"] == {"model": 16 * 8 * 4}
+
+
+@pytest.mark.slow
+def pytest_rules_cover_entire_model_zoo():
+    """Strict matching over EVERY stack's full TrainState: a parameter
+    name outside the rule table raises at place_state, so this test is
+    the tripwire that keeps the table complete as models grow.
+    slow-marked (9 model inits); tier-1 still exercises strict matching
+    through every mesh-trainer test and the driver e2e runs."""
+    import optax
+
+    from hydragnn_tpu.models.create import (
+        create_model_config,
+        init_model_params,
+    )
+    from hydragnn_tpu.train.common import TrainState
+    from test_models_forward import arch_config, make_batch
+
+    mesh = _mesh2d(4, 2)
+    for model_type in (
+        "PNA", "GIN", "SAGE", "MFC", "CGCNN", "GAT", "SchNet", "EGNN",
+        "DimeNet",
+    ):
+        batch = make_batch(with_triplets=model_type == "DimeNet")
+        model = create_model_config(arch_config(model_type))
+        variables = init_model_params(model, batch, seed=0)
+        tx = optax.adamw(1e-3)
+        state = TrainState(
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(variables["params"]),
+            step=jnp.zeros((), jnp.int32),
+        )
+        # strict=True: raises listing offenders if the table has a hole
+        sh = prules.state_shardings(state, mesh, zero_stage=0)
+        assert jax.tree_util.tree_structure(sh) == (
+            jax.tree_util.tree_structure(
+                state,
+            )
+        ), model_type
+
+
+# ---- shard_over_data_axis shim fix ---------------------------------------
+
+
+def pytest_shim_divisible_bias_no_longer_shards():
+    """THE satellite fix: a size-8 bias on an 8-way data mesh used to
+    shard silently (dim 0 divides the axis); the rule-engine route
+    replicates it while kernels still shard."""
+    mesh = make_mesh()  # 1-D ("data",) over all 8 devices
+    tree = {"lin": {"kernel": np.ones((16, 4), np.float32),
+                    "bias": np.ones((8,), np.float32)}}
+    placed = shard_over_data_axis(tree, mesh)
+    assert tuple(placed["lin"]["kernel"].sharding.spec) == ("data",)
+    assert tuple(placed["lin"]["bias"].sharding.spec) == ()
+    # and values are untouched
+    np.testing.assert_array_equal(
+        np.asarray(placed["lin"]["kernel"]), tree["lin"]["kernel"]
+    )
+
+
+def pytest_shim_respects_replicate_rule_names():
+    mesh = make_mesh()
+    # a 2-D leaf with a replicate-rule NAME (batch-norm scale stacked
+    # per-layer) stays replicated even though dim 0 divides
+    tree = {"bn": {"scale": np.ones((8, 16), np.float32)}}
+    placed = shard_over_data_axis(tree, mesh)
+    assert tuple(placed["bn"]["scale"].sharding.spec) == ()
+
+
+# ---- best-fit mesh derivation (the elastic re-mesh rule) ------------------
+
+
+def pytest_best_mesh_shape_table():
+    assert best_mesh_shape(8, 1) == (8, 1)
+    assert best_mesh_shape(8, 2) == (4, 2)
+    assert best_mesh_shape(8, 4) == (2, 4)
+    assert best_mesh_shape(8, 8) == (1, 8)
+    # a shrunken world KEEPS the model width and drops data replicas
+    assert best_mesh_shape(7, 2) == (3, 2)
+    assert best_mesh_shape(5, 4) == (1, 4)
+    # degenerate corners
+    assert best_mesh_shape(1, 8) == (1, 1)
+    assert best_mesh_shape(3, 0) == (3, 1)
+
+
+def pytest_mesh_shape_list_and_active_multiple():
+    mesh = _mesh2d(4, 2)
+    assert mesh_shape_list(mesh) == [4, 2]
+    assert mesh_shape_list(None) is None
+    try:
+        set_active_mesh(mesh)
+        assert data_axis_multiple() == 4
+        set_active_mesh(None)
+        assert data_axis_multiple() == jax.device_count()
+    finally:
+        set_active_mesh(None)
+
+
+def pytest_requested_mesh_env_and_config(monkeypatch):
+    from hydragnn_tpu.parallel.mesh import requested_mesh
+
+    monkeypatch.delenv("HYDRAGNN_MESH", raising=False)
+    assert requested_mesh({"model_parallel": 2}) == (None, 2)
+    assert requested_mesh({"mesh_shape": [4, 2]}) == (4, 2)
+    assert requested_mesh({}) == (None, 1)
+    monkeypatch.setenv("HYDRAGNN_MESH", "2,4")
+    assert requested_mesh({"model_parallel": 8}) == (2, 4)  # env wins
+    monkeypatch.setenv("HYDRAGNN_MESH", "4")
+    assert requested_mesh(None) == (None, 4)
+    monkeypatch.setenv("HYDRAGNN_MESH", "banana")
+    with pytest.raises(ValueError, match="HYDRAGNN_MESH"):
+        requested_mesh(None)
+    monkeypatch.delenv("HYDRAGNN_MESH")
+    with pytest.raises(ValueError, match="mesh_shape"):
+        requested_mesh({"mesh_shape": [8]})  # [d, m] typo'd to one entry
+
+
+def pytest_resolve_mesh_re_derives_oversized_request(monkeypatch):
+    """A requested shape that no longer fits the visible devices (the
+    elastic-shrink scenario) re-derives via best_mesh_shape instead of
+    failing — on this 8-device host, 16,2 -> (4, 2)."""
+    from hydragnn_tpu.parallel.mesh import resolve_mesh
+
+    monkeypatch.setenv("HYDRAGNN_MESH", "16,2")
+    try:
+        mesh = resolve_mesh({})
+        assert mesh_shape_list(mesh) == [4, 2]
+    finally:
+        set_active_mesh(None)
+
+
+# ---- collective-bytes HLO accounting -------------------------------------
+
+
+def pytest_collective_bytes_attributed_per_axis():
+    from hydragnn_tpu.parallel.collectives import collective_bytes_by_axis
+
+    mesh = _mesh2d(4, 2)
+    x_sh = jax.sharding.NamedSharding(mesh, P("data"))
+    w_sh = jax.sharding.NamedSharding(mesh, P(None, "model"))
+    rep = jax.sharding.NamedSharding(mesh, P())
+
+    def f(x, w):
+        loss = ((x @ w) ** 2).mean()
+        g = jax.grad(lambda w: ((x @ w) ** 2).mean())(w)
+        return loss, g
+
+    jf = jax.jit(f, in_shardings=(x_sh, w_sh), out_shardings=(rep, w_sh))
+    x = jax.device_put(jnp.ones((16, 8)), x_sh)
+    w = jax.device_put(jnp.ones((8, 4)), w_sh)
+    compiled = jf.lower(x, w).compile()
+    out = collective_bytes_by_axis(compiled.as_text(), ("data", "model"), (4, 2))
+    # the dW contraction all-reduces over data; the mean over model —
+    # both axes must carry bytes, and nothing lands in "other"
+    assert out.get("data", 0) > 0, out
+    assert out.get("model", 0) > 0, out
+    assert "other" not in out, out
+
+
+def pytest_collective_bytes_group_formats():
+    from hydragnn_tpu.parallel.collectives import (
+        classify_groups,
+        collective_bytes_by_axis,
+    )
+
+    # explicit groups, stride-m = data axis on a (4, 2) mesh
+    assert classify_groups(
+        [(0, 2, 4, 6), (1, 3, 5, 7)], ("data", "model"), (4, 2)
+    ) == "data"
+    # consecutive runs of m = model axis
+    assert classify_groups(
+        [(0, 1), (2, 3), (4, 5), (6, 7)], ("data", "model"), (4, 2)
+    ) == "model"
+    # one full-mesh group on a genuinely 2-D mesh is a global reduce
+    assert classify_groups(
+        [tuple(range(8))], ("data", "model"), (4, 2)
+    ) == "global"
+    # ... but IS the data axis when model is degenerate
+    assert classify_groups(
+        [tuple(range(8))], ("data", "model"), (8, 1)
+    ) == "data"
+    # iota spelling, bytes summed from the result type
+    hlo = (
+        "%ar = f32[2,8]{1,0} all-reduce(f32[2,8]{1,0} %dot), channel_id=3,"
+        " replica_groups=[4,2]<=[8], use_global_device_ids=true"
+    )
+    out = collective_bytes_by_axis(hlo, ("data", "model"), (4, 2))
+    assert out == {"model": 2 * 8 * 4}
+    # transposed iota = data axis
+    hlo_t = (
+        "%ar = bf16[4]{0} all-reduce(bf16[4]{0} %v), channel_id=1,"
+        " replica_groups=[2,4]<=[4,2]T(1,0), use_global_device_ids=true"
+    )
+    out = collective_bytes_by_axis(hlo_t, ("data", "model"), (4, 2))
+    assert out == {"data": 4 * 2}
+    # -done lines of async pairs are not double counted
+    hlo_async = (
+        "%s = f32[4]{0} all-reduce-start(f32[4]{0} %v),"
+        " replica_groups=[4,2]<=[8]\n"
+        "%d = f32[4]{0} all-reduce-done(f32[4]{0} %s)"
+    )
+    out = collective_bytes_by_axis(hlo_async, ("data", "model"), (4, 2))
+    assert out == {"model": 16}
+    # async TUPLE type (operand, result): only the result half counts —
+    # else async vs sync spellings of the same collective diverge
+    hlo_tuple = (
+        "%ag = (f32[8,4]{1,0}, f32[16,4]{1,0}) all-gather-start("
+        "f32[8,4]{1,0} %v), replica_groups=[4,2]<=[8], dimensions={0}"
+    )
+    out = collective_bytes_by_axis(hlo_tuple, ("data", "model"), (4, 2))
+    assert out == {"model": 16 * 4 * 4}
+
+
+def pytest_resolve_mesh_honors_explicit_1d_width(monkeypatch):
+    """HYDRAGNN_MESH='4,1' pins a 4-device 1-D mesh — an explicit narrow
+    layout must not silently widen to every device."""
+    from hydragnn_tpu.parallel.mesh import resolve_mesh
+
+    monkeypatch.setenv("HYDRAGNN_MESH", "4,1")
+    try:
+        mesh = resolve_mesh({})
+        assert tuple(mesh.axis_names) == ("data",)
+        assert mesh.shape["data"] == 4
+    finally:
+        set_active_mesh(None)

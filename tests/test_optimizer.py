@@ -41,6 +41,14 @@ def pytest_optimizers(opt_type):
 
 
 def pytest_zero_redundancy_sharding():
+    """The ZeRO helper routes through the rule engine: weight-like
+    (ndim >= 2) moments shard over data, 1-D bias moments REPLICATE (the
+    old shape heuristic sharded a divisible-size bias silently). The
+    step programs now declare explicit in_shardings, so arbitrary
+    external reshards are corrected by place_state — which restores the
+    step contract and training still steps."""
+    from jax.sharding import PartitionSpec as P
+
     batch = make_batch()
     model = create_model_config(arch_config("SAGE"))
     mesh = make_mesh()
@@ -49,7 +57,30 @@ def pytest_zero_redundancy_sharding():
     )
     state = trainer.init_state(batch)
     sharded = shard_optimizer_state(state.opt_state, mesh)
-    state = state.replace(opt_state=sharded)
+    import jax.tree_util as jtu
+
+    def name_of(path):
+        return "/".join(
+            str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k))))
+            for k in path
+        )
+
+    specs = {
+        name_of(path): tuple(leaf.sharding.spec)
+        for path, leaf in jtu.tree_flatten_with_path(sharded)[0]
+        if hasattr(leaf, "sharding")
+    }
+    kernels = {k: v for k, v in specs.items() if k.endswith("kernel")}
+    biases = {
+        k: v
+        for k, v in specs.items()
+        if k.endswith("bias") or k.endswith("scale")
+    }
+    assert kernels and any(v and v[0] == "data" for v in kernels.values()), specs
+    # THE fix: divisible-size biases no longer shard silently
+    assert all(v == () for v in biases.values()), biases
+    # an externally resharded state re-enters the step via place_state
+    state = trainer.place_state(state.replace(opt_state=sharded))
     rng = jax.random.PRNGKey(0)
     state, metrics = trainer._train_step(state, trainer.put_batch(batch), rng)
     assert np.isfinite(float(metrics["loss"]))
