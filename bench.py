@@ -521,11 +521,12 @@ MXU_HEADLINE = dict(model_type="PNA", hidden=256, num_graphs=64, nodes=90,
 def bench_headline_mxu():
     """Primary headline (round-4 verdict item 6): fence-true train-step
     throughput of the OC20-shaped PNA hidden-256 dense-bf16 config — an
-    MXU-scale surface that actually moves when kernels improve."""
+    MXU-scale surface that actually moves when kernels improve. Returns
+    the full bench row (the headline line also reports its MFU — the
+    number the ROADMAP's <1% -> double-digits campaign is judged by)."""
     from benchmarks.model_bench import bench_model
 
-    row = bench_model(**MXU_HEADLINE, iters=20)
-    return float(row["graphs_per_sec"])
+    return bench_model(**MXU_HEADLINE, iters=20)
 
 
 def bench_mesh(mesh_arg: str):
@@ -548,7 +549,20 @@ def main():
         return
     # primary headline FIRST: a failure in the (much longer) legacy
     # measurement must not cost the round its recorded number
-    ours = bench_headline_mxu()
+    headline_row = bench_headline_mxu()
+    ours = float(headline_row["graphs_per_sec"])
+    # the headline's MFU only rides the driver-parsed line when the
+    # device kind has a REAL peak entry — model_bench's 197-TFLOP/s
+    # fallback is fine for the annotated BENCH_EXTRA rows (they carry
+    # peak_tflops_assumed) but would record a fabricated campaign metric
+    # here, where no disclaimer travels with the number
+    from hydragnn_tpu.obs.ledger import PEAK_FLOPS
+
+    mfu_pct = (
+        headline_row.get("mfu_pct")
+        if headline_row.get("device_kind") in PEAK_FLOPS
+        else None
+    )
     try:
         legacy = bench_ours()
     except Exception as e:
@@ -598,19 +612,23 @@ def main():
     # the machine-readable headline MUST be the last stdout line and small:
     # the driver tail-captures stdout and json-parses the final line
     sys.stdout.flush()
-    print(headline_line(ours, base, legacy, legacy_base))
+    print(headline_line(ours, base, legacy, legacy_base, mfu_pct=mfu_pct))
 
 
-def headline_line(ours, base, legacy, legacy_base):
+def headline_line(ours, base, legacy, legacy_base, mfu_pct=None):
     """The one driver-parsed stdout line. Compact separators and no
     legacy_metric key (it is the constant
     ``pna_multihead_train_graphs_per_sec``, documented in BASELINE.md) keep
-    the line tail-capture safe (<200 chars) with both headlines aboard."""
+    the line tail-capture safe (<200 chars) with both headlines aboard.
+    ``mfu_pct`` is the headline config's measured MFU (XLA-counted FLOPs
+    vs the device-kind peak, obs/ledger.PEAK_FLOPS) — the ROADMAP's MFU
+    campaign reads its progress off this line."""
     return json.dumps(
         {
             "metric": "oc20_pna_h256_dense_bf16_graphs_per_sec",
             "value": round(ours, 2),
             "unit": "graphs/sec",
+            "mfu_pct": mfu_pct,
             "vs_baseline": round(ours / base, 3) if base else None,
             "legacy_value": round(legacy, 2) if legacy else None,
             "legacy_vs_baseline": (

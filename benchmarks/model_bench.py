@@ -28,14 +28,15 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # peak dense-matmul TFLOP/s per chip by device kind; used for the MFU
-# denominator. bf16 figures (fp32 runs are reported against the same
-# denominator — conservative, since fp32 peak is lower).
+# denominator. Derived from the ONE peak table the goodput/MFU ledger
+# owns (obs/ledger.PEAK_FLOPS) so the bench MFU and the live
+# hydragnn_train_mfu gauge cannot drift. bf16 column on purpose: fp32
+# rows report against the same denominator — conservative, since fp32
+# peak is lower (the live gauge is precision-aware instead).
+from hydragnn_tpu.obs.ledger import PEAK_FLOPS as _LEDGER_PEAK_FLOPS
+
 _PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,  # v5p
-    "TPU v6 lite": 918.0,  # v6e / Trillium
+    kind: row["bf16"] / 1e12 for kind, row in _LEDGER_PEAK_FLOPS.items()
 }
 _DEFAULT_PEAK = 197.0
 

@@ -40,6 +40,11 @@ class StreamLoader:
     as a cap, so iteration simply ends at the true batch count.
     """
 
+    # this loader measures its own consumer-side stalls and reports them
+    # through obs.stream_epoch_stats — the trainer's data-wait accounting
+    # (goodput ledger) must not time the same waits a second time
+    reports_stream_stats = True
+
     def __init__(
         self,
         mix: WeightedMix,

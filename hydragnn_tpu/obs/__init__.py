@@ -49,6 +49,13 @@ from hydragnn_tpu.obs.introspect import (
     TraceCapture,
     instrument,
 )
+from hydragnn_tpu.obs.ledger import (
+    CATEGORIES,
+    GoodputLedger,
+    build_fleet_report,
+    flag_stragglers,
+    resolve_peak_flops,
+)
 from hydragnn_tpu.obs.runtime import (
     FlightRecorder,
     RunTelemetry,
@@ -61,10 +68,12 @@ from hydragnn_tpu.obs.runtime import (
 from hydragnn_tpu.obs.scalars import ScalarWriter
 
 __all__ = [
+    "CATEGORIES",
     "DEFAULT_LATENCY_BOUNDS",
     "EPOCH_LATENCY_BOUNDS",
     "EVENT_FIELDS",
     "FlightRecorder",
+    "GoodputLedger",
     "InstrumentedJit",
     "LatencyHistogram",
     "MetricsRegistry",
@@ -79,8 +88,11 @@ __all__ = [
     "TrainingMetrics",
     "activate",
     "active",
+    "build_fleet_report",
     "deactivate",
+    "flag_stragglers",
     "init_run_telemetry",
     "instrument",
+    "resolve_peak_flops",
     "validate_events",
 ]

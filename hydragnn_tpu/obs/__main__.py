@@ -7,9 +7,21 @@ Subcommands::
         [--check-budget .perf-baseline.json] [--tolerance F]
         [--write-budget .perf-baseline.json]
 
+    fleet <run-or-coordination dir>
+        [--format text|markdown|json]
+        [--straggler-factor F] [--min-steps N]
+
+``fleet`` merges every per-host event stream (rank 0's ``events.jsonl``
+plus the elastic hosts' ``events-host<k>.jsonl``) and the elastic
+heartbeat leases' step-time digests found under the directory into one
+cross-host view: per-host step-time distributions, straggler flags (host
+p50 > factor x the leave-one-out fleet median), and ``world_resize``
+recovery windows priced as lost goodput.
+
 Exit status: 0 clean, 1 when ``--check-budget`` finds a figure over
-budget, 2 on usage errors (missing stream, malformed budget). The CI
-gate runs the smoke training, then::
+budget (or under its MFU floor), 2 on usage errors (missing stream,
+malformed budget, no fleet data). The CI gate runs the smoke training,
+then::
 
     python -m hydragnn_tpu.obs report <run> --check-budget \
         .perf-baseline.json
@@ -19,6 +31,7 @@ import argparse
 import os
 import sys
 
+from hydragnn_tpu.obs import ledger as ledger_mod
 from hydragnn_tpu.obs import report as report_mod
 
 
@@ -60,7 +73,38 @@ def build_parser() -> argparse.ArgumentParser:
     rep.add_argument(
         "--write-budget",
         metavar="FILE",
-        help="write this run's compiled-cost figures as the new baseline",
+        help="write this run's compiled-cost figures (and MFU floors, "
+        "when measured) as the new baseline",
+    )
+    fl = sub.add_parser(
+        "fleet",
+        help="merge an elastic run's per-host streams + heartbeat "
+        "digests into one cross-host rollup",
+    )
+    fl.add_argument(
+        "dir",
+        help="run or coordination directory (searched recursively for "
+        "events*.jsonl streams and workers/host-*.json leases)",
+    )
+    fl.add_argument(
+        "--format",
+        choices=sorted(ledger_mod.FLEET_RENDERERS),
+        default="text",
+        help="output format (default: text)",
+    )
+    fl.add_argument(
+        "--straggler-factor",
+        type=float,
+        default=2.0,
+        help="flag a host when its step p50 exceeds this multiple of "
+        "the leave-one-out fleet median (default: 2.0)",
+    )
+    fl.add_argument(
+        "--min-steps",
+        type=int,
+        default=3,
+        help="hosts with fewer recorded steps neither flag nor count "
+        "toward the median (default: 3)",
     )
     return p
 
@@ -140,13 +184,34 @@ def _run_report(args) -> int:
                 "compiled program in this run",
                 file=sys.stderr,
             )
+        # an MFU floor the run could not measure (no peak-FLOPs entry,
+        # telemetry off) is a NOTE, never a silent pass or a failure
+        for name, entry in sorted(budget["programs"].items()):
+            if "mfu_floor" not in entry:
+                continue
+            current = report["programs"].get(name)
+            if current is not None and current.get("mfu") is None:
+                print(
+                    f"obs report: note: budget entry {name} has an MFU "
+                    "floor but this run measured no MFU (peak FLOPs "
+                    "unresolvable? goodput ledger inactive?)",
+                    file=sys.stderr,
+                )
         for v in violations:
-            print(
-                f"obs report: OVER BUDGET: {v['bucket']} {v['metric']} "
-                f"{v['current']:.6g} > limit {v['limit']:.6g} "
-                f"(baseline {v['baseline']:.6g}, x{v['ratio']:.3f})",
-                file=sys.stderr,
-            )
+            if v["metric"] == "mfu_floor":
+                print(
+                    f"obs report: UNDER MFU FLOOR: {v['bucket']} mfu "
+                    f"{v['current']:.6g} < limit {v['limit']:.6g} "
+                    f"(floor {v['baseline']:.6g}, x{v['ratio']:.3f})",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"obs report: OVER BUDGET: {v['bucket']} {v['metric']} "
+                    f"{v['current']:.6g} > limit {v['limit']:.6g} "
+                    f"(baseline {v['baseline']:.6g}, x{v['ratio']:.3f})",
+                    file=sys.stderr,
+                )
         if violations:
             return 1
         print(
@@ -157,12 +222,34 @@ def _run_report(args) -> int:
     return 0
 
 
+def _run_fleet(args) -> int:
+    if not os.path.isdir(args.dir):
+        print(f"obs fleet: {args.dir} is not a directory", file=sys.stderr)
+        return 2
+    report = ledger_mod.build_fleet_report(
+        args.dir,
+        straggler_factor=args.straggler_factor,
+        min_steps=args.min_steps,
+    )
+    if not report["streams"] and not report["hosts"]:
+        print(
+            f"obs fleet: no event streams or worker leases found under "
+            f"{args.dir}",
+            file=sys.stderr,
+        )
+        return 2
+    print(ledger_mod.FLEET_RENDERERS[args.format](report), end="")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if args.command != "report":
-        build_parser().print_help(sys.stderr)
-        return 2
-    return _run_report(args)
+    if args.command == "report":
+        return _run_report(args)
+    if args.command == "fleet":
+        return _run_fleet(args)
+    build_parser().print_help(sys.stderr)
+    return 2
 
 
 if __name__ == "__main__":

@@ -96,6 +96,14 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # completed|failed|killed, reason names the failure/kill cause
     # (garbled_output, heartbeat_timeout, divergence, timeout, exit_<rc>)
     "hpo_trial": ("trial", "status"),
+    # goodput ledger (obs/ledger.py): one per epoch window — `seconds`
+    # and `fractions` map every CATEGORIES entry (compute/data_stall/
+    # collective/checkpoint/compile/guard_recovery/eval/other) to its
+    # attributed wall time / fraction (fractions sum to 1 by
+    # construction); optional `mfu` carries per-bucket
+    # {mfu, flops, steps_per_sec, peak_flops}
+    "goodput": ("epoch", "wall_s", "seconds", "fractions",
+                "goodput_fraction"),
 }
 
 _ENVELOPE = ("event", "ts", "seq")

@@ -156,6 +156,14 @@ class MetricsRegistry:
         with self._lock:
             self._values[name][key] = value
 
+    def clear_labeled(self, name: str):
+        """Drop every label set of a :meth:`labeled_gauge` — for families
+        whose membership is a LIVE view (e.g. per-host fleet gauges): a
+        member that disappeared must stop being exported, not freeze at
+        its last value."""
+        with self._lock:
+            self._values[name].clear()
+
     def observe(self, name: str, seconds: float):
         with self._lock:
             self._values[name].observe(seconds)
@@ -229,7 +237,18 @@ class ServeMetrics:
     ``errors_total``. ``shed_total`` counts queue-full rejections (never
     accepted, so not in ``requests_total``). Padding waste is tracked as
     the two raw integrals (real vs padded node rows) so the ratio stays
-    exact under any aggregation window."""
+    exact under any aggregation window.
+
+    SLO accounting (roadmap item 3 prerequisite): every DEADLINE-CARRYING
+    request that reaches a terminal serving outcome resolves to exactly
+    one ``deadline_met_total`` / ``deadline_missed_total`` — missed
+    covers both in-queue expiry (``on_timeout`` counts it automatically)
+    and a response delivered after its deadline. Requests that FAIL
+    (``errors_total``) are serving failures, not deadline outcomes, and
+    touch neither counter — reconcile against ``errors_total``
+    separately. ``slo_misses_total`` is the alertable counter
+    (== missed); ``slo_miss_ratio`` the derived gauge. Requests without
+    deadlines never touch these series."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -245,6 +264,8 @@ class ServeMetrics:
         self.real_node_rows = 0
         self.padded_node_rows = 0
         self.queue_depth = 0
+        self.deadline_met_total = 0
+        self.deadline_missed_total = 0
         self.request_latency = LatencyHistogram()
         self.batch_latency = LatencyHistogram()
 
@@ -258,8 +279,20 @@ class ServeMetrics:
             self.shed_total += 1
 
     def on_timeout(self, n: int = 1):
+        # an in-queue expiry IS a missed deadline (only deadline-carrying
+        # requests can time out)
         with self._lock:
             self.timeouts_total += n
+            self.deadline_missed_total += n
+
+    def on_deadline(self, met: bool, n: int = 1):
+        """A deadline-carrying request completed: did its response land
+        before the deadline?"""
+        with self._lock:
+            if met:
+                self.deadline_met_total += n
+            else:
+                self.deadline_missed_total += n
 
     def on_error(self, n: int = 1):
         with self._lock:
@@ -325,6 +358,17 @@ class ServeMetrics:
                     else 1.0 - self.real_node_rows / self.padded_node_rows,
                     6,
                 ),
+                "deadline_met_total": self.deadline_met_total,
+                "deadline_missed_total": self.deadline_missed_total,
+                "slo_miss_ratio": round(
+                    self.deadline_missed_total
+                    / max(
+                        self.deadline_met_total
+                        + self.deadline_missed_total,
+                        1,
+                    ),
+                    6,
+                ),
                 "request_latency": self.request_latency.state(),
                 "batch_latency": self.batch_latency.state(),
             }
@@ -367,4 +411,25 @@ class ServeMetrics:
             ("batch_latency_seconds", s["batch_latency"]),
         ):
             lines.extend(render_summary(prefix, name, hist))
+        # SLO series appended AFTER the historical exposition so existing
+        # consumers' byte offsets are untouched (the golden parity test
+        # was updated deliberately for these lines)
+        counter(
+            "slo_misses_total",
+            s["deadline_missed_total"],
+            "Deadline-carrying requests that missed their deadline",
+        )
+        lines.append(
+            f'{prefix}_deadline_outcomes_total{{outcome="met"}} '
+            f'{s["deadline_met_total"]}'
+        )
+        lines.append(
+            f'{prefix}_deadline_outcomes_total{{outcome="missed"}} '
+            f'{s["deadline_missed_total"]}'
+        )
+        counter(
+            "slo_miss_ratio",
+            s["slo_miss_ratio"],
+            "Fraction of deadline-carrying requests that missed",
+        )
         return "\n".join(lines) + "\n"

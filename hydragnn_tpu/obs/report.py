@@ -103,7 +103,10 @@ def build_report(records: List[Dict]) -> Dict:
         throughput["mean_padding_waste"] = sum(waste) / len(waste)
 
     # per-bucket compiled cost: LAST capture wins (a resumed run's
-    # recompile re-reports the same bucket)
+    # recompile re-reports the same bucket). Collective result bytes
+    # (PR 10's per-axis accounting riding the compile events) roll up
+    # per axis over the DEDUPED programs — summing raw records would
+    # double-count every bucket a resumed run recompiled.
     programs: Dict[str, Dict] = {}
     for r in records:
         if r["event"] != "compile":
@@ -119,7 +122,44 @@ def build_report(records: List[Dict]) -> Dict:
             "argument_bytes": _num(mem.get("argument_bytes")),
             "output_bytes": _num(mem.get("output_bytes")),
             "temp_bytes": _num(mem.get("temp_bytes")),
+            "collectives": {
+                str(axis): _num(v)
+                for axis, v in (r.get("collectives") or {}).items()
+            },
         }
+    collectives: Dict[str, float] = {}
+    for p in programs.values():
+        for axis, v in (p.get("collectives") or {}).items():
+            if v is not None:
+                collectives[axis] = collectives.get(axis, 0.0) + float(v)
+
+    # goodput ledger events (obs/ledger.py): per-epoch category fractions
+    # + the per-bucket MFU figures (LAST value per bucket wins — it saw
+    # the most warmed-up steps); the MFU lands on the program entry so
+    # the budget ratchet can floor it
+    goodput = []
+    for r in records:
+        if r["event"] != "goodput":
+            continue
+        goodput.append(
+            {
+                "epoch": r.get("epoch"),
+                "wall_s": _num(r.get("wall_s")),
+                "fractions": r.get("fractions") or {},
+                "goodput_fraction": _num(r.get("goodput_fraction")),
+                "mfu": r.get("mfu") or {},
+            }
+        )
+        for bucket, m in (r.get("mfu") or {}).items():
+            if bucket in programs and isinstance(m, dict):
+                if _num(m.get("mfu")) is not None:
+                    programs[bucket]["mfu"] = float(m["mfu"])
+
+    # the run's device mesh (parallel/mesh.py announce_mesh): the header
+    # should say what hardware layout produced these figures
+    mesh = next(
+        (r for r in reversed(records) if r["event"] == "mesh_shape"), None
+    )
 
     counts = {
         key: sum(1 for r in records if r["event"] == key)
@@ -186,10 +226,14 @@ def build_report(records: List[Dict]) -> Dict:
             "status": run_end["status"] if run_end else "incomplete",
             "duration_s": round(ts[-1] - ts[0], 3) if len(ts) > 1 else None,
             "events": len(records),
+            "mesh_shape": mesh.get("shape") if mesh else None,
+            "mesh_axes": mesh.get("axes") if mesh else None,
         },
         "epochs": epochs,
         "throughput": throughput,
         "programs": programs,
+        "collectives": collectives,
+        "goodput": goodput,
         "counts": counts,
         "timeline": timeline,
     }
@@ -246,7 +290,12 @@ _PROGRAM_COLS = (
     ("args", "argument_bytes"),
     ("out", "output_bytes"),
     ("temp", "temp_bytes"),
+    ("mfu", "mfu"),
 )
+
+
+def _fmt_pct(v) -> str:
+    return "-" if v is None else f"{100.0 * float(v):.2f}%"
 
 
 def _program_rows(report) -> List[List[str]]:
@@ -263,6 +312,7 @@ def _program_rows(report) -> List[List[str]]:
                 _fmt_bytes(p.get("argument_bytes")),
                 _fmt_bytes(p.get("output_bytes")),
                 _fmt_bytes(p.get("temp_bytes")),
+                _fmt_pct(p.get("mfu")),
             ]
         )
     return rows
@@ -299,11 +349,19 @@ def _md_table(headers, rows) -> List[str]:
 def _summary_lines(report) -> List[str]:
     run = report["run"]
     c = report["counts"]
+    mesh = ""
+    if run.get("mesh_shape"):
+        axes = run.get("mesh_axes") or []
+        mesh = (
+            "  mesh: "
+            + "x".join(str(v) for v in run["mesh_shape"])
+            + (f" ({', '.join(str(a) for a in axes)})" if axes else "")
+        )
     lines = [
         f"run: {run['run']}  status: {run['status']}  "
         f"git: {run['git_rev']}  config: {run['config_hash']}",
         f"world: {run['world_size']} process(es) x "
-        f"{run['device_count']} {run['device_kind']} device(s)  "
+        f"{run['device_count']} {run['device_kind']} device(s){mesh}  "
         f"epochs: {len(report['epochs'])}/{run['num_epoch']}  "
         f"duration: {_fmt(run['duration_s'], 5)}s",
         "counts: "
@@ -327,6 +385,28 @@ def _summary_lines(report) -> List[str]:
     return lines
 
 
+def _goodput_cols(report):
+    """(headers, rows) of the per-epoch goodput table — epoch, wall, and
+    one fraction column per category that ever appeared."""
+    from hydragnn_tpu.obs.ledger import CATEGORIES
+
+    seen = set()
+    for g in report.get("goodput", []):
+        seen.update(g.get("fractions") or {})
+    cats = [c for c in CATEGORIES if c in seen] + sorted(
+        seen - set(CATEGORIES)
+    )
+    headers = ["epoch", "wall_s"] + list(cats)
+    rows = []
+    for g in report.get("goodput", []):
+        fr = g.get("fractions") or {}
+        rows.append(
+            [_fmt(g.get("epoch"), 4), _fmt(g.get("wall_s"), 4)]
+            + [_fmt_pct(_num(fr.get(c))) for c in cats]
+        )
+    return headers, rows
+
+
 def render_text(report: Dict) -> str:
     lines = ["== run report =="]
     lines += _summary_lines(report)
@@ -335,11 +415,22 @@ def render_text(report: Dict) -> str:
         lines += _text_table(
             [h for h, _ in _EPOCH_COLS], _epoch_rows(report)
         )
+    if report.get("goodput"):
+        lines += ["", "-- goodput (wall-time fraction per category) --"]
+        headers, rows = _goodput_cols(report)
+        lines += _text_table(headers, rows)
     if report["programs"]:
         lines += ["", "-- compiled programs (XLA cost/memory) --"]
         lines += _text_table(
             [h for h, _ in _PROGRAM_COLS], _program_rows(report)
         )
+    if report.get("collectives"):
+        lines += ["", "-- collective bytes (per mesh axis, summed over "
+                  "captured programs) --"]
+        for axis in sorted(report["collectives"]):
+            lines.append(
+                f"{axis}: {_fmt_bytes(report['collectives'][axis])}"
+            )
     if report["timeline"]:
         lines += ["", "-- timeline (s after first event) --"]
         for item in report["timeline"]:
@@ -355,10 +446,23 @@ def render_markdown(report: Dict) -> str:
     if report["epochs"]:
         lines += ["", "## Epochs", ""]
         lines += _md_table([h for h, _ in _EPOCH_COLS], _epoch_rows(report))
+    if report.get("goodput"):
+        lines += ["", "## Goodput (wall-time fraction per category)", ""]
+        headers, rows = _goodput_cols(report)
+        lines += _md_table(headers, rows)
     if report["programs"]:
         lines += ["", "## Compiled programs (XLA cost/memory)", ""]
         lines += _md_table(
             [h for h, _ in _PROGRAM_COLS], _program_rows(report)
+        )
+    if report.get("collectives"):
+        lines += ["", "## Collective bytes (per mesh axis)", ""]
+        lines += _md_table(
+            ["axis", "bytes"],
+            [
+                [axis, _fmt_bytes(report["collectives"][axis])]
+                for axis in sorted(report["collectives"])
+            ],
         )
     if report["timeline"]:
         lines += ["", "## Timeline", ""]
@@ -388,12 +492,20 @@ RENDERERS = {
 
 def budget_from_report(report: Dict,
                        tolerance: float = DEFAULT_TOLERANCE) -> Dict:
-    """The committed-baseline content for this run's compiled programs."""
+    """The committed-baseline content for this run's compiled programs.
+
+    When the run produced an MFU figure for a bucket (goodput ledger +
+    a resolvable peak — see docs/observability.md "Goodput & MFU"), it is
+    recorded as that bucket's ``mfu_floor``: the check direction INVERTS
+    for it (dropping below floor x (1 - tolerance) fails), so an MFU
+    regression gates CI exactly like a step-cost regression."""
     programs = {}
     for key, p in sorted(report["programs"].items()):
         entry = {
             m: p[m] for m in BUDGET_METRICS if p.get(m) is not None
         }
+        if p.get("mfu") is not None:
+            entry["mfu_floor"] = p["mfu"]
         if entry:
             programs[key] = entry
     return {
@@ -438,6 +550,29 @@ def check_budget(
         if current is None:
             continue
         for metric, base in baseline.items():
+            if metric == "mfu_floor":
+                # lower-bound metric: the run's MFU must not DROP below
+                # floor x (1 - tolerance). A run with no MFU at all
+                # (no peak-FLOPs entry, introspection off) is a note in
+                # the CLI, never a silent pass-as-violation.
+                cur = current.get("mfu")
+                if cur is None or base is None:
+                    continue
+                limit = float(base) * (1.0 - tol)
+                if float(cur) < limit:
+                    violations.append(
+                        {
+                            "bucket": key,
+                            "metric": metric,
+                            "baseline": float(base),
+                            "limit": limit,
+                            "current": float(cur),
+                            "ratio": float(cur) / float(base)
+                            if base
+                            else 0.0,
+                        }
+                    )
+                continue
             cur = current.get(metric)
             if cur is None or base is None:
                 continue

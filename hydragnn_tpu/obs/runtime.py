@@ -113,6 +113,10 @@ class TrainingMetrics:
     ``ServeMetrics`` is the other client of the same machinery."""
 
     def __init__(self):
+        # scrape-time poll hooks (device memory is built in; the elastic
+        # fleet poll registers here) — run on every render, never in the
+        # step loop
+        self.extra_polls = []
         r = MetricsRegistry("hydragnn_train")
         r.counter("epochs_total", "Completed epochs")
         r.counter("steps_total", "Dispatched optimizer steps")
@@ -192,6 +196,27 @@ class TrainingMetrics:
             "stream_source_fraction",
             "Fraction of last epoch's draws per mix source",
         )
+        # goodput & MFU ledger (obs/ledger.py): per-category wall-time
+        # fractions of the last closed epoch window (sum to 1), and
+        # per-bucket model FLOPs utilization against the device's peak
+        r.labeled_gauge(
+            "goodput_fraction",
+            "Last epoch's wall-time fraction per goodput category",
+        )
+        r.labeled_gauge(
+            "mfu",
+            "Model FLOPs utilization per train bucket (vs device peak)",
+        )
+        # fleet view (elastic runs; the leader polls peer heartbeat
+        # digests at scrape time — obs/ledger.py poll_fleet_gauges)
+        r.labeled_gauge(
+            "fleet_step_p50_seconds",
+            "Per-host step-time p50 from elastic heartbeat digests",
+        )
+        r.gauge(
+            "fleet_straggler_hosts",
+            "Hosts whose step p50 exceeds the fleet median threshold",
+        )
         # live device memory, polled from device 0's memory_stats() at
         # scrape time (stays 0 on backends that report none, e.g. CPU)
         r.gauge("device_bytes_in_use", "Live device memory in use")
@@ -270,6 +295,11 @@ class TrainingMetrics:
             "heartbeat_age_seconds", max(time.time() - self.last_beat, 0.0)
         )
         self.poll_device_memory()
+        for poll in self.extra_polls:
+            try:
+                poll()
+            except Exception:
+                pass  # a poll hook must never break /metrics
         return self.registry.render_prometheus()
 
     def snapshot(self) -> Dict:
@@ -281,6 +311,9 @@ _compile_listener_registered = False
 # installed, whether or not a telemetry run is active. The recompile
 # sentinel (analysis/guards.py) diffs it around a warmed-up region.
 _compile_events = 0
+# ... and the matching duration integral: total backend-compile seconds,
+# the goodput ledger's `compile` category signal (obs/ledger.py)
+_compile_seconds = 0.0
 
 
 def _register_compile_listener():
@@ -298,9 +331,13 @@ def _register_compile_listener():
         def _on_duration(event: str, duration: float = 0.0, **kwargs):
             # '/jax/core/compile/backend_compile_duration' fires once per
             # actual XLA compilation (cache hits don't reach the backend)
-            global _compile_events
+            global _compile_events, _compile_seconds
             if "backend_compile" in event:
                 _compile_events += 1
+                try:
+                    _compile_seconds += float(duration)
+                except (TypeError, ValueError):
+                    pass
                 t = _active
                 if t is not None:
                     t.metrics.registry.inc("compiles_total")
@@ -323,6 +360,12 @@ def install_compile_listener() -> bool:
 def compile_events() -> int:
     """Backend compilations observed since the listener was installed."""
     return _compile_events
+
+
+def compile_seconds() -> float:
+    """Cumulative backend-compile wall seconds (0.0 when the monitoring
+    API is unavailable — the ledger's compile category then reads 0)."""
+    return _compile_seconds
 
 
 def _config_hash(config: dict) -> str:
@@ -362,17 +405,19 @@ class RunTelemetry:
         log_dir: str,
         port: Optional[int] = None,
         events: bool = True,
+        events_file: str = "events.jsonl",
     ):
         from hydragnn_tpu.obs.introspect import (
             TraceCapture,
             parse_profile_at_step,
         )
+        from hydragnn_tpu.obs.ledger import GoodputLedger, poll_fleet_gauges
 
         self.run_name = run_name
         self.log_dir = log_dir
         self.metrics = TrainingMetrics()
         self.events: Optional[RunEventLog] = (
-            RunEventLog(os.path.join(log_dir, "events.jsonl"))
+            RunEventLog(os.path.join(log_dir, events_file))
             if events
             else None
         )
@@ -394,6 +439,23 @@ class RunTelemetry:
         # per-axis collective-bytes running totals (record_compile)
         self._collective_totals: Dict[str, float] = {}
         self._compile_events_at_step = _compile_events
+        self._compile_seconds_at_step = _compile_seconds
+        # goodput & MFU ledger: per-epoch wall-time attribution + the
+        # hydragnn_train_mfu{bucket=} gauges (obs/ledger.py)
+        self.ledger = GoodputLedger(
+            registry=self.metrics.registry,
+            emit=self.emit,
+            compile_seconds=compile_seconds,
+        )
+        # elastic runs: the leader's /metrics scrape also polls the peer
+        # heartbeat digests into the fleet gauges
+        coord_dir = os.getenv("HYDRAGNN_ELASTIC_DIR")
+        if coord_dir:
+            self.metrics.extra_polls.append(
+                lambda: poll_fleet_gauges(
+                    coord_dir, self.metrics.registry
+                )
+            )
         _register_compile_listener()
         if port is not None:
             from hydragnn_tpu.obs.http import ObservabilityServer
@@ -432,6 +494,18 @@ class RunTelemetry:
         # mid-run novel-bucket compile is worse than no alarm.
         compiled_now = _compile_events != self._compile_events_at_step
         self._compile_events_at_step = _compile_events
+        compile_delta = _compile_seconds - self._compile_seconds_at_step
+        self._compile_seconds_at_step = _compile_seconds
+        # goodput attribution + the elastic heartbeat's step-time digest
+        # (the digest skips compile-heavy steps the same way the flight
+        # recorder does — a 3-step host must not read as a straggler
+        # because its first step compiled)
+        self.ledger.on_step(
+            seconds, count, compile_delta if compiled_now else 0.0
+        )
+        from hydragnn_tpu.train import elastic as _elastic
+
+        _elastic.note_step_time(seconds, count, compiled=compiled_now)
         if not compiled_now:
             # per-step time: K-step scan dispatches must compare against
             # single-step dispatches on the same scale, or bucketed runs
@@ -462,6 +536,9 @@ class RunTelemetry:
     def on_epoch_start(self, epoch: int):
         self.current_epoch = int(epoch)
         self._step_in_epoch = 0
+        # closes (and publishes) the previous goodput window — post-epoch
+        # work like the resumable checkpoint save lands in ITS epoch
+        self.ledger.epoch_begin(epoch)
 
     def on_dispatch_boundary(self):
         """Fit-path granularity: whole-training chunks dispatch as ONE
@@ -488,6 +565,7 @@ class RunTelemetry:
         mem = rec.get("memory") or {}
         coll = rec.get("collectives") or {}
         bucket = rec["bucket"]
+        self.ledger.note_program(rec)  # train-bucket FLOPs feed the MFU
         if cost.get("flops"):
             self.metrics.registry.set_labeled(
                 "flops_per_step", float(cost["flops"]), bucket=bucket
@@ -530,6 +608,7 @@ class RunTelemetry:
 
         devices = jax.devices()
         self.metrics.registry.set("world_size", float(jax.process_count()))
+        host = os.getenv("HYDRAGNN_ELASTIC_HOST")
         self.emit(
             "run_manifest",
             schema_version=SCHEMA_VERSION,
@@ -544,12 +623,21 @@ class RunTelemetry:
                 .get("Training", {})
                 .get("num_epoch", 0)
             ),
+            # elastic runs: which HOST wrote this stream segment — the
+            # fleet rollup attributes rank 0's shared events.jsonl to
+            # hosts by walking these manifests across generations
+            **({} if host is None else {"host": int(host)}),
         )
 
     def close(self, status: str = "complete"):
         if self._closed:
             return
         self._closed = True
+        # the last epoch's goodput window closes with the run
+        try:
+            self.ledger.finalize()
+        except Exception:
+            pass
         # a run dying mid-capture must still flush a loadable trace
         flushed = self.trace.close()
         if flushed is not None:
@@ -634,6 +722,10 @@ def epoch_complete(
         nodes_per_sec=nodes_per_sec,
         padding_waste=padding_waste,
     )
+    if seconds is not None:
+        # whole-dispatch epochs (staged / fit chunks) have no per-step
+        # hook; the driver's measured train wall is their compute signal
+        t.ledger.note_train_wall(seconds)
     t.emit(
         "epoch",
         epoch=int(epoch),
@@ -675,12 +767,16 @@ def guard_skip(scope: str, skipped: int, streak: int = 0):
            streak=int(streak))
 
 
-def guard_restore(restores: int, lr: float):
+def guard_restore(restores: int, lr: float, seconds: float = 0.0):
     t = _active
     if t is None:
         return
     t.metrics.registry.inc("guard_restores_total")
-    t.emit("guard_restore", restores=int(restores), lr=float(lr))
+    t.ledger.guard_cost(seconds)
+    t.emit(
+        "guard_restore", restores=int(restores), lr=float(lr),
+        **({} if not seconds else {"seconds": round(float(seconds), 6)}),
+    )
 
 
 def checkpoint_saved(name: str, kind: str, **fields):
@@ -688,6 +784,12 @@ def checkpoint_saved(name: str, kind: str, **fields):
     if t is None:
         return
     t.metrics.registry.inc("checkpoints_saved_total")
+    # goodput: a sync save costs the loop snapshot + serialize/write; an
+    # async one only the device->host snapshot (the write overlaps)
+    cost = float(fields.get("snapshot_s") or 0.0)
+    if not fields.get("async"):
+        cost += float(fields.get("write_s") or 0.0)
+    t.ledger.checkpoint_cost(cost)
     t.emit("checkpoint_saved", name=name, kind=kind, **fields)
 
 
@@ -715,6 +817,7 @@ def stream_epoch_stats(
     t = _active
     if t is None:
         return
+    t.ledger.data_wait(stall_s)  # the goodput data_stall signal
     r = t.metrics.registry
     r.set("stream_queue_depth", float(queue_depth))
     r.set("stream_stall_seconds", float(stall_s))
@@ -753,6 +856,21 @@ def world_resized(old_world: int, new_world: int, gen: int,
     )
 
 
+def eval_start():
+    """The epoch driver is entering its val/test evaluation — opens a
+    goodput eval span (compile time and data waits inside the span stay
+    in their own categories)."""
+    t = _active
+    if t is not None:
+        t.ledger.eval_begin()
+
+
+def eval_complete():
+    t = _active
+    if t is not None:
+        t.ledger.eval_end()
+
+
 # ---- run construction ----------------------------------------------------
 
 
@@ -761,12 +879,15 @@ def init_run_telemetry(
 ) -> Optional[RunTelemetry]:
     """Build + activate telemetry for a driver run, honoring the env/config
     knobs (module docstring). Returns None (hooks stay no-ops) on
-    non-zero ranks or when disabled."""
+    non-zero ranks — EXCEPT under elastic mode, where every host writes
+    its own ``events-host<k>.jsonl`` next to rank 0's ``events.jsonl``
+    (no HTTP endpoint, no shared-file contention) so the fleet rollup
+    (``python -m hydragnn_tpu.obs fleet``) has a per-host record of
+    stalls, goodput, and step times — a straggler is only visible from
+    the host it lives on."""
     from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
 
     _, rank = get_comm_size_and_rank()
-    if rank != 0:
-        return None
     tcfg = config.get("Telemetry", {}) or {}
     env = os.getenv("HYDRAGNN_TELEMETRY")
     enabled = (
@@ -776,6 +897,18 @@ def init_run_telemetry(
     )
     if not enabled:
         return None
+    if rank != 0:
+        host = os.getenv("HYDRAGNN_ELASTIC_HOST")
+        if not os.getenv("HYDRAGNN_ELASTIC_DIR") or host is None:
+            return None
+        telemetry = RunTelemetry(
+            log_name,
+            os.path.join(path, log_name),
+            port=None,
+            events_file=f"events-host{int(host)}.jsonl",
+        )
+        telemetry.emit_manifest(config, log_name)
+        return activate(telemetry)
     port_env = os.getenv("HYDRAGNN_OBS_PORT")
     port: Optional[int]
     if port_env is not None and port_env.strip() != "":

@@ -496,6 +496,13 @@ class InferenceServer:
                     per_head.append(outputs[ihead][off: off + n])
             req.future.set_result(per_head)
             self.metrics.on_response_latency(now - req.enqueued_at)
+            # SLO accounting: a deadline-carrying request that still got
+            # its answer counts met/missed by when the answer LANDED (a
+            # result delivered late is a miss even though it resolved;
+            # in-queue expiries were already counted by on_timeout).
+            # Errored requests are failures, not deadline outcomes.
+            if req.deadline is not None:
+                self.metrics.on_deadline(now <= req.deadline)
         self.metrics.on_batch(
             bucket,
             len(requests),

@@ -100,6 +100,13 @@ DEFAULT_LEASE_S = 6.0
 _progress = {"step": 0, "epoch": 0, "guard_restores": 0, "progress_ts": 0.0}
 _beating = False  # one global read gates every hook (the faults.py pattern)
 _runtime: Optional["ElasticRuntime"] = None
+# compact per-host step-time digest riding the heartbeat payload: the
+# leader's /metrics scrape (and the offline fleet rollup) reads every
+# host's p50/p99 out of the lease files, so a straggler is visible from
+# any host without shipping event streams around. A LatencyHistogram is
+# a few hundred bytes; torn heartbeat reads of it are as acceptable as
+# they are for _progress.
+_step_hist = None
 # the worker's device-mesh shape [d, m] (parallel/mesh.py announces it):
 # rides the heartbeat payload and the world_resize event, so a 2-D
 # world's re-mesh is observable as a MESH change, not just a world count
@@ -131,6 +138,31 @@ def note_epoch(epoch: int):
         return
     _progress["epoch"] = int(epoch)
     _progress["progress_ts"] = time.time()
+
+
+def note_step_time(seconds: float, count: int = 1, compiled: bool = False):
+    """One timed step dispatch (fed by ``RunTelemetry.on_step``) — feeds
+    the heartbeat's step-time digest. Compile-containing dispatches are
+    excluded, mirroring the flight recorder: a freshly respawned host's
+    first (compiling) step must not read as straggling."""
+    if not _beating or compiled:
+        return
+    global _step_hist
+    h = _step_hist
+    if h is None:
+        from hydragnn_tpu.obs.metrics import LatencyHistogram
+
+        h = _step_hist = LatencyHistogram()
+    per_step = float(seconds) / max(int(count), 1)
+    for _ in range(max(int(count), 1)):
+        h.observe(per_step)
+
+
+def step_digest() -> Optional[Dict]:
+    """{count, sum, p50, p99} of this host's recorded step times (None
+    before the first timed step) — the heartbeat payload's digest."""
+    h = _step_hist
+    return None if h is None or h.total == 0 else h.state()
 
 
 def note_guard_restore():
@@ -438,6 +470,9 @@ class ElasticRuntime:
                  world=self.world, done=self._done)
         if _mesh_shape is not None:
             p["mesh"] = _mesh_shape
+        digest = step_digest()
+        if digest is not None:
+            p["step_digest"] = digest
         return p
 
     def start(self) -> "ElasticRuntime":
@@ -501,7 +536,14 @@ class FileHeartbeatRuntime:
     divergence signal."""
 
     def __init__(self, path: str, heartbeat_s: float = DEFAULT_HEARTBEAT_S):
-        self.heartbeat = Heartbeat(path, lambda: dict(_progress), heartbeat_s)
+        def _payload():
+            p = dict(_progress)
+            digest = step_digest()
+            if digest is not None:
+                p["step_digest"] = digest
+            return p
+
+        self.heartbeat = Heartbeat(path, _payload, heartbeat_s)
 
     def start(self) -> "FileHeartbeatRuntime":
         global _beating

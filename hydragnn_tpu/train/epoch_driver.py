@@ -549,45 +549,60 @@ def train_validate_test(
         if skip_valtest:
             val_loss, val_tasks = train_loss, train_tasks
             test_loss, test_tasks = train_loss, train_tasks
-        elif staged is not None:
-            # device-resident epoch driver: evals run staged too (one
-            # dispatch + one readback per split, no per-batch H2D). Any
-            # staging/dispatch memory failure downgrades PERMANENTLY to the
-            # streaming evaluate — the eval sets have their own footprint
-            # on top of the staged training set.
-            if staged_evals is None:
-                try:
-                    vb, tb = list(val_loader), list(test_loader)
-                    if not vb or not tb:
-                        raise ValueError("empty eval loader")
-                    staged_evals = (
-                        trainer.stage_batches(vb),
-                        trainer.stage_batches(tb),
-                    )
-                except Exception as e:
-                    if isinstance(e, ValueError) or _is_oom(e):
-                        staged_evals = False
-                    else:
-                        raise
-            if staged_evals:
-                try:
-                    val_loss, val_tasks = trainer.evaluate_staged(
-                        state, staged_evals[0]
-                    )
-                    test_loss, test_tasks = trainer.evaluate_staged(
-                        state, staged_evals[1]
-                    )
-                except Exception as e:
-                    if _is_oom(e):
-                        staged_evals = False
-                    else:
-                        raise
-            if not staged_evals:
-                val_loss, val_tasks = trainer.evaluate(state, val_loader)
-                test_loss, test_tasks = trainer.evaluate(state, test_loader)
         else:
-            val_loss, val_tasks = trainer.evaluate(state, val_loader)
-            test_loss, test_tasks = trainer.evaluate(state, test_loader)
+            # the goodput ledger's eval span: val+test wall lands in the
+            # `eval` category (compile time and data waits inside the
+            # span stay in theirs)
+            obs.eval_start()
+            try:
+                if staged is not None:
+                    # device-resident epoch driver: evals run staged too
+                    # (one dispatch + one readback per split, no per-batch
+                    # H2D). Any staging/dispatch memory failure downgrades
+                    # PERMANENTLY to the streaming evaluate — the eval
+                    # sets have their own footprint on top of the staged
+                    # training set.
+                    if staged_evals is None:
+                        try:
+                            vb, tb = list(val_loader), list(test_loader)
+                            if not vb or not tb:
+                                raise ValueError("empty eval loader")
+                            staged_evals = (
+                                trainer.stage_batches(vb),
+                                trainer.stage_batches(tb),
+                            )
+                        except Exception as e:
+                            if isinstance(e, ValueError) or _is_oom(e):
+                                staged_evals = False
+                            else:
+                                raise
+                    if staged_evals:
+                        try:
+                            val_loss, val_tasks = trainer.evaluate_staged(
+                                state, staged_evals[0]
+                            )
+                            test_loss, test_tasks = trainer.evaluate_staged(
+                                state, staged_evals[1]
+                            )
+                        except Exception as e:
+                            if _is_oom(e):
+                                staged_evals = False
+                            else:
+                                raise
+                    if not staged_evals:
+                        val_loss, val_tasks = trainer.evaluate(
+                            state, val_loader
+                        )
+                        test_loss, test_tasks = trainer.evaluate(
+                            state, test_loader
+                        )
+                else:
+                    val_loss, val_tasks = trainer.evaluate(state, val_loader)
+                    test_loss, test_tasks = trainer.evaluate(
+                        state, test_loader
+                    )
+            finally:
+                obs.eval_complete()
 
         if guard is not None:
             if not (np.isfinite(train_loss) and np.isfinite(val_loss)):

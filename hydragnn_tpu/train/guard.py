@@ -123,6 +123,8 @@ class DivergenceGuard:
         return self._restore()
 
     def _restore(self):
+        import time
+
         self.restores += 1
         if self.restores > self.max_restores:
             raise RuntimeError(
@@ -132,6 +134,7 @@ class DivergenceGuard:
                 "inspect the data/LR, or raise guard_max_restores"
             )
         self.bad_streak = 0
+        t0 = time.perf_counter()
         restored = self._copy(self.last_good)
         lr = get_learning_rate(restored.opt_state) * self.lr_factor
         restored = restored.replace(
@@ -139,7 +142,11 @@ class DivergenceGuard:
         )
         # keep halving across successive restores, not oscillating back up
         self.last_good = self._copy(restored)
-        obs.guard_restore(self.restores, lr)
+        # the measured restore wall is the goodput ledger's
+        # guard_recovery signal (obs/ledger.py)
+        obs.guard_restore(
+            self.restores, lr, seconds=time.perf_counter() - t0
+        )
         # the heartbeat lease carries a guard_restores counter — the HPO
         # launcher's divergence early-kill signal (train/elastic.py)
         from hydragnn_tpu.train import elastic

@@ -110,7 +110,13 @@ def build_steps(
     # fence before enabling (see BASELINE.md measurement note).
     from hydragnn_tpu.models.create import resolve_precision
 
-    mixed = resolve_precision(model, training_config)["mixed"]
+    precision = resolve_precision(model, training_config)
+    mixed = precision["mixed"]
+    # the goodput/MFU ledger judges achieved FLOPs against the precision-
+    # matched peak (bf16 vs f32 column of obs/ledger.PEAK_FLOPS)
+    from hydragnn_tpu.obs import ledger as _ledger
+
+    _ledger.note_precision(mixed, source=precision["source"])
     # divergence guard (train/guard.py): when on, every train step also
     # reports a device-computed "finite" scalar — loss AND all gradient
     # leaves finite — so the host can skip a poisoned update without

@@ -531,7 +531,8 @@ class Trainer(PredictMixin):
         n = max(a[1], 1.0)
         return a[0] / n, a[2:] / n
 
-    def _prefetch_put(self, loader, nbatch, depth, put=None):
+    def _prefetch_put(self, loader, nbatch, depth, put=None,
+                      ledger_waits=True):
         """Yield device-resident batches with up to ``depth`` transfers in
         flight ahead of the consumer. The transfers are issued from a
         background thread (shared :func:`prefetch_iter` machinery): both
@@ -540,6 +541,14 @@ class Trainer(PredictMixin):
         link) — overlap the steps already dispatched on earlier batches.
         ``depth <= 0`` degrades to the strict transfer/step alternation."""
         put = put or self.put_batch
+        # goodput ledger (obs/ledger.py): the wall the consumer spends
+        # waiting on the data plane is the data_stall category — resolved
+        # once per epoch like the trainer's step hook. Callers whose
+        # source loader reports its OWN stalls (StreamLoader via
+        # stream_epoch_stats) pass ledger_waits=False so the same starved
+        # seconds are not attributed twice.
+        _telemetry = obs.active() if ledger_waits else None
+        _ledger = _telemetry.ledger if _telemetry is not None else None
 
         def limited():
             for ibatch, batch in enumerate(loader):
@@ -550,7 +559,10 @@ class Trainer(PredictMixin):
         if depth <= 0:
             for batch in limited():
                 tr.start("dataload")
+                t0 = time.perf_counter() if _ledger is not None else 0.0
                 dev = put(batch)
+                if _ledger is not None:
+                    _ledger.data_wait(time.perf_counter() - t0)
                 tr.stop("dataload")
                 yield dev
             return
@@ -561,6 +573,7 @@ class Trainer(PredictMixin):
         )
         while True:
             tr.start("dataload")  # time spent WAITING on the transfer stage
+            t0 = time.perf_counter() if _ledger is not None else 0.0
             try:
                 try:
                     item = next(it)
@@ -569,6 +582,8 @@ class Trainer(PredictMixin):
             finally:
                 # a worker-side error re-raised by next(it) must not leave
                 # the dataload timer running for the rest of the process
+                if _ledger is not None:
+                    _ledger.data_wait(time.perf_counter() - t0)
                 tr.stop("dataload")
             yield item
 
@@ -637,7 +652,8 @@ class Trainer(PredictMixin):
         _telemetry = obs.active()
         plan = self._group_plan(loader, nbatch, K)
         for dev, count in self._prefetch_put(
-            plan, float("inf"), self.device_prefetch, put=self._put_group
+            plan, float("inf"), self.device_prefetch, put=self._put_group,
+            ledger_waits=not getattr(loader, "reports_stream_stats", False),
         ):
             if count > 1:
                 subs = jax.random.split(rng, count + 1)
@@ -709,7 +725,8 @@ class Trainer(PredictMixin):
         K = max(1, self.steps_per_dispatch)
         plan = self._group_plan(loader, nbatch, K)
         for dev, count in self._prefetch_put(
-            plan, float("inf"), self.device_prefetch, put=self._put_group
+            plan, float("inf"), self.device_prefetch, put=self._put_group,
+            ledger_waits=not getattr(loader, "reports_stream_stats", False),
         ):
             if count > 1:
                 metrics = self._eval_multi(

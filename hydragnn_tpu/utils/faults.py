@@ -101,10 +101,24 @@ def lose_host_at_step(step: int) -> None:
 def slow_step(step: int) -> None:
     """Straggler injection: sleep before dispatching a covered step.
     Spec is ``"SPEC@SECONDS"`` (``"12@0.3"``, ``"4:9@0.05"``); a bare
-    ``"SPEC"`` sleeps the 0.25 s default."""
+    ``"SPEC"`` sleeps the 0.25 s default. With
+    ``HYDRAGNN_FAULT_SLOW_STEP_RANK=K`` only process rank K is slowed —
+    the one-host straggler the goodput fleet rollup exists to flag
+    (every rank otherwise sleeps, which is a fleet-wide slowdown, not a
+    straggler)."""
     spec = os.getenv("HYDRAGNN_FAULT_SLOW_STEP")
     if spec is None:
         return
+    rank_s = os.getenv("HYDRAGNN_FAULT_SLOW_STEP_RANK")
+    if rank_s is not None and rank_s.strip() != "":
+        import jax  # lazy: the no-op path must not initialize a backend
+
+        try:
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+        if rank != int(rank_s):
+            return
     member, _, secs = spec.partition("@")
     if _parse_step_spec(member)(int(step)):
         time.sleep(float(secs) if secs else 0.25)

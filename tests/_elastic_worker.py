@@ -157,11 +157,12 @@ def worker_main(workdir):
     trainer = Trainer(model, training, mesh=None)
     state = trainer.init_state(next(iter(train_loader)), seed=0)
 
-    telemetry = None
-    if rank == 0:
-        telemetry = obs.init_run_telemetry(
-            {"NeuralNetwork": {"Training": training}}, LOG_NAME
-        )
+    # all ranks: rank 0 gets the full events.jsonl stream, the other
+    # hosts get per-host events-host<k>.jsonl streams (elastic mode) so
+    # the fleet rollup sees every host's record
+    telemetry = obs.init_run_telemetry(
+        {"NeuralNetwork": {"Training": training}}, LOG_NAME
+    )
 
     # start-aligned epoch 0: the coordination-service barrier (plain RPC,
     # no XLA collective — works on every backend) removes the multi-second

@@ -1,0 +1,131 @@
+"""CI goodput & fleet smoke (standalone, NOT a pytest module).
+
+Reuses the elastic 2-proc smoke machinery (``tests/_elastic_worker.py``):
+2 agent-supervised CPU training processes with ``HYDRAGNN_FAULT_SLOW_STEP``
+injected on ONE host (rank 0, via HYDRAGNN_FAULT_SLOW_STEP_RANK) and the
+other host fault-killed mid-run, so the produced directory carries every
+fleet signal at once — per-host event streams (rank 0's ``events.jsonl``
++ host 1's ``events-host1.jsonl``), heartbeat leases with step-time
+digests, a ``world_resize`` recovery window, and per-epoch ``goodput``
+events.
+
+Asserts the PR's acceptance bar:
+
+- ``goodput`` events validate against the documented schema and their
+  category fractions sum to 1.0 +- 1e-6;
+- ``obs fleet`` merges BOTH hosts' streams, flags the fault-slowed host
+  as a straggler, and prices the world_resize recovery as lost goodput.
+
+Usage: python tests/_goodput_smoke.py <workdir>
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _elastic_worker  # noqa: E402
+
+# the straggler's per-step sleep. Deliberately LARGE: under CI's CPU
+# contention the victim host's first compile can take >10s, and the
+# slowed survivor must still be mid-run when the kill lands (2 steps/
+# epoch x 8 epochs) or there is no world_resize window to price.
+SLOW_S = 1.0
+
+
+def main(workdir):
+    os.makedirs(workdir, exist_ok=True)
+    rcs = _elastic_worker.run_elastic(
+        workdir,
+        n_hosts=2,
+        extra_env={
+            # host 1 vanishes on its 8th optimizer step (epoch 3 at 2
+            # steps/epoch): late enough that COMPILE-FREE goodput
+            # windows (epochs 1-2, >= 3 steps — the straggler
+            # baseline's qualification bar) exist for it, early enough
+            # that the survivor's re-mesh recovery window is in the
+            # stream
+            "HYDRAGNN_FAULT_LOSE_HOST_AT_STEP": "1:7",
+            # ONE host (rank 0 — the survivor) is the straggler
+            "HYDRAGNN_FAULT_SLOW_STEP": f"0:@{SLOW_S}",
+            "HYDRAGNN_FAULT_SLOW_STEP_RANK": "0",
+        },
+        timeout=300,
+    )
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.utils.faults import KILL_EXIT_CODE
+
+    assert rcs[1] == KILL_EXIT_CODE, f"killed host agent rc: {rcs}"
+    assert rcs[0] == 0, f"survivor agent rc: {rcs}"
+
+    log_dir = os.path.join(workdir, "logs", "elastic")
+
+    # rank 0's stream: schema-valid with goodput + the resize record
+    recs = validate_events(
+        os.path.join(log_dir, "events.jsonl"),
+        require=["goodput", "world_resize", "host_lost"],
+    )
+    goodput = [r for r in recs if r["event"] == "goodput"]
+    for g in goodput:
+        total = sum(g["fractions"].values())
+        assert abs(total - 1.0) < 1e-6, (g["epoch"], total)
+        assert set(g["seconds"]) >= {"compute", "data_stall", "compile",
+                                     "checkpoint", "eval", "other"}
+    # the straggler's own stream shows the slowdown as compute-dominated
+    # step time (>= the injected sleep per step once warmed up)
+    warmed = [g for g in goodput if g["steps"] and not g["seconds"]["compile"]]
+    if warmed:
+        per_step = warmed[-1]["step_s"] / warmed[-1]["steps"]
+        assert per_step >= SLOW_S, warmed[-1]
+
+    # host 1's per-host stream exists and validates (no run_end: the host
+    # was hard-killed — a valid prefix is the contract)
+    host1 = os.path.join(log_dir, "events-host1.jsonl")
+    assert os.path.exists(host1), "host 1 wrote no per-host stream"
+    recs1 = validate_events(host1, require=["run_manifest", "goodput"])
+    assert any(r.get("host") == 1 for r in recs1
+               if r["event"] == "run_manifest")
+
+    # the fleet rollup over the whole directory
+    out = subprocess.run(
+        [sys.executable, "-m", "hydragnn_tpu.obs", "fleet", workdir,
+         "--format", "json"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr
+    fleet = json.loads(out.stdout)
+    assert set(fleet["streams"]) >= {"events.jsonl", "events-host1.jsonl"}, (
+        fleet["streams"]
+    )
+    assert "0" in fleet["hosts"] and "1" in fleet["hosts"], fleet["hosts"]
+    assert fleet["stragglers"] == ["0"], (
+        f"fault-slowed host not flagged: {fleet['stragglers']} "
+        f"(hosts: {fleet['hosts']})"
+    )
+    assert len(fleet["resizes"]) >= 1, "world_resize never priced"
+    assert fleet["lost_goodput_s"] > 0.0, fleet["resizes"]
+    assert 0.0 < fleet["lost_goodput_fraction"] <= 1.0
+
+    # human-readable render exercises the text path too
+    text = subprocess.run(
+        [sys.executable, "-m", "hydragnn_tpu.obs", "fleet", workdir],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert text.returncode == 0 and "STRAGGLER" in text.stdout
+
+    print(
+        "goodput smoke OK: straggler host 0 flagged "
+        f"(p50 {fleet['hosts']['0'].get('p50')}s vs "
+        f"{fleet['hosts']['1'].get('p50')}s), "
+        f"{len(goodput)} goodput events sum to 1, "
+        f"recovery priced at {fleet['lost_goodput_s']}s lost goodput"
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

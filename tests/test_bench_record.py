@@ -101,16 +101,20 @@ def pytest_headline_shape():
     """The driver json-parses the LAST stdout line: keep it one compact
     object with the contracted keys — exercised through the REAL
     formatting helper at worst-case value widths."""
-    line = bench.headline_line(123456.78, 1234.5678, 98765.43, 1234.5678)
+    line = bench.headline_line(
+        123456.78, 1234.5678, 98765.43, 1234.5678, mfu_pct=12.34
+    )
     parsed = json.loads(line)
     assert set(parsed) == {
         "metric",
         "value",
         "unit",
+        "mfu_pct",
         "vs_baseline",
         "legacy_value",
         "legacy_vs_baseline",
     }
+    assert parsed["mfu_pct"] == 12.34
     assert len(line) < 200  # tail-capture safe
     # every baseline may fail independently; Nones must not crash or widen
     assert json.loads(bench.headline_line(1.0, None, None, None))

@@ -1,0 +1,683 @@
+"""Goodput & MFU ledger (obs/ledger.py) + fleet rollup tests.
+
+Covers the PR's acceptance bar directly:
+
+- on a CPU run with a known compiled-cost program,
+  ``hydragnn_train_mfu{bucket=}`` equals the hand-computed
+  ``flops_per_step x steps/sec / peak`` to 1e-6;
+- goodput category fractions sum to 1.0 +- 1e-6 per epoch;
+- the fleet rollup merges multiple hosts' streams, prices world_resize
+  recovery as lost goodput, and flags the slow host as a straggler.
+"""
+
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _resilience_worker import make_samples  # noqa: E402
+
+from hydragnn_tpu.obs import ledger as led  # noqa: E402
+from hydragnn_tpu.obs import runtime as obs_rt  # noqa: E402
+from hydragnn_tpu.obs.events import validate_events  # noqa: E402
+
+
+# ---- peak-FLOPs resolution -----------------------------------------------
+
+
+def pytest_resolve_peak_flops_env_table_and_warn_once(monkeypatch):
+    # env override beats everything (and is the only CPU-side source)
+    monkeypatch.setenv("HYDRAGNN_PEAK_FLOPS", "1.5e12")
+    assert led.resolve_peak_flops("anything") == 1.5e12
+    monkeypatch.delenv("HYDRAGNN_PEAK_FLOPS")
+
+    # table lookup is precision-aware
+    assert led.resolve_peak_flops("TPU v4", mixed=True) == 275e12
+    assert led.resolve_peak_flops("TPU v4", mixed=False) == 137.5e12
+    # default precision follows note_precision
+    led.note_precision(True, source="test")
+    try:
+        assert led.resolve_peak_flops("TPU v5") == 459e12
+    finally:
+        led.note_precision(False, source="test")
+    assert led.resolve_peak_flops("TPU v5") == 229.5e12
+
+    # unknown kinds warn exactly once per kind and return None
+    monkeypatch.setattr(led, "_peak_warned", set())
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert led.resolve_peak_flops("weird-chip-9000") is None
+        assert led.resolve_peak_flops("weird-chip-9000") is None
+    hits = [c for c in caught if "weird-chip-9000" in str(c.message)]
+    assert len(hits) == 1
+
+
+# ---- ledger unit behavior ------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _collecting_ledger(clock, compile_seconds=lambda: 0.0):
+    events = []
+
+    def emit(event, **fields):
+        events.append({"event": event, **fields})
+
+    return led.GoodputLedger(
+        emit=emit, compile_seconds=compile_seconds, clock=clock
+    ), events
+
+
+def pytest_ledger_fractions_sum_to_one_and_attribute():
+    clock = _Clock()
+    compile_box = {"s": 0.0}
+    ledger, events = _collecting_ledger(clock, lambda: compile_box["s"])
+
+    ledger.epoch_begin(0)
+    # 4 steps of 0.5s, the first containing 0.3s of backend compile
+    compile_box["s"] += 0.3
+    ledger.on_step(0.5, 1, compile_s=0.3)
+    for _ in range(3):
+        ledger.on_step(0.5, 1)
+    ledger.data_wait(0.4)
+    ledger.checkpoint_cost(0.2)
+    ledger.guard_cost(0.1)
+    clock.t += 10.0  # the epoch took 10s of wall
+    ledger.epoch_begin(1)  # closes window 0
+
+    assert len(events) == 1 and events[0]["event"] == "goodput"
+    g = events[0]
+    assert g["epoch"] == 0
+    assert abs(g["wall_s"] - 10.0) < 1e-6
+    s = g["seconds"]
+    # compute = step dispatch minus in-step compile
+    assert abs(s["compute"] - (2.0 - 0.3)) < 1e-6
+    assert abs(s["compile"] - 0.3) < 1e-6
+    assert abs(s["data_stall"] - 0.4) < 1e-6
+    assert abs(s["checkpoint"] - 0.2) < 1e-6
+    assert abs(s["guard_recovery"] - 0.1) < 1e-6
+    # other is the residual to the 10s wall
+    assert abs(s["other"] - (10.0 - 2.7)) < 1e-6
+    assert abs(sum(g["fractions"].values()) - 1.0) < 1e-6
+    assert g["goodput_fraction"] == g["fractions"]["compute"]
+
+    # a window whose components EXCEED wall (async overlap) still sums
+    # to exactly 1 with other == 0
+    ledger.on_step(5.0, 1)
+    ledger.checkpoint_cost(5.0)
+    clock.t += 1.0  # wall (1s) < known (10s)
+    ledger.finalize()
+    g1 = events[-1]
+    assert g1["epoch"] == 1
+    assert g1["seconds"]["other"] == 0.0
+    assert abs(sum(g1["fractions"].values()) - 1.0) < 1e-6
+
+
+def pytest_ledger_staged_compute_excludes_only_train_compile():
+    """Eval-span compile is already kept out of the eval category; the
+    staged-path compute deduction must not subtract it from the train
+    wall a second time."""
+    clock = _Clock()
+    box = {"s": 0.0}
+    ledger, events = _collecting_ledger(clock, lambda: box["s"])
+    ledger.epoch_begin(0)
+    box["s"] += 2.0  # train-side compile inside the staged dispatch
+    ledger.note_train_wall(10.0)
+    ledger.eval_begin()
+    box["s"] += 3.0  # eval programs compiling inside the eval span
+    ledger.eval_end()
+    clock.t += 15.0
+    ledger.finalize()
+    g = events[-1]
+    assert abs(g["seconds"]["compile"] - 5.0) < 1e-6
+    # compute = train wall minus the TRAIN-side compile only: 10 - 2
+    assert abs(g["seconds"]["compute"] - 8.0) < 1e-6
+    assert abs(sum(g["fractions"].values()) - 1.0) < 1e-6
+
+
+def pytest_ledger_whole_dispatch_epochs_use_train_wall():
+    """Staged/fit epochs have no per-step hook: the driver's measured
+    train wall is the compute signal."""
+    clock = _Clock()
+    ledger, events = _collecting_ledger(clock)
+    ledger.epoch_begin(0)
+    ledger.note_train_wall(3.0)
+    clock.t += 4.0
+    ledger.finalize()
+    g = events[-1]
+    assert abs(g["seconds"]["compute"] - 3.0) < 1e-6
+    assert abs(g["seconds"]["other"] - 1.0) < 1e-6
+    assert abs(sum(g["fractions"].values()) - 1.0) < 1e-6
+
+
+def pytest_ledger_mfu_hand_computation(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_PEAK_FLOPS", "2e9")
+    clock = _Clock()
+    ledger, events = _collecting_ledger(clock)
+    ledger.note_program(
+        {"name": "train_step", "bucket": "train_step/abc",
+         "cost": {"flops": 1e6}}
+    )
+    # eval buckets never get an MFU
+    ledger.note_program(
+        {"name": "eval_step", "bucket": "eval_step/def",
+         "cost": {"flops": 5e5}}
+    )
+    ledger.epoch_begin(0)
+    for _ in range(10):
+        ledger.on_step(0.01, 1)
+    clock.t += 1.0
+    ledger.finalize()
+    g = events[-1]
+    assert set(g["mfu"]) == {"train_step/abc"}
+    m = g["mfu"]["train_step/abc"]
+    # 10 steps over 0.1s of step time = 100 steps/s
+    assert abs(m["steps_per_sec"] - 100.0) < 1e-6
+    expected = 1e6 * m["steps_per_sec"] / 2e9
+    assert abs(m["mfu"] - expected) < 1e-6
+    assert m["peak_flops"] == 2e9
+
+
+# ---- the CPU acceptance e2e ----------------------------------------------
+
+
+def _build_tiny_training(num_epoch):
+    from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = {
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "output_dim": [1, 1],
+        "output_type": ["graph", "node"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {"num_headlayers": 1, "dim_headlayers": [8],
+                     "type": "mlp"},
+        },
+        "task_weights": [1.0, 1.0],
+    }
+    training = {
+        "num_epoch": num_epoch,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "resume_every": 1,
+    }
+    samples = make_samples()
+    layout = compute_layout([samples], batch_size=4)
+    loaders = (
+        GraphLoader(samples[:16], 4, layout, shuffle=True, seed=7),
+        GraphLoader(samples[16:20], 4, layout, shuffle=False),
+        GraphLoader(samples[20:], 4, layout, shuffle=False),
+    )
+    model = create_model_config(arch)
+    trainer = Trainer(model, training)
+    state = trainer.init_state(next(iter(loaders[0])), seed=0)
+    return trainer, state, loaders, training
+
+
+def pytest_goodput_mfu_acceptance_e2e(tmp_path, monkeypatch):
+    """The PR's acceptance bar: a real CPU training with a configured
+    peak — per-epoch goodput fractions sum to 1 +- 1e-6, and the MFU
+    equals flops x steps/sec / peak to 1e-6, hand-recomputed from the
+    event's own inputs AND cross-checked against the flops gauge."""
+    from hydragnn_tpu.train.epoch_driver import train_validate_test
+
+    monkeypatch.chdir(tmp_path)
+    peak = 1e9
+    monkeypatch.setenv("HYDRAGNN_PEAK_FLOPS", str(peak))
+    num_epoch = 2
+    trainer, state, loaders, training = _build_tiny_training(num_epoch)
+
+    telem = obs_rt.activate(
+        obs_rt.RunTelemetry(
+            "goodput-e2e", str(tmp_path / "logs" / "goodput-e2e"),
+            port=None,
+        )
+    )
+    try:
+        telem.emit_manifest(
+            {"NeuralNetwork": {"Training": training}}, "goodput-e2e"
+        )
+        config_nn = {
+            "Training": training,
+            "Variables_of_interest": {"output_names": ["sum", "x"]},
+        }
+        train_validate_test(
+            trainer, state, *loaders, config_nn, "goodput-e2e",
+            verbosity=0,
+        )
+    finally:
+        obs_rt.deactivate()
+    # snapshot AFTER close: the final epoch's window publishes during
+    # deactivate, and the gauges must mirror that last window
+    snap = telem.metrics.snapshot()
+
+    recs = validate_events(
+        str(tmp_path / "logs" / "goodput-e2e" / "events.jsonl"),
+        require=["goodput", "compile", "epoch", "run_end"],
+    )
+    goodput = [r for r in recs if r["event"] == "goodput"]
+    assert [g["epoch"] for g in goodput] == list(range(num_epoch))
+    for g in goodput:
+        assert abs(sum(g["fractions"].values()) - 1.0) < 1e-6, g
+        assert set(g["seconds"]) == set(led.CATEGORIES)
+        assert g["wall_s"] > 0
+        assert 0.0 <= g["goodput_fraction"] <= 1.0
+    # the epoch after warmup has real compute attribution
+    assert goodput[-1]["seconds"]["compute"] > 0
+    assert goodput[-1]["steps"] == 4  # 16 samples / batch 4
+
+    # MFU: hand-recompute from the event's own inputs, against the
+    # configured peak, and against the introspection flops gauge
+    mfu_events = [g for g in goodput if g.get("mfu")]
+    assert mfu_events, "no MFU recorded despite HYDRAGNN_PEAK_FLOPS"
+    flops_gauge = snap["flops_per_step"]
+    mfu_gauge = snap["mfu"]
+    for g in mfu_events:
+        for bucket, m in g["mfu"].items():
+            assert bucket.startswith(("train_step/", "train_multi/"))
+            expected = m["flops"] * m["steps_per_sec"] / peak
+            assert abs(m["mfu"] - expected) <= 1e-6 * max(expected, 1.0)
+            assert m["peak_flops"] == peak
+            assert flops_gauge[f"bucket={bucket}"] == m["flops"]
+    # the live gauge carries the LAST window's value
+    last = mfu_events[-1]
+    for bucket, m in last["mfu"].items():
+        assert abs(mfu_gauge[f"bucket={bucket}"] - m["mfu"]) < 1e-9
+    # goodput fraction gauges mirror the last window too
+    frac_gauge = snap["goodput_fraction"]
+    for cat, frac in goodput[-1]["fractions"].items():
+        assert abs(frac_gauge[f"category={cat}"] - frac) < 1e-9
+
+
+# ---- straggler flagging ---------------------------------------------------
+
+
+def pytest_flag_stragglers_leave_one_out():
+    hosts = {
+        "0": {"p50": 0.30, "count": 30},
+        "1": {"p50": 0.001, "count": 30},
+    }
+    assert led.flag_stragglers(hosts, factor=2.0) == ["0"]
+    # symmetric fleet: nobody flags
+    even = {str(i): {"p50": 0.01, "count": 30} for i in range(4)}
+    assert led.flag_stragglers(even, factor=2.0) == []
+    # under-sampled hosts neither flag nor pollute the baseline
+    hosts["2"] = {"p50": 9.9, "count": 1}
+    assert led.flag_stragglers(hosts, factor=2.0, min_steps=3) == ["0"]
+    # a single qualified host can never be judged
+    assert led.flag_stragglers(
+        {"0": {"p50": 1.0, "count": 30}}, factor=2.0
+    ) == []
+
+
+# ---- fleet rollup ---------------------------------------------------------
+
+
+def _write_events(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for i, rec in enumerate(records):
+            rec = dict(rec)
+            rec.setdefault("seq", i)
+            f.write(json.dumps(rec) + "\n")
+
+
+def _fleet_fixture(root):
+    run = os.path.join(root, "logs", "run")
+    _write_events(
+        os.path.join(run, "events.jsonl"),
+        [
+            {"event": "run_manifest", "ts": 100.0, "host": 0,
+             "schema_version": 1, "run": "run", "config_hash": "c",
+             "git_rev": "g", "world_size": 2, "device_kind": "cpu",
+             "device_count": 1, "num_epoch": 4},
+            {"event": "goodput", "ts": 110.0, "epoch": 0, "wall_s": 10.0,
+             "seconds": {}, "fractions": {}, "goodput_fraction": 0.5,
+             "steps": 4, "step_s": 1.2},
+            {"event": "world_resize", "ts": 120.0, "old_world": 2,
+             "new_world": 1, "gen": 1, "recovery_s": 2.5},
+            {"event": "run_end", "ts": 150.0, "status": "complete"},
+        ],
+    )
+    _write_events(
+        os.path.join(run, "events-host1.jsonl"),
+        [
+            {"event": "run_manifest", "ts": 101.0, "host": 1,
+             "schema_version": 1, "run": "run", "config_hash": "c",
+             "git_rev": "g", "world_size": 2, "device_kind": "cpu",
+             "device_count": 1, "num_epoch": 4},
+            {"event": "stall", "ts": 105.0, "step": 7, "seconds": 2.0,
+             "median": 0.1, "factor": 8.0},
+        ],
+    )
+    workers = os.path.join(root, "elastic-coord", "workers")
+    os.makedirs(workers, exist_ok=True)
+    with open(os.path.join(workers, "host-0.json"), "w") as f:
+        json.dump(
+            {"host": 0, "ts": 149.0, "step": 30, "epoch": 3, "done": True,
+             "step_digest": {"count": 30, "sum": 9.0, "p50": 0.30,
+                             "p99": 0.32}},
+            f,
+        )
+    with open(os.path.join(workers, "host-1.json"), "w") as f:
+        json.dump(
+            {"host": 1, "ts": 119.0, "step": 12, "epoch": 1,
+             "step_digest": {"count": 12, "sum": 0.012, "p50": 0.001,
+                             "p99": 0.002}},
+            f,
+        )
+    return run
+
+
+def pytest_fleet_rollup_merges_prices_and_flags(tmp_path):
+    _fleet_fixture(str(tmp_path))
+    report = led.build_fleet_report(str(tmp_path), straggler_factor=2.0)
+    # both hosts' streams merged into one ts-ordered view
+    assert set(report["streams"]) == {"events.jsonl", "events-host1.jsonl"}
+    assert report["events"] == 6
+    ts_order = [i["t"] for i in report["timeline"]]
+    assert ts_order == sorted(ts_order)
+    hosts_in_timeline = {i["host"] for i in report["timeline"]}
+    assert {"0", "1"} <= hosts_in_timeline
+    # heartbeat digests drive the per-host distributions
+    assert report["hosts"]["0"]["p50"] == 0.30
+    assert report["hosts"]["1"]["p50"] == 0.001
+    assert report["hosts"]["0"]["source"] == "heartbeat"
+    # the slow host is flagged
+    assert report["stragglers"] == ["0"]
+    # the world_resize recovery window is priced as lost goodput
+    assert report["lost_goodput_s"] == 2.5
+    assert report["lost_goodput_host_s"] == 2.5  # new_world == 1
+    assert 0 < report["lost_goodput_fraction"] <= 1.0
+    assert report["mean_goodput_fraction"] == 0.5
+    # all three renderers produce output mentioning the straggler
+    for fmt, render in led.FLEET_RENDERERS.items():
+        out = render(report)
+        assert "0" in out and out.endswith("\n"), fmt
+    assert "STRAGGLER" in led.render_fleet_text(report)
+
+
+def pytest_fleet_cli(tmp_path, capsys):
+    from hydragnn_tpu.obs.__main__ import main
+
+    _fleet_fixture(str(tmp_path))
+    assert main(["fleet", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "STRAGGLER" in out and "fleet rollup" in out
+    # json format parses
+    assert main(["fleet", str(tmp_path), "--format", "json"]) == 0
+    assert json.loads(capsys.readouterr().out)["stragglers"] == ["0"]
+    # empty dir: usage error, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["fleet", str(empty)]) == 2
+    assert main(["fleet", str(tmp_path / "missing")]) == 2
+
+
+def _live_lease(workers, host, p50, count=30, ts=None, done=False):
+    import time as _time
+
+    os.makedirs(workers, exist_ok=True)
+    with open(os.path.join(workers, f"host-{host}.json"), "w") as f:
+        json.dump(
+            {"host": host, "ts": _time.time() if ts is None else ts,
+             "done": done,
+             "step_digest": {"count": count, "sum": p50 * count,
+                             "p50": p50, "p99": p50 * 1.1}},
+            f,
+        )
+
+
+def pytest_poll_fleet_gauges_scrape_time(tmp_path):
+    """The leader's /metrics scrape reads peer lease digests into the
+    fleet gauges (obs/ledger.poll_fleet_gauges via extra_polls) — LIVE
+    hosts only: done/stale/tombstoned leases drop out of the view."""
+    from hydragnn_tpu.obs.runtime import TrainingMetrics
+
+    coord = str(tmp_path / "coord")
+    workers = os.path.join(coord, "workers")
+    _live_lease(workers, 0, 0.3)
+    _live_lease(workers, 1, 0.001)
+    m = TrainingMetrics()
+    m.extra_polls.append(
+        lambda: led.poll_fleet_gauges(coord, m.registry)
+    )
+    text = m.render_prometheus()
+    assert 'hydragnn_train_fleet_step_p50_seconds{host="0"} 0.3' in text
+    assert 'hydragnn_train_fleet_step_p50_seconds{host="1"} 0.001' in text
+    assert "hydragnn_train_fleet_straggler_hosts 1.0" in text
+
+    # the straggler finishes cleanly (done=True): it must leave the live
+    # view — both its p50 series and the straggler count
+    _live_lease(workers, 0, 0.3, done=True)
+    text = m.render_prometheus()
+    assert 'fleet_step_p50_seconds{host="0"}' not in text
+    assert "hydragnn_train_fleet_straggler_hosts 0.0" in text
+
+    # ... same for a stale lease (the host died without a goodbye)
+    _live_lease(workers, 0, 0.3, ts=100.0)
+    assert 'host="0"' not in m.render_prometheus()
+    # ... and for a tombstoned host
+    _live_lease(workers, 0, 0.3)
+    os.makedirs(os.path.join(coord, "dead"), exist_ok=True)
+    with open(os.path.join(coord, "dead", "host-0.json"), "w") as f:
+        json.dump({"host": 0, "ts": 1.0, "reason": "x", "by": 1}, f)
+    assert 'host="0"' not in m.render_prometheus()
+
+    # a missing coordination dir must not break the scrape
+    m2 = TrainingMetrics()
+    m2.extra_polls.append(
+        lambda: led.poll_fleet_gauges(str(tmp_path / "gone"), m2.registry)
+    )
+    assert (
+        "hydragnn_train_fleet_straggler_hosts 0" in m2.render_prometheus()
+    )
+
+
+def pytest_collective_estimate_opt_in(monkeypatch):
+    """The collective category is 0 without HYDRAGNN_ICI_BYTES_PER_S and
+    a labeled bandwidth-model estimate with it."""
+    clock = _Clock()
+    ledger, events = _collecting_ledger(clock)
+    ledger.note_program(
+        {"name": "train_step", "bucket": "train_step/aa",
+         "cost": {"flops": 1.0},
+         "collectives": {"data": 1e6, "model": 1e6}}
+    )
+    ledger.epoch_begin(0)
+    for _ in range(10):
+        ledger.on_step(0.1, 1)
+    clock.t += 2.0
+    ledger.epoch_begin(1)
+    g = events[-1]
+    assert g["seconds"]["collective"] == 0.0
+    assert "collective_estimated" not in g
+
+    monkeypatch.setenv("HYDRAGNN_ICI_BYTES_PER_S", "1e8")
+    for _ in range(10):
+        ledger.on_step(0.1, 1)
+    clock.t += 2.0
+    ledger.finalize()
+    g = events[-1]
+    # 10 steps x 2e6 bytes / 1e8 B/s = 0.2s, carved out of compute
+    assert abs(g["seconds"]["collective"] - 0.2) < 1e-6
+    assert abs(g["seconds"]["compute"] - 0.8) < 1e-6
+    assert g["collective_estimated"] is True
+    assert abs(sum(g["fractions"].values()) - 1.0) < 1e-6
+
+
+# ---- events-without-leases fallback ---------------------------------------
+
+
+def pytest_fleet_falls_back_to_goodput_events(tmp_path):
+    run = os.path.join(str(tmp_path), "logs", "run")
+    _write_events(
+        os.path.join(run, "events-host0.jsonl"),
+        [{"event": "goodput", "ts": 10.0, "epoch": 0, "wall_s": 5.0,
+          "seconds": {}, "fractions": {}, "goodput_fraction": 0.9,
+          "steps": 10, "step_s": 3.0}],
+    )
+    _write_events(
+        os.path.join(run, "events-host1.jsonl"),
+        [{"event": "goodput", "ts": 10.0, "epoch": 0, "wall_s": 5.0,
+          "seconds": {}, "fractions": {}, "goodput_fraction": 0.9,
+          "steps": 10, "step_s": 0.1}],
+    )
+    report = led.build_fleet_report(str(tmp_path), straggler_factor=2.0)
+    assert report["hosts"]["0"]["source"] == "events"
+    assert report["hosts"]["0"]["p50"] == pytest.approx(0.3)
+    assert report["stragglers"] == ["0"]
+
+
+# ---- budget MFU floor -----------------------------------------------------
+
+
+def pytest_budget_mfu_floor_roundtrip_and_direction():
+    from hydragnn_tpu.obs import report as report_mod
+
+    report = {
+        "programs": {
+            "train_step/aa": {"flops": 100.0, "mfu": 0.08},
+            "eval_step/bb": {"flops": 50.0},
+        }
+    }
+    budget = report_mod.budget_from_report(report, tolerance=0.1)
+    assert budget["programs"]["train_step/aa"]["mfu_floor"] == 0.08
+    assert "mfu_floor" not in budget["programs"]["eval_step/bb"]
+
+    # at/above floor: clean
+    v, _, _ = report_mod.check_budget(report, budget)
+    assert v == []
+    # regression below floor x (1 - tol): violation
+    worse = {
+        "programs": {
+            "train_step/aa": {"flops": 100.0, "mfu": 0.05},
+            "eval_step/bb": {"flops": 50.0},
+        }
+    }
+    v, _, _ = report_mod.check_budget(worse, budget)
+    assert [x["metric"] for x in v] == ["mfu_floor"]
+    assert v[0]["current"] == 0.05
+    # a run that measured no MFU is NOT a violation (the CLI notes it)
+    unmeasured = {
+        "programs": {
+            "train_step/aa": {"flops": 100.0},
+            "eval_step/bb": {"flops": 50.0},
+        }
+    }
+    v, _, _ = report_mod.check_budget(unmeasured, budget)
+    assert v == []
+    # the upper-bound metrics still ratchet the usual direction
+    heavier = {
+        "programs": {
+            "train_step/aa": {"flops": 200.0, "mfu": 0.08},
+            "eval_step/bb": {"flops": 50.0},
+        }
+    }
+    v, _, _ = report_mod.check_budget(heavier, budget)
+    assert [x["metric"] for x in v] == ["flops"]
+
+
+# ---- report: mesh header, collectives, goodput sections -------------------
+
+
+def pytest_report_carries_mesh_collectives_goodput(tmp_path):
+    from hydragnn_tpu.obs import report as report_mod
+
+    path = str(tmp_path / "events.jsonl")
+    _write_events(
+        path,
+        [
+            {"event": "run_manifest", "ts": 1.0, "schema_version": 1,
+             "run": "r", "config_hash": "c", "git_rev": "g",
+             "world_size": 1, "device_kind": "cpu", "device_count": 8,
+             "num_epoch": 1},
+            {"event": "mesh_shape", "ts": 1.5, "axes": ["data", "model"],
+             "shape": [4, 2], "devices": 8},
+            {"event": "compile", "ts": 2.0, "name": "train_step",
+             "bucket": "train_step/aa", "cost": {"flops": 1000.0},
+             "memory": {"peak_bytes": 64.0},
+             "collectives": {"data": 512.0, "model": 128.0}},
+            {"event": "compile", "ts": 2.5, "name": "eval_step",
+             "bucket": "eval_step/bb", "cost": {"flops": 10.0},
+             "memory": {}, "collectives": {"data": 256.0}},
+            # a resumed run RE-REPORTS the same bucket: the per-axis
+            # rollup must dedup (last capture wins), not double-count
+            {"event": "compile", "ts": 2.7, "name": "train_step",
+             "bucket": "train_step/aa", "cost": {"flops": 1000.0},
+             "memory": {"peak_bytes": 64.0},
+             "collectives": {"data": 512.0, "model": 128.0}},
+            {"event": "goodput", "ts": 3.0, "epoch": 0, "wall_s": 2.0,
+             "seconds": {"compute": 1.0, "other": 1.0},
+             "fractions": {"compute": 0.5, "other": 0.5},
+             "goodput_fraction": 0.5, "steps": 4, "step_s": 1.0,
+             "mfu": {"train_step/aa": {"mfu": 0.07, "flops": 1000.0,
+                                       "steps_per_sec": 4.0,
+                                       "peak_flops": 1e5}}},
+            {"event": "run_end", "ts": 4.0, "status": "complete"},
+        ],
+    )
+    report = report_mod.build_report(report_mod.load_events(path))
+    assert report["run"]["mesh_shape"] == [4, 2]
+    assert report["collectives"] == {"data": 768.0, "model": 128.0}
+    assert report["programs"]["train_step/aa"]["mfu"] == 0.07
+    assert "mfu" not in report["programs"]["eval_step/bb"]
+    assert report["goodput"][0]["goodput_fraction"] == 0.5
+
+    text = report_mod.render_text(report)
+    assert "mesh: 4x2 (data, model)" in text
+    assert "collective bytes" in text
+    assert "goodput" in text
+    assert "7.00%" in text  # the program table's mfu column
+    md = report_mod.render_markdown(report)
+    assert "## Collective bytes (per mesh axis)" in md
+    assert "## Goodput" in md
+    json.loads(report_mod.render_json(report))
+
+
+# ---- serve SLO accounting -------------------------------------------------
+
+
+def pytest_serve_metrics_deadline_outcomes():
+    from hydragnn_tpu.obs.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    m.on_deadline(True)
+    m.on_deadline(True)
+    m.on_deadline(False)
+    m.on_timeout(2)  # queue expiries are missed deadlines too
+    s = m.snapshot()
+    assert s["deadline_met_total"] == 2
+    assert s["deadline_missed_total"] == 3
+    assert s["slo_miss_ratio"] == 0.6
+    text = m.render_prometheus()
+    assert "hydragnn_serve_slo_misses_total 3" in text
+    assert 'hydragnn_serve_deadline_outcomes_total{outcome="met"} 2' in text
+    assert (
+        'hydragnn_serve_deadline_outcomes_total{outcome="missed"} 3' in text
+    )
+    assert "hydragnn_serve_slo_miss_ratio 0.6" in text
+    # no deadlines at all: ratio is 0, not a division error
+    assert ServeMetrics().snapshot()["slo_miss_ratio"] == 0.0

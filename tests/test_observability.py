@@ -35,7 +35,10 @@ from _resilience_worker import make_samples  # noqa: E402
 
 # render_prometheus() of the PRE-REFACTOR hydragnn_tpu/serve/metrics.py for
 # exactly the traffic _drive_serve_traffic() generates — the shared-core
-# promotion must keep the serving exposition byte-identical
+# promotion must keep the serving exposition byte-identical. DELIBERATE
+# extension (goodput/SLO PR): the deadline-outcome + SLO-miss series are
+# appended AFTER the historical lines, so every pre-existing consumer's
+# byte offsets are untouched and the golden grew by exactly that tail.
 _GOLDEN_SERVE = """\
 # HELP hydragnn_serve_requests_total Accepted requests
 # TYPE hydragnn_serve_requests_total counter
@@ -79,6 +82,14 @@ hydragnn_serve_batch_latency_seconds{quantile="0.5"} 0.025
 hydragnn_serve_batch_latency_seconds{quantile="0.99"} 0.495
 hydragnn_serve_batch_latency_seconds_sum 0.412
 hydragnn_serve_batch_latency_seconds_count 2
+# HELP hydragnn_serve_slo_misses_total Deadline-carrying requests that missed their deadline
+# TYPE hydragnn_serve_slo_misses_total counter
+hydragnn_serve_slo_misses_total 2
+hydragnn_serve_deadline_outcomes_total{outcome="met"} 2
+hydragnn_serve_deadline_outcomes_total{outcome="missed"} 2
+# HELP hydragnn_serve_slo_miss_ratio Fraction of deadline-carrying requests that missed
+# TYPE hydragnn_serve_slo_miss_ratio gauge
+hydragnn_serve_slo_miss_ratio 0.5
 """
 
 
@@ -86,7 +97,7 @@ def _drive_serve_traffic(m):
     for _ in range(5):
         m.on_submit()
     m.on_shed()
-    m.on_timeout()
+    m.on_timeout()  # in-queue expiry: also a missed deadline
     m.on_error(2)
     m.on_compile()
     m.set_queue_depth(3)
@@ -96,6 +107,11 @@ def _drive_serve_traffic(m):
                batch_seconds=0.4)
     for s in (0.002, 0.03, 1.7):
         m.on_response_latency(s)
+    # per-request deadline outcomes (SLO accounting): 2 met, 1 delivered
+    # late -> with the timeout above, 2 met / 2 missed, miss ratio 0.5
+    m.on_deadline(True)
+    m.on_deadline(True)
+    m.on_deadline(False)
     return m
 
 

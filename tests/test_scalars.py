@@ -1,0 +1,137 @@
+"""Direct ScalarWriter fan-out coverage (obs/scalars.py).
+
+The fan-out was previously exercised mostly incidentally through the
+observability e2e; these tests pin its contracts on their own: JSONL/CSV
+backends record the SAME rows for the same calls, backend failures are
+isolated (one broken backend must not eat the others' scalars or the
+run), tracer totals forward through ``add_regions``, ``for_run`` honors
+the format knob and the rank-0-only contract, and the missing-TensorBoard
+warning fires exactly once per process.
+"""
+
+import csv
+import json
+import os
+import sys
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from hydragnn_tpu.obs.scalars import (  # noqa: E402
+    CsvScalarBackend,
+    JsonlScalarBackend,
+    ScalarWriter,
+)
+
+
+def _drive(writer):
+    writer.add_scalar("train error", 0.5, 0)
+    writer.add_scalar("train error", 0.25, 1)
+    writer.add_scalar("validate error", 0.75, 1)
+    writer.add_regions({"train": 2.0, "dataload": 0.5}, step=2)
+    writer.close()
+
+
+def _jsonl_rows(path):
+    return [
+        (r["tag"], r["value"], r["step"])
+        for r in (json.loads(line) for line in open(path))
+    ]
+
+
+def _csv_rows(path):
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    return [(r["tag"], float(r["value"]), int(r["step"])) for r in rows]
+
+
+def pytest_jsonl_and_csv_backends_record_identical_rows(tmp_path):
+    """Row PARITY: the two plain-file backends are interchangeable — the
+    same call sequence produces the same (tag, value, step) rows."""
+    jpath = str(tmp_path / "scalars.jsonl")
+    cpath = str(tmp_path / "scalars.csv")
+    _drive(ScalarWriter([JsonlScalarBackend(jpath)]))
+    _drive(ScalarWriter([CsvScalarBackend(cpath)]))
+    jrows, crows = _jsonl_rows(jpath), _csv_rows(cpath)
+    assert jrows == crows
+    assert ("tracer/train_seconds", 2.0, 2) in jrows
+    assert ("tracer/dataload_seconds", 0.5, 2) in jrows
+    # regions render in sorted name order (deterministic output)
+    tracer_rows = [t for t, _, _ in jrows if t.startswith("tracer/")]
+    assert tracer_rows == sorted(tracer_rows)
+
+
+def pytest_fanout_writes_every_backend_and_isolates_failures(tmp_path):
+    jpath = str(tmp_path / "a.jsonl")
+    cpath = str(tmp_path / "b.csv")
+
+    class _Exploding:
+        def add_scalar(self, tag, value, step):
+            raise RuntimeError("backend down")
+
+        def close(self):
+            raise RuntimeError("close down")
+
+    w = ScalarWriter(
+        [JsonlScalarBackend(jpath), _Exploding(), CsvScalarBackend(cpath)]
+    )
+    w.add_scalar("loss", 1.5, 0)
+    w.close()  # the exploding close must not skip the CSV close
+    assert _jsonl_rows(jpath) == [("loss", 1.5, 0)]
+    assert _csv_rows(cpath) == [("loss", 1.5, 0)]
+
+
+def pytest_for_run_honors_format_knob_and_rank(tmp_path, monkeypatch):
+    from hydragnn_tpu.obs import scalars as sc
+    from hydragnn_tpu.parallel import distributed as dist
+
+    # break TensorBoard so the file backend is the only one (and silence
+    # the warn-once for this test)
+    monkeypatch.setattr(sc, "_tb_warned", True)
+    monkeypatch.setattr(
+        sc.TensorBoardScalarBackend,
+        "__init__",
+        lambda self, log_dir: (_ for _ in ()).throw(ImportError("no tb")),
+    )
+    monkeypatch.setenv("HYDRAGNN_SCALAR_FORMAT", "csv")
+    w = ScalarWriter.for_run("fmt", path=str(tmp_path))
+    w.add_scalar("x", 2.0, 0)
+    w.close()
+    assert _csv_rows(str(tmp_path / "fmt" / "scalars.csv")) == [
+        ("x", 2.0, 0)
+    ]
+    assert not os.path.exists(tmp_path / "fmt" / "scalars.jsonl")
+
+    # non-zero ranks get None — same contract as the old summary writer
+    monkeypatch.setattr(
+        dist, "get_comm_size_and_rank", lambda: (2, 1)
+    )
+    assert ScalarWriter.for_run("rank1", path=str(tmp_path)) is None
+
+
+def pytest_for_run_warns_once_and_keeps_recording(tmp_path, monkeypatch):
+    from hydragnn_tpu.obs import scalars as sc
+
+    monkeypatch.delenv("HYDRAGNN_SCALAR_FORMAT", raising=False)
+    monkeypatch.setattr(sc, "_tb_warned", False)
+    monkeypatch.setattr(
+        sc.TensorBoardScalarBackend,
+        "__init__",
+        lambda self, log_dir: (_ for _ in ()).throw(ImportError("no tb")),
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        w1 = ScalarWriter.for_run("w1", path=str(tmp_path))
+        w2 = ScalarWriter.for_run("w2", path=str(tmp_path))
+    assert (
+        len([c for c in caught if "TensorBoard" in str(c.message)]) == 1
+    )
+    # tracer-totals forwarding still lands in the surviving backend
+    w1.add_regions({"train": 1.0}, step=3)
+    w1.close()
+    w2.close()
+    assert _jsonl_rows(str(tmp_path / "w1" / "scalars.jsonl")) == [
+        ("tracer/train_seconds", 1.0, 3)
+    ]

@@ -187,6 +187,14 @@ def pytest_serve_deadline_expires_in_queue():
         with pytest.raises(DeadlineExceeded):
             fut.result(30)
         assert server.metrics.timeouts_total >= 1
+        # SLO accounting: the in-queue expiry counts as a missed deadline
+        assert server.metrics.snapshot()["deadline_missed_total"] >= 1
+        # ... and a request answered within its (generous) deadline as met
+        ok = server.submit(g, deadline_s=60.0)
+        ok.result(30)
+        snap = server.metrics.snapshot()
+        assert snap["deadline_met_total"] >= 1
+        assert 0.0 < snap["slo_miss_ratio"] < 1.0
 
 
 def pytest_serve_dense_graph_falls_back_to_larger_bucket():
