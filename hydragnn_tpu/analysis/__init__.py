@@ -1,14 +1,20 @@
-"""jaxlint + threadlint: static analysis + runtime guards, one engine.
+"""jaxlint + threadlint + shardlint: static analysis + runtime guards.
 
 Static pass (``python -m hydragnn_tpu.analysis``): an AST-based rule
-engine in two suites. The ``jax`` suite (jaxlint) targets JAX/TPU
+engine in three suites. The ``jax`` suite (jaxlint) targets JAX/TPU
 anti-patterns — per-batch host syncs in step loops, jit wrappers rebuilt
 per call, state-threading jits missing ``donate_argnums``, PRNG key
 reuse, recompile-hazard static args, general hygiene. The
 ``concurrency`` suite (threadlint, ``--suite=concurrency``) targets the
 always-on serving/telemetry surface — lock-order inversions, blocking
 calls under held locks, leaked threads/executors, lock-free mutation of
-lock-guarded state, unbounded or shutdown-hostile queues. See
+lock-guarded state, unbounded or shutdown-hostile queues. The
+``sharding`` suite (shardlint, ``--suite=sharding``) guards the 2-D
+mesh layer — hardcoded axis strings, jit programs missing their
+sharding contract, unknown PartitionSpec axes, sharding-less
+``device_put``, legacy ``pmap``, leading-dim reshapes in sharded
+bodies; its compiled-HLO sibling (``analysis/hlo.py``) ratchets each
+step program's collective set against ``.shardlint-hlo.json``. See
 ``docs/static-analysis.md`` for the rule catalog, suppression syntax,
 and the per-suite baseline ratchets.
 
@@ -16,9 +22,10 @@ Runtime guards (``hydragnn_tpu.analysis.guards``): what the static pass
 cannot prove — a :class:`CompileSentinel` asserting the XLA compile
 counter stays flat after warmup, :func:`no_host_syncs`, a
 ``jax.transfer_guard`` harness that turns implicit device->host
-transfers into hard errors inside tests, and :func:`lock_sanitizer`, a
+transfers into hard errors inside tests, :func:`lock_sanitizer`, a
 lock-order/deadlock sanitizer with per-lock wait/hold metrics and a
-stack-dumping watchdog.
+stack-dumping watchdog, and :func:`sharding_sentinel`, which asserts
+program outputs LAND at their declared shardings.
 """
 
 from hydragnn_tpu.analysis.core import (  # noqa: F401
@@ -37,13 +44,17 @@ from hydragnn_tpu.analysis import (  # noqa: F401  (registration side effect)
     rules_hygiene,
     rules_jit,
     rules_prng,
+    rules_sharding,
 )
 from hydragnn_tpu.analysis.guards import (  # noqa: F401
     CompileSentinel,
     InstrumentedLock,
     LockOrderViolation,
     LockSanitizer,
+    ShardingSentinel,
+    ShardingViolation,
     lock_sanitizer,
     no_host_syncs,
     no_implicit_transfers,
+    sharding_sentinel,
 )

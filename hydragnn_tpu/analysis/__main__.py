@@ -32,8 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m hydragnn_tpu.analysis",
         description=(
-            "jaxlint: JAX/TPU anti-pattern static analysis "
-            "(docs/static-analysis.md)"
+            "jaxlint/threadlint/shardlint: JAX/TPU, concurrency and "
+            "sharding static analysis (docs/static-analysis.md)"
         ),
     )
     p.add_argument(
@@ -66,8 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--suite",
         metavar="SUITE",
-        help="run only one rule suite: 'jax' (the jaxlint gate) or "
-        "'concurrency' (the threadlint gate); default: every suite",
+        help="run only one rule suite: 'jax' (the jaxlint gate), "
+        "'concurrency' (the threadlint gate) or 'sharding' (the "
+        "shardlint gate); default: every suite",
     )
     p.add_argument(
         "--select",
@@ -85,12 +86,41 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# the gate each suite is known by in CI/docs — the --list-rules headers
+SUITE_GATES = {
+    "jax": "jaxlint",
+    "concurrency": "threadlint",
+    "sharding": "shardlint",
+}
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    # an unknown --suite is a usage error EVERYWHERE, --list-rules
+    # included (listing every rule for a suite that does not exist would
+    # be a silently-wrong answer)
+    if args.suite is not None and args.suite not in all_suites():
+        print(
+            f"jaxlint: unknown suite {args.suite!r} "
+            f"(have: {', '.join(sorted(all_suites()))})",
+            file=sys.stderr,
+        )
+        return 2
+
     if args.list_rules:
-        for name, rule in sorted(all_rules().items()):
-            print(f"{name} [{rule.suite}]: {rule.description}")
+        # the per-suite catalog: three suites are too many to keep in
+        # one flat list (or only in docs) — one block per suite, each
+        # rule with its one-line doc
+        for suite in sorted(all_suites()):
+            if args.suite is not None and suite != args.suite:
+                continue
+            gate = SUITE_GATES.get(suite, suite)
+            print(f"suite {suite} ({gate} gate, --suite={suite}):")
+            for name, rule in sorted(all_rules().items()):
+                if rule.suite != suite:
+                    continue
+                print(f"  {name}: {rule.description}")
         return 0
 
     paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
@@ -114,13 +144,6 @@ def main(argv=None) -> int:
             print(f"jaxlint: unknown rule {given!r}", file=sys.stderr)
             return 2
     if args.suite is not None:
-        if args.suite not in all_suites():
-            print(
-                f"jaxlint: unknown suite {args.suite!r} "
-                f"(have: {', '.join(sorted(all_suites()))})",
-                file=sys.stderr,
-            )
-            return 2
         suite_rules = rules_in_suite(args.suite)
         select = suite_rules if select is None else (select & suite_rules)
     # contradictory flags must not masquerade as a clean run: a

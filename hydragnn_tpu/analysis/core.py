@@ -24,11 +24,13 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
-# both tags are accepted everywhere: `jaxlint` predates the concurrency
-# suite (threadlint), and a suppression should read as the suite it
-# silences — but the engine is one engine
+# all three tags are accepted everywhere: `jaxlint` predates the
+# concurrency (threadlint) and sharding (shardlint) suites, and a
+# suppression should read as the suite it silences — but the engine is
+# one engine
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:jaxlint|threadlint):\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
+    r"#\s*(?:jaxlint|threadlint|shardlint):"
+    r"\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
 )
 
 
@@ -128,8 +130,9 @@ class Rule:
     ``check``. ``hot_path_patterns`` narrows a rule to specific files.
     ``suite`` groups rules for ``--suite`` gating: the JAX/TPU rules are
     ``jax`` (the jaxlint gate), the concurrency/shutdown-safety rules are
-    ``concurrency`` (the threadlint gate) — each gate ratchets against
-    its own baseline file."""
+    ``concurrency`` (the threadlint gate), the sharding-correctness
+    rules are ``sharding`` (the shardlint gate) — each gate ratchets
+    against its own baseline file."""
 
     name = ""
     description = ""

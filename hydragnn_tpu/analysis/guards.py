@@ -172,6 +172,121 @@ def no_implicit_transfers():
         yield
 
 
+# ---- sharding sentinel ----------------------------------------------------
+
+
+class ShardingViolation(AssertionError):
+    """A program output landed at a different sharding than declared."""
+
+
+def _norm_spec(spec) -> tuple:
+    """Canonical PartitionSpec tuple: trailing Nones stripped, so
+    ``P('data')`` and ``P('data', None)`` (and a fully-replicated
+    ``P()`` vs a spec-less single-device sharding) compare equal."""
+    dims = list(tuple(spec))
+    while dims and dims[-1] is None:
+        dims.pop()
+    return tuple(dims)
+
+
+def _expected_spec(expected):
+    """Spec tuple of one expected placement: a NamedSharding, a raw
+    PartitionSpec, or anything exposing ``.spec``."""
+    spec = getattr(expected, "spec", expected)
+    try:
+        return _norm_spec(spec)
+    except TypeError:
+        return None
+
+
+def tree_sharding_mismatches(tree, expected) -> List[str]:
+    """Human-readable mismatches between where ``tree``'s leaves LANDED
+    (``leaf.sharding``) and where ``expected`` (a congruent pytree of
+    ``NamedSharding``/``PartitionSpec``) declared they should.
+
+    Leaves without a ``.sharding`` (host values) and expected entries of
+    None are skipped; a single-device/spec-less sharding reads as
+    replicated — declaring ``P()`` on a meshless run passes, declaring
+    ``P('data')`` there correctly reports the shard that never happened.
+    """
+    import jax
+
+    mismatches: List[str] = []
+
+    def chk(path, leaf, exp):
+        sh = getattr(leaf, "sharding", None)
+        if sh is None or exp is None:
+            return leaf
+        want = _expected_spec(exp)
+        if want is None:
+            return leaf
+        got = _norm_spec(getattr(sh, "spec", ()))
+        if got != want:
+            name = jax.tree_util.keystr(path)
+            mismatches.append(
+                f"{name}: landed at {got or 'replicated'}, "
+                f"declared {want or 'replicated'}"
+            )
+        return leaf
+
+    jax.tree_util.tree_map_with_path(chk, tree, expected)
+    return mismatches
+
+
+class ShardingSentinel:
+    """Assert program outputs LAND at their declared shardings — the
+    runtime sibling of :class:`CompileSentinel` for the 2-D mesh era and
+    of the static ``jit-missing-shardings`` rule: the lint proves the
+    contract is *written*, this proves execution *honors* it (a
+    ``with_sharding_constraint`` dropped in a refactor still compiles
+    and still converges — it just reshards on every consumer).
+
+    Usage::
+
+        state, metrics = trainer._train_step(state, batch, rng)
+        with sharding_sentinel() as sen:
+            sen.check(state, trainer._state_shardings, what="train_step")
+        # or standalone: ShardingSentinel().check(...) raises directly
+    """
+
+    def __init__(self):
+        self.violations: List[str] = []
+
+    def check(self, tree, expected, what: str = "outputs", defer=False):
+        """Compare ``tree``'s landed shardings against ``expected``;
+        raises :class:`ShardingViolation` (or records, with
+        ``defer=True``, for :meth:`assert_clean` at context exit)."""
+        mism = [
+            f"{what}: {m}" for m in tree_sharding_mismatches(tree, expected)
+        ]
+        if not mism:
+            return
+        self.violations.extend(mism)
+        if not defer:
+            self._raise()
+
+    def _raise(self):
+        raise ShardingViolation(
+            f"{len(self.violations)} output(s) landed off their declared "
+            "sharding — an implicit reshard every consumer pays for:\n  "
+            + "\n  ".join(self.violations)
+        )
+
+    def assert_clean(self):
+        if self.violations:
+            self._raise()
+
+
+@contextlib.contextmanager
+def sharding_sentinel(check_on_exit: bool = True):
+    """Context harness: ``check(..., defer=True)`` inside the region,
+    one :class:`ShardingViolation` listing everything at exit."""
+    sen = ShardingSentinel()
+    yield sen
+    if check_on_exit:
+        sen.assert_clean()
+
+
 # ---- lock sanitizer -------------------------------------------------------
 
 # lock waits/holds live well below the serving-latency bounds: critical

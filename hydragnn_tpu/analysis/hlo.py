@@ -1,0 +1,625 @@
+"""shardlint's compiled-HLO ratchet — the post-compile half of the suite.
+
+The AST rules (``rules_sharding.py``) prove the sharding contract is
+*written*; nothing static can prove what XLA *does* with it. An implicit
+resharding — a partition rule regressed, a ``with_sharding_constraint``
+dropped, a batch dim reshaped — shows up in the compiled module as a new
+all-gather long before it shows up as step time on a small config. So
+this module fingerprints each of the eight ``train/steps.py`` programs'
+compiled HLO on a canonical CPU mesh:
+
+* the **collective set** — one ``(op kind, mesh axis, result bytes)``
+  record per all-reduce/all-gather/all-to-all/reduce-scatter, attributed
+  via ``parallel/collectives.py``'s replica-group parsing;
+* **host-transfer ops** (infeed/outfeed/host custom-calls) — zero today,
+  and a future host round-trip inside a step program must fail loudly;
+* **bf16 -> f32 converts** — a silent upcast doubles matmul cost on the
+  precision-policy paths.
+
+The fingerprints ratchet against a committed ``.shardlint-hlo.json``
+(the ``.perf-baseline.json`` pattern at compile time): CI re-compiles the
+programs on the forced-8-device CPU backend and fails with a diff naming
+the program, the collective and the bytes when anything new appears or
+grows past tolerance. ``--prove-injection`` demonstrates the failing
+case by injecting a synthetic all-gather and asserting it is caught.
+
+CLI::
+
+    python -m hydragnn_tpu.analysis.hlo --check .shardlint-hlo.json
+    python -m hydragnn_tpu.analysis.hlo --write .shardlint-hlo.json
+    python -m hydragnn_tpu.analysis.hlo --check ... --prove-injection
+
+Exit status: 0 clean, 1 budget violations (or a failed injection proof),
+2 usage errors. Unlike the AST pass this half NEEDS jax — it compiles
+the real programs; the budget is the CPU-compiled canon (per-device
+result bytes are backend-independent; TPU-only fusion differences are
+the introspection gauges' job, not this gate's).
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+BUDGET_VERSION = 1
+DEFAULT_BUDGET = ".shardlint-hlo.json"
+DEFAULT_TOLERANCE = 0.25
+# the canonical harness mesh: 4x2 exercises BOTH axes' collectives
+DEFAULT_MESH = (4, 2)
+
+# ---- pure-text analyzers --------------------------------------------------
+
+_HOST_TRANSFER_RE = re.compile(
+    r"\b(?:infeed|outfeed)(?:-start|-done)?\(|is_host_transfer=true|"
+    r'custom_call_target="(?:MoveToHost|MoveToDevice|[^"]*[Hh]ost[^"]*)"'
+)
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<dtype>[a-z]+[0-9]+)\["
+)
+_CONVERT_RE = re.compile(
+    r"=\s*f32\[[0-9,]*\](?:\{[0-9,]*\})?\s*convert\((?P<args>[^)]*)\)"
+)
+_OPERAND_NAME_RE = re.compile(r"%?([\w.\-]+)\s*$")
+
+
+def count_host_transfers(hlo_text: str) -> int:
+    """Host-transfer ops in one compiled module: infeed/outfeed, sends
+    and receives marked ``is_host_transfer=true``, and host-placement
+    custom calls. A step program should have NONE — each occurrence is a
+    synchronous hop off the device."""
+    return sum(
+        1 for line in hlo_text.splitlines() if _HOST_TRANSFER_RE.search(line)
+    )
+
+
+def count_bf16_upcasts(hlo_text: str) -> int:
+    """``bf16 -> f32`` convert ops. Handles both operand spellings the
+    HLO printer emits: the inline-typed ``convert(bf16[...] %x)`` and the
+    bare ``convert(%x)`` (resolved through a first pass over instruction
+    result dtypes)."""
+    dtypes: Dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        m = _DEF_RE.match(line)
+        if m:
+            dtypes[m.group("name")] = m.group("dtype")
+    count = 0
+    for line in hlo_text.splitlines():
+        m = _CONVERT_RE.search(line)
+        if m is None:
+            continue
+        args = m.group("args")
+        if "bf16[" in args:
+            count += 1
+            continue
+        om = _OPERAND_NAME_RE.search(args.strip())
+        if om and dtypes.get(om.group(1)) == "bf16":
+            count += 1
+    return count
+
+
+def fingerprint_hlo(
+    hlo_text: str, axes: Sequence[str], shape: Sequence[int]
+) -> Dict:
+    """One program's budgetable fingerprint. Collectives are aggregated
+    by ``(op, axis)`` with summed result bytes — stable under
+    instruction reordering, sensitive to any NEW collective kind/axis
+    and to byte growth."""
+    from hydragnn_tpu.parallel.collectives import parse_collectives
+
+    agg: Dict[Tuple[str, str], float] = {}
+    for rec in parse_collectives(hlo_text, axes, shape):
+        key = (rec["op"], rec["axis"])
+        agg[key] = agg.get(key, 0.0) + rec["bytes"]
+    return {
+        "collectives": [
+            {"op": op, "axis": axis, "bytes": int(nbytes)}
+            for (op, axis), nbytes in sorted(agg.items())
+        ],
+        "host_transfers": count_host_transfers(hlo_text),
+        "bf16_to_f32_converts": count_bf16_upcasts(hlo_text),
+    }
+
+
+# ---- the budget (the ratchet file) ----------------------------------------
+
+
+def save_budget(
+    path: str,
+    programs: Dict[str, Dict],
+    axes: Sequence[str],
+    shape: Sequence[int],
+    tolerance: float = DEFAULT_TOLERANCE,
+):
+    payload = {
+        "version": BUDGET_VERSION,
+        "mesh": {"axes": list(axes), "shape": [int(s) for s in shape]},
+        "tolerance": tolerance,
+        "programs": {k: programs[k] for k in sorted(programs)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_budget(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as f:
+        payload = json.load(f)
+    version = payload.get("version")
+    if version != BUDGET_VERSION:
+        raise ValueError(
+            f"HLO budget {path} has version {version!r}; this analyzer "
+            f"writes version {BUDGET_VERSION} — regenerate with --write"
+        )
+    return payload
+
+
+def check_fingerprints(
+    current: Dict[str, Dict],
+    budget_programs: Dict[str, Dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+) -> Tuple[List[str], List[str]]:
+    """``(violations, notes)`` of the current fingerprints vs the budget.
+
+    Violations (gate-failing): a program absent from the budget, a NEW
+    ``(collective, axis)`` pair, collective bytes grown past
+    ``tolerance``, more host transfers or bf16->f32 converts than
+    budgeted. Notes (stderr, non-failing): budgeted collectives that
+    disappeared and stale budgeted programs — the ratchet only tightens,
+    so these are prune-the-budget reminders."""
+    violations: List[str] = []
+    notes: List[str] = []
+    for prog in sorted(current):
+        fp = current[prog]
+        b = budget_programs.get(prog)
+        if b is None:
+            violations.append(
+                f"{prog}: program not in the budget — a new compiled "
+                "step program must be budgeted deliberately (--write)"
+            )
+            continue
+        budgeted = {
+            (c["op"], c["axis"]): float(c["bytes"])
+            for c in b.get("collectives", [])
+        }
+        seen = set()
+        for c in fp["collectives"]:
+            key = (c["op"], c["axis"])
+            seen.add(key)
+            if key not in budgeted:
+                violations.append(
+                    f"{prog}: NEW collective {c['op']} over axis "
+                    f"'{c['axis']}' ({int(c['bytes'])} result bytes/"
+                    "dispatch) — an implicit resharding XLA inserted "
+                    "that the budget never agreed to"
+                )
+            elif float(c["bytes"]) > budgeted[key] * (1.0 + tolerance):
+                violations.append(
+                    f"{prog}: {c['op']}@{c['axis']} grew "
+                    f"{int(budgeted[key])} -> {int(c['bytes'])} result "
+                    f"bytes (> {tolerance:.0%} tolerance)"
+                )
+        for (op, axis), nbytes in sorted(budgeted.items()):
+            if (op, axis) not in seen:
+                notes.append(
+                    f"{prog}: budgeted {op}@{axis} ({int(nbytes)} B) no "
+                    "longer emitted — tighten the budget with --write"
+                )
+        for field, label in (
+            ("host_transfers", "host-transfer op(s)"),
+            ("bf16_to_f32_converts", "bf16->f32 convert(s)"),
+        ):
+            if int(fp.get(field, 0)) > int(b.get(field, 0)):
+                violations.append(
+                    f"{prog}: {fp[field]} {label}, budget allows "
+                    f"{b.get(field, 0)}"
+                )
+    for prog in sorted(set(budget_programs) - set(current)):
+        notes.append(
+            f"{prog}: budgeted but not compiled here — stale entry, "
+            "prune with --write"
+        )
+    return violations, notes
+
+
+# ---- the canonical program harness ----------------------------------------
+
+
+def _make_samples(num: int = 24, seed: int = 11):
+    import numpy as np
+
+    from hydragnn_tpu.data.dataobj import GraphData
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        n = 6
+        g = GraphData()
+        g.x = rng.random((n, 1)).astype(np.float32)
+        g.pos = rng.random((n, 3)).astype(np.float32)
+        src = np.arange(n)
+        dst = (src + 1) % n
+        g.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        g.edge_attr = None
+        g.targets = [np.array([g.x.sum()], np.float32), g.x.copy()]
+        g.target_types = ["graph", "node"]
+        out.append(g)
+    return out
+
+
+_CANON_ARCH = {
+    "model_type": "GIN",
+    "input_dim": 1,
+    "hidden_dim": 8,
+    "num_conv_layers": 2,
+    "output_dim": [1, 1],
+    "output_type": ["graph", "node"],
+    "output_heads": {
+        "graph": {
+            "num_sharedlayers": 1,
+            "dim_sharedlayers": 8,
+            "num_headlayers": 1,
+            "dim_headlayers": [8],
+        },
+        "node": {"num_headlayers": 1, "dim_headlayers": [8], "type": "mlp"},
+    },
+    "task_weights": [1.0, 1.0],
+}
+
+
+def build_canonical_trainer(mesh_shape: Tuple[int, int] = DEFAULT_MESH):
+    """The fixed tiny GIN training the budget is derived from — one
+    deterministic config (same shape as the 2-D mesh CI smoke's), so a
+    fingerprint diff is a CODE change, never a config drift. Returns
+    ``(trainer, state, dev_batch, stacked, mesh)``."""
+    import jax
+
+    from hydragnn_tpu.data.loaders import GraphLoader, compute_layout
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.parallel.mesh import make_mesh2d, set_active_mesh
+    from hydragnn_tpu.train.trainer import Trainer
+
+    d, m = int(mesh_shape[0]), int(mesh_shape[1])
+    mesh = make_mesh2d(d, m)
+    set_active_mesh(mesh)
+    training = {
+        "num_epoch": 1,
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+        "model_parallel": m,
+    }
+    samples = _make_samples()
+    layout = compute_layout([samples], batch_size=4, need_triplets=False)
+    loader = GraphLoader(samples[:16], 4, layout, shuffle=False)
+    model = create_model_config(_CANON_ARCH)
+    trainer = Trainer(model, training, mesh=mesh)
+    batches = list(loader)
+    state = trainer.init_state(batches[0], seed=0)
+    dev_batch = trainer.put_batch(batches[0])
+    stacked = trainer.stage_batches(batches[:2])
+    return trainer, state, dev_batch, stacked, mesh
+
+
+def compile_step_programs(
+    mesh_shape: Tuple[int, int] = DEFAULT_MESH,
+    programs: Optional[Sequence[str]] = None,
+) -> Tuple[Dict[str, str], Tuple, Tuple, Dict]:
+    """Compile the step programs on the canonical harness and return
+    ``({name: optimized_hlo_text}, axes, shape, context)``. ``programs``
+    restricts the set (the unit tests compile two, CI compiles all 8).
+    ``context`` carries the live trainer/state/batch for the runtime
+    sharding-sentinel check."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.parallel.mesh import active_mesh, set_active_mesh
+    from hydragnn_tpu.train.common import SchedState
+    from hydragnn_tpu.train.trainer import _copy_tree
+
+    prev_mesh = active_mesh()
+    try:
+        trainer, state, dev_batch, stacked, mesh = build_canonical_trainer(
+            mesh_shape
+        )
+    finally:
+        # the harness mesh must not leak as ambient context (padding
+        # multiples, collective attribution) into the calling process —
+        # placement is already baked into the built programs/arrays
+        set_active_mesh(prev_mesh)
+    steps = trainer._steps
+    nb = 2
+    step_rng, multi_rng, scan_rng, fit_rng, sentinel_rng = jax.random.split(
+        jax.random.PRNGKey(0), 5
+    )
+    rngs = jax.random.split(multi_rng, nb)
+    scan_rngs = jax.random.split(scan_rng, nb)
+    perm = jnp.arange(nb)
+    sched = jax.tree_util.tree_map(jnp.asarray, SchedState.init())
+    best_state = _copy_tree(state)
+    perms = jnp.tile(jnp.arange(nb), (1, 1))
+    erngs = jax.random.split(fit_rng, nb).reshape(1, nb, -1)
+    active = jnp.arange(1) < 1
+    params, bs = state.params, state.batch_stats
+    lowerings = {
+        "train_step": lambda: steps.train_step.lower(
+            state, dev_batch, step_rng
+        ),
+        "train_multi": lambda: steps.train_multi.lower(state, stacked, rngs),
+        "epoch_scan": lambda: steps.epoch_scan.lower(
+            state, stacked, perm, scan_rngs
+        ),
+        "eval_epoch": lambda: steps.eval_epoch.lower(params, bs, stacked),
+        "predict_scan": lambda: steps.predict_scan.lower(
+            params, bs, stacked
+        ),
+        "fit_scan": lambda: steps.fit_scan.lower(
+            state, best_state, sched, stacked, stacked, stacked,
+            perms, erngs, active,
+        ),
+        "eval_step": lambda: steps.eval_step.lower(params, bs, dev_batch),
+        "eval_multi": lambda: steps.eval_multi.lower(params, bs, stacked),
+    }
+    if programs is not None:
+        lowerings = {k: lowerings[k] for k in programs}
+    texts = {
+        name: low().compile().as_text() for name, low in lowerings.items()
+    }
+    context = {
+        "trainer": trainer,
+        "state": state,
+        "dev_batch": dev_batch,
+        "rng": sentinel_rng,
+        "mesh": mesh,
+    }
+    return (
+        texts,
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
+        context,
+    )
+
+
+def run_sharding_sentinel(context) -> None:
+    """Execute one real train step and assert its outputs LAND at the
+    declared shardings (state at the rule-engine placement, metrics
+    replicated) — the runtime complement of the compile-time budget.
+    Raises :class:`~hydragnn_tpu.analysis.guards.ShardingViolation`."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from hydragnn_tpu.analysis.guards import sharding_sentinel
+
+    trainer = context["trainer"]
+    # the step donates its input state: snapshot-free is fine, the
+    # harness state is not reused after this
+    new_state, metrics = trainer._train_step(
+        context["state"], context["dev_batch"], context["rng"]
+    )
+    rep = NamedSharding(context["mesh"], PartitionSpec())
+    with sharding_sentinel() as sen:
+        sen.check(
+            new_state,
+            trainer._state_shardings,
+            what="train_step state",
+            defer=True,
+        )
+        sen.check(
+            metrics,
+            jax.tree_util.tree_map(lambda _: rep, metrics),
+            what="train_step metrics",
+            defer=True,
+        )
+
+
+# a synthetic full-mesh all-gather: the exact signature of an implicit
+# resharding (e.g. a parameter table gathered at every use) — appended to
+# a program's HLO text by --prove-injection to demonstrate the gate fires
+INJECTED_ALL_GATHER = (
+    "  %shardlint.injected = f32[65536]{0} all-gather("
+    "f32[8192]{0} %shardlint.operand), replica_groups={{0,1,2,3,4,5,6,7}}, "
+    "dimensions={0}\n"
+)
+
+
+def prove_injection(
+    texts: Dict[str, str],
+    budget_programs: Dict[str, Dict],
+    axes: Sequence[str],
+    shape: Sequence[int],
+    tolerance: float,
+) -> bool:
+    """Append a synthetic all-gather to one program and assert the
+    budget check CATCHES it — the ratchet's reintroduction regression,
+    run in CI so 'the gate would fire' is demonstrated, not assumed."""
+    prog = sorted(texts)[0]
+    doctored = dict(texts)
+    doctored[prog] = texts[prog] + INJECTED_ALL_GATHER
+    current = {
+        name: fingerprint_hlo(text, axes, shape)
+        for name, text in doctored.items()
+    }
+    violations, _ = check_fingerprints(
+        current, budget_programs, tolerance=tolerance
+    )
+    return any("all-gather" in v and prog in v for v in violations)
+
+
+# ---- CLI ------------------------------------------------------------------
+
+
+def _force_cpu_devices(n: int):
+    """The canonical budget compiles on the forced-N-device CPU backend;
+    set that up before the backend initializes (the jax module may
+    already be imported — only backend init reads these)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.analysis.hlo",
+        description=(
+            "shardlint compiled-HLO ratchet: fingerprint the step "
+            "programs' collective set against the committed budget "
+            "(docs/static-analysis.md)"
+        ),
+    )
+    p.add_argument(
+        "--check",
+        metavar="FILE",
+        help=f"check fingerprints against a budget (e.g. {DEFAULT_BUDGET})",
+    )
+    p.add_argument(
+        "--write",
+        metavar="FILE",
+        help="compile and write the current fingerprints as the budget",
+    )
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=None,
+        help="collective-bytes growth tolerance (default: the budget's, "
+        f"else {DEFAULT_TOLERANCE})",
+    )
+    p.add_argument(
+        "--mesh",
+        default=f"{DEFAULT_MESH[0]},{DEFAULT_MESH[1]}",
+        help='harness mesh "d,m" (default 4,2 — the canonical budget)',
+    )
+    p.add_argument(
+        "--prove-injection",
+        action="store_true",
+        help="after checking, inject a synthetic all-gather and assert "
+        "the gate catches it (the CI reintroduction proof)",
+    )
+    p.add_argument(
+        "--skip-sentinel",
+        action="store_true",
+        help="skip the runtime sharding-sentinel step execution",
+    )
+    args = p.parse_args(argv)
+
+    if not args.check and not args.write:
+        print(
+            "hlo-ratchet: one of --check/--write is required",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        d, m = (int(v) for v in args.mesh.split(","))
+    except ValueError:
+        print(
+            f'hlo-ratchet: --mesh {args.mesh!r} is not "d,m"',
+            file=sys.stderr,
+        )
+        return 2
+
+    # validate the budget BEFORE the multi-minute 8-program compile: a
+    # missing/mismatched budget is answerable from the JSON alone
+    budget = None
+    tolerance = (
+        args.tolerance if args.tolerance is not None else DEFAULT_TOLERANCE
+    )
+    if args.check and not args.write:
+        try:
+            budget = load_budget(args.check)
+        except FileNotFoundError:
+            print(
+                f"hlo-ratchet: budget {args.check} not found — derive it "
+                "with --write",
+                file=sys.stderr,
+            )
+            return 2
+        except ValueError as e:
+            print(f"hlo-ratchet: {e}", file=sys.stderr)
+            return 2
+        if args.tolerance is None:
+            tolerance = float(budget.get("tolerance", DEFAULT_TOLERANCE))
+        bmesh = budget.get("mesh", {})
+        if list(bmesh.get("shape", [])) != [d, m]:
+            print(
+                f"hlo-ratchet: budget was derived on mesh "
+                f"{bmesh.get('shape')} but this run uses [{d}, {m}] — "
+                "fingerprints are not comparable (pass the matching "
+                "--mesh)",
+                file=sys.stderr,
+            )
+            return 2
+
+    # the canonical environment: forced CPU devices, no ambient
+    # HYDRAGNN_MESH leaking into the harness resolution
+    os.environ.pop("HYDRAGNN_MESH", None)
+    _force_cpu_devices(max(d * m, 8))
+
+    print(f"hlo-ratchet: compiling 8 step programs on a {d}x{m} CPU mesh")
+    texts, axes, shape, context = compile_step_programs((d, m))
+    current = {
+        name: fingerprint_hlo(text, axes, shape)
+        for name, text in texts.items()
+    }
+
+    if not args.skip_sentinel:
+        run_sharding_sentinel(context)
+        print("hlo-ratchet: sharding sentinel OK (outputs landed as declared)")
+
+    if args.write:
+        save_budget(
+            args.write,
+            current,
+            axes,
+            shape,
+            tolerance=(
+                args.tolerance
+                if args.tolerance is not None
+                else DEFAULT_TOLERANCE
+            ),
+        )
+        ncol = sum(len(fp["collectives"]) for fp in current.values())
+        print(
+            f"hlo-ratchet: wrote {len(current)} program fingerprint(s) "
+            f"({ncol} collective record(s)) to {args.write}"
+        )
+        return 0
+
+    violations, notes = check_fingerprints(
+        current, budget.get("programs", {}), tolerance=tolerance
+    )
+    for note in notes:
+        print(f"note: {note}", file=sys.stderr)
+    for v in violations:
+        print(f"VIOLATION: {v}")
+    ok = not violations
+    print(
+        f"hlo-ratchet: {len(violations)} violation(s) across "
+        f"{len(current)} program(s) (tolerance {tolerance:.0%})"
+    )
+    if ok and args.prove_injection:
+        if prove_injection(
+            texts, budget.get("programs", {}), axes, shape, tolerance
+        ):
+            print(
+                "hlo-ratchet: injection proof OK — a synthetic "
+                "all-gather IS caught by this budget"
+            )
+        else:
+            print(
+                "hlo-ratchet: injection proof FAILED — the gate did not "
+                "catch a synthetic all-gather",
+                file=sys.stderr,
+            )
+            return 1
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,458 @@
+"""Sharding-correctness rules (shardlint, ``--suite=sharding``).
+
+PR 10 made the repo genuinely 2-D parallel: params column-split over
+``model`` per the rule engine, batches over ``data``, eight jit programs
+in ``train/steps.py`` declaring explicit in/out shardings. Nothing
+*static* guarded that layer — a hardcoded axis string, a jit program
+added without shardings, or a stray ``device_put`` all pass tier-1 on CPU
+and surface only as an MFU regression on real hardware. These rules are
+the lint half of shardlint; the compiled-HLO ratchet
+(``analysis/hlo.py``) is the post-compile half.
+
+The axis-name vocabulary is ``parallel/mesh.py``'s
+``DATA_AXIS``/``MODEL_AXIS``/``GRAPH_AXIS`` (imported lazily with a
+literal fallback, so the AST pass never depends on the analyzed package
+importing cleanly).
+"""
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from hydragnn_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    matches_any,
+    register,
+)
+
+
+def _known_axes() -> frozenset:
+    try:
+        from hydragnn_tpu.parallel.mesh import KNOWN_AXES
+
+        return frozenset(KNOWN_AXES)
+    except Exception:
+        return frozenset({"data", "model", "graph"})
+
+
+_PARALLEL_PATTERNS = (
+    "hydragnn_tpu/parallel/*",
+    "parallel/*",
+    "*/parallel/*",
+)
+# device-dispatching code that must declare its sharding contract
+_CONTRACT_PATTERNS = (
+    "hydragnn_tpu/train/*",
+    "hydragnn_tpu/serve/*",
+    "train/*",
+    "serve/*",
+    "*/train/*",
+    "*/serve/*",
+)
+
+# calls whose string arguments ARE mesh-axis names
+_SPEC_CALLEES = {"P", "PartitionSpec"}
+_MESH_CALLEES = {"Mesh"}
+_COLLECTIVE_TAILS = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "psum_scatter",
+    "all_gather",
+    "all_to_all",
+    "axis_index",
+    "ppermute",
+}
+
+
+def _axis_call_kind(node: ast.Call) -> Optional[str]:
+    """'spec' | 'mesh' | 'collective' when the call's string args name
+    mesh axes; None otherwise."""
+    callee = dotted_name(node.func)
+    if not callee:
+        return None
+    tail = callee.rsplit(".", 1)[-1]
+    if tail in _SPEC_CALLEES or callee.endswith(".PartitionSpec"):
+        return "spec"
+    if tail in _MESH_CALLEES and (
+        callee == "Mesh" or callee.endswith(".Mesh")
+    ):
+        return "mesh"
+    if tail in _COLLECTIVE_TAILS and (
+        callee == tail or ".lax." in callee or callee.startswith("lax.")
+    ):
+        return "collective"
+    return None
+
+
+def _string_args(node: ast.Call):
+    """Every string constant inside the call's argument expressions
+    (walks nested tuples/lists, so ``P(None, ('data',))`` is covered)."""
+    for arg in [*node.args, *[k.value for k in node.keywords]]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                yield sub
+
+
+@register
+class HardcodedMeshAxis(Rule):
+    name = "hardcoded-mesh-axis"
+    suite = "sharding"
+    description = (
+        "Mesh-axis string literal ('data'/'model'/'graph') in a "
+        "PartitionSpec/Mesh/collective call outside parallel/ — route "
+        "through parallel.mesh DATA_AXIS/MODEL_AXIS/GRAPH_AXIS so a "
+        "renamed axis is a NameError, not a silent replication"
+    )
+
+    def applies_to(self, module: ModuleInfo) -> bool:
+        return not matches_any(module.rel_path, _PARALLEL_PATTERNS)
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        axes = _known_axes()
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _axis_call_kind(node)
+            if kind is None:
+                continue
+            for const in _string_args(node):
+                if const.value in axes:
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            const,
+                            f"axis name {const.value!r} hardcoded in a "
+                            f"{kind} call — import the named constant "
+                            "from hydragnn_tpu.parallel (DATA_AXIS/"
+                            "MODEL_AXIS/GRAPH_AXIS); only parallel/ "
+                            "spells the strings",
+                        )
+                    )
+        return findings
+
+
+@register
+class UnknownSpecAxis(Rule):
+    name = "unknown-spec-axis"
+    suite = "sharding"
+    description = (
+        "PartitionSpec/collective axis literal that is not a 2-D mesh "
+        "axis ('data'/'model'/'graph') — a typo'd axis name fails only "
+        "at trace time on a mesh that HAS the axis, and silently "
+        "replicates everywhere else"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        axes = _known_axes()
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _axis_call_kind(node) not in ("spec", "collective"):
+                continue
+            for const in _string_args(node):
+                if const.value not in axes:
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            const,
+                            f"axis name {const.value!r} is not one of "
+                            f"the mesh axes {tuple(sorted(axes))} — "
+                            "typo, or a new axis missing from "
+                            "parallel.mesh.KNOWN_AXES",
+                        )
+                    )
+        return findings
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_DISPATCH_SUBSTRINGS = (
+    "train",
+    "fit",
+    "update",
+    "eval",
+    "predict",
+    "infer",
+    "apply",
+    "scan",
+    "epoch",
+)
+_DISPATCH_EXACT = {"step"}
+
+
+def _wrapped_name(call: ast.Call) -> Optional[str]:
+    if not call.args:
+        return None
+    first = call.args[0]
+    if isinstance(first, ast.Name):
+        return first.id
+    if isinstance(first, ast.Attribute):
+        return first.attr
+    return None
+
+
+def _looks_dispatching(name: str) -> bool:
+    low = name.lower()
+    return any(s in low for s in _DISPATCH_SUBSTRINGS) or (
+        low.lstrip("_") in _DISPATCH_EXACT
+    )
+
+
+def _decorator_jit_keywords(dec: ast.AST):
+    """``(is_jit, keywords)`` for the decorator spellings: bare
+    ``@jax.jit``, configured ``@jax.jit(...)``, and
+    ``@partial(jax.jit, ...)``."""
+    if dotted_name(dec) in _JIT_NAMES:
+        return True, []
+    if isinstance(dec, ast.Call):
+        callee = dotted_name(dec.func)
+        if callee in _JIT_NAMES:
+            return True, dec.keywords
+        if (
+            callee in ("partial", "functools.partial")
+            and dec.args
+            and dotted_name(dec.args[0]) in _JIT_NAMES
+        ):
+            return True, dec.keywords
+    return False, []
+
+
+def _declares_contract(keywords) -> bool:
+    kw_names = {kw.arg for kw in keywords}
+    return bool(kw_names & {"in_shardings", "out_shardings"}) or (
+        None in kw_names  # a **plan splat carries the contract
+    )
+
+
+@register
+class JitMissingShardings(Rule):
+    name = "jit-missing-shardings"
+    suite = "sharding"
+    description = (
+        "Device-dispatching jax.jit in train//serve/ without explicit "
+        "in_shardings/out_shardings — on the 2-D mesh the program "
+        "inherits whatever placement its inputs carry; declare the "
+        "contract (steps.py _sharding_plan) or use "
+        "parallel.mesh.jit_replicated"
+    )
+
+    def applies_to(self, module: ModuleInfo) -> bool:
+        return matches_any(module.rel_path, _CONTRACT_PATTERNS)
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            # call form: jax.jit(fn, ...)
+            if (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func) in _JIT_NAMES
+            ):
+                fn_name = _wrapped_name(node)
+                # lambdas/utility copies inherit deliberately
+                if fn_name is not None and _looks_dispatching(fn_name):
+                    if not _declares_contract(node.keywords):
+                        findings.append(
+                            module.finding(
+                                self.name,
+                                node,
+                                f"jax.jit({fn_name}) dispatches to "
+                                "devices but declares no in_shardings/"
+                                "out_shardings — on a 2-D mesh its "
+                                "placement is whatever the inputs "
+                                "happened to carry; declare the "
+                                "contract or route through "
+                                "parallel.mesh.jit_replicated",
+                            )
+                        )
+                continue
+            # decorator forms: @jax.jit / @jax.jit(...) /
+            # @partial(jax.jit, ...) on a dispatching-named def
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) or not _looks_dispatching(node.name):
+                continue
+            for dec in node.decorator_list:
+                is_jit, keywords = _decorator_jit_keywords(dec)
+                if is_jit and not _declares_contract(keywords):
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            dec,
+                            f"@jit on `{node.name}` dispatches to "
+                            "devices but declares no in_shardings/"
+                            "out_shardings — declare the contract or "
+                            "route through parallel.mesh.jit_replicated",
+                        )
+                    )
+        return findings
+
+
+@register
+class DevicePutWithoutSharding(Rule):
+    name = "device-put-without-sharding"
+    suite = "sharding"
+    description = (
+        "jax.device_put of a non-scalar without an explicit sharding — "
+        "the array lands fully on the default device; pass a "
+        "NamedSharding (or use rules.put_tree / Trainer.place_state)"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in (
+                "jax.device_put",
+                "device_put",
+            ):
+                continue
+            if len(node.args) >= 2 or {
+                kw.arg for kw in node.keywords
+            } & {"device", "sharding", None}:
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant):
+                continue  # a literal scalar has no layout to get wrong
+            findings.append(
+                module.finding(
+                    self.name,
+                    node,
+                    "device_put without a sharding places the full "
+                    "array on ONE device — every sharded consumer then "
+                    "pays a reshard; pass NamedSharding(mesh, spec) "
+                    "(parallel/rules.put_tree for pytrees)",
+                )
+            )
+        return findings
+
+
+@register
+class LegacyPmapUsage(Rule):
+    name = "legacy-pmap-usage"
+    suite = "sharding"
+    description = (
+        "jax.pmap — the pre-mesh SPMD API; it fights the 2-D mesh "
+        "(separate device axes, no NamedSharding interop). Use jit with "
+        "shardings on the ('data', 'model') mesh instead"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+
+        def flag(node, how: str):
+            line = getattr(node, "lineno", 0)
+            if line in seen:
+                return
+            seen.add(line)
+            findings.append(
+                module.finding(
+                    self.name,
+                    node,
+                    f"jax.pmap {how} — replicated-params pmap cannot "
+                    "compose with the mesh's NamedSharding placement; "
+                    "express this as jax.jit with in/out shardings "
+                    "(train/steps.py) or shard_map",
+                )
+            )
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and dotted_name(node.func) in (
+                "jax.pmap",
+                "pmap",
+            ):
+                flag(node, "call")
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                for dec in node.decorator_list:
+                    if dotted_name(dec) in ("jax.pmap", "pmap") or (
+                        isinstance(dec, ast.Call)
+                        and dotted_name(dec.func) in ("jax.pmap", "pmap")
+                    ):
+                        flag(dec, "decorator")
+        return findings
+
+
+def _reshape_leading_dim(node: ast.Call) -> Optional[ast.AST]:
+    """The expression for the FIRST target dim of a reshape call, or
+    None when there is none (``x.reshape(dims)`` / ``jnp.reshape(x,
+    shape)`` / splatted shapes)."""
+    callee = dotted_name(node.func)
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "reshape":
+        if callee in ("jnp.reshape", "jax.numpy.reshape", "np.reshape"):
+            shape = node.args[1] if len(node.args) >= 2 else None
+        else:
+            shape = node.args[0] if node.args else None
+    else:
+        return None
+    if isinstance(shape, (ast.Tuple, ast.List)) and shape.elts:
+        return shape.elts[0]
+    return shape
+
+
+def _is_minus_one(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant) and node.value == -1:
+        return True
+    return (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+        and node.operand.value == 1
+    )
+
+
+@register
+class ReshapeAcrossShardedDim(Rule):
+    name = "reshape-across-sharded-dim"
+    suite = "sharding"
+    description = (
+        "reshape(-1, ...) inside a function that pins shardings "
+        "(with_sharding_constraint) — collapsing the leading dim merges "
+        "the sharded axis into the rest and XLA inserts a full "
+        "all-gather to honor it"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen: Set[tuple] = set()  # a nested fn is walked by its outer too
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            constrains = any(
+                isinstance(sub, ast.Call)
+                and dotted_name(sub.func).endswith(
+                    "with_sharding_constraint"
+                )
+                for sub in ast.walk(fn)
+            )
+            if not constrains:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) and _is_minus_one(
+                    _reshape_leading_dim(sub)
+                ):
+                    key = (sub.lineno, sub.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(
+                        module.finding(
+                            self.name,
+                            sub,
+                            "reshape with a leading -1 in a sharded "
+                            "program body collapses the sharded leading "
+                            "axis — XLA materializes a full all-gather; "
+                            "keep the leading dim (reshape trailing "
+                            "dims) or reshape shard-locally inside "
+                            "shard_map",
+                        )
+                    )
+        return findings

@@ -8,9 +8,15 @@ from hydragnn_tpu.parallel.distributed import (
     setup_distributed,
 )
 from hydragnn_tpu.parallel.mesh import (
+    DATA_AXIS,
+    GRAPH_AXIS,
+    KNOWN_AXES,
+    MESH_AXES,
+    MODEL_AXIS,
     best_mesh_shape,
     data_axis_multiple,
     default_mesh,
+    jit_replicated,
     make_mesh,
     make_mesh2d,
     mesh_shape_list,

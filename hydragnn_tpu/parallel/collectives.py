@@ -116,14 +116,19 @@ def classify_groups(
     return "other"
 
 
-def collective_bytes_by_axis(
+def parse_collectives(
     hlo_text: str, axes: Sequence[str], shape: Sequence[int]
-) -> Dict[str, float]:
-    """``{axis: result_bytes_per_device_per_dispatch}`` summed over every
-    collective in one compiled module. Result bytes (the op's output
-    shape), not wire bytes — a stable, backend-independent proxy the
-    1-D/2-D A/B in ``bench.py --mesh`` compares on."""
-    totals: Dict[str, float] = {}
+) -> List[Dict]:
+    """One record per collective op in a compiled module:
+    ``{"op": kind, "axis": mesh-axis, "bytes": result_bytes}``.
+
+    The per-op form is shardlint's compiled-HLO fingerprint
+    (``analysis/hlo.py``): a future refactor that makes XLA insert an
+    implicit-resharding all-gather changes the record *set*, not just a
+    per-axis total a shrinking all-reduce could mask. ``*-done`` halves
+    of async pairs are skipped (the ``-start`` carries the bytes), same
+    as the summed view."""
+    records: List[Dict] = []
     for line in hlo_text.splitlines():
         m = _OP_RE.search(line)
         if m is None:
@@ -139,5 +144,20 @@ def collective_bytes_by_axis(
             if groups is not None
             else "other"
         )
-        totals[axis] = totals.get(axis, 0.0) + float(nbytes)
+        records.append(
+            {"op": m.group("op"), "axis": axis, "bytes": float(nbytes)}
+        )
+    return records
+
+
+def collective_bytes_by_axis(
+    hlo_text: str, axes: Sequence[str], shape: Sequence[int]
+) -> Dict[str, float]:
+    """``{axis: result_bytes_per_device_per_dispatch}`` summed over every
+    collective in one compiled module. Result bytes (the op's output
+    shape), not wire bytes — a stable, backend-independent proxy the
+    1-D/2-D A/B in ``bench.py --mesh`` compares on."""
+    totals: Dict[str, float] = {}
+    for rec in parse_collectives(hlo_text, axes, shape):
+        totals[rec["axis"]] = totals.get(rec["axis"], 0.0) + rec["bytes"]
     return totals

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from hydragnn_tpu.graph.batch import GraphBatch
+from hydragnn_tpu.parallel.mesh import GRAPH_AXIS
 
 
 # --------------------------------------------------------------------------
@@ -529,7 +530,7 @@ def _put_global(a, sharding):
     return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])
 
 
-def put_partitioned_batch(batch: GraphBatch, mesh, axis: str = "graph") -> GraphBatch:
+def put_partitioned_batch(batch: GraphBatch, mesh, axis: str = GRAPH_AXIS) -> GraphBatch:
     """Device placement: every leaf sharded on axis 0 so each device holds
     exactly its shard's rows (multi-host safe)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -558,7 +559,7 @@ def put_partitioned_state(state, mesh):
     )
 
 
-def make_partitioned_apply(model, mesh, axis: str = "graph"):
+def make_partitioned_apply(model, mesh, axis: str = GRAPH_AXIS):
     """Jitted partitioned forward: (variables, batch) -> per-shard outputs.
 
     Graph-head rows come back replicated-identical on every shard; node-head
@@ -584,7 +585,7 @@ def make_partitioned_apply(model, mesh, axis: str = "graph"):
     return jax.jit(fwd)
 
 
-def make_partitioned_train_step(model, tx, mesh, axis: str = "graph"):
+def make_partitioned_train_step(model, tx, mesh, axis: str = GRAPH_AXIS):
     """One fused XLA program: partitioned forward + psum'd loss + backward
     (all_to_all transposes inserted by AD) + grad psum + optimizer update.
 
@@ -667,7 +668,7 @@ def make_partitioned_train_step(model, tx, mesh, axis: str = "graph"):
     return jax.jit(step, donate_argnums=(0,))
 
 
-def make_partitioned_eval_step(model, mesh, axis: str = "graph"):
+def make_partitioned_eval_step(model, mesh, axis: str = GRAPH_AXIS):
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 

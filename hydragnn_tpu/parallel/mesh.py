@@ -28,6 +28,21 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+# ---- axis names -----------------------------------------------------------
+# THE spellings of the mesh axes. Everything outside ``parallel/`` must
+# route through these constants instead of re-typing the string — the
+# shardlint ``hardcoded-mesh-axis`` rule (analysis/rules_sharding.py)
+# enforces it, so a renamed or fat-fingered axis is a NameError at import
+# time, not a silently-replicated PartitionSpec three PRs later.
+DATA_AXIS = "data"  # batch leading axes; gradient all-reduce
+MODEL_AXIS = "model"  # column-split weights; graph-partition ownership (2-D)
+GRAPH_AXIS = "graph"  # legacy 1-D graph-partition mesh axis
+MESH_AXES: Tuple[str, str] = (DATA_AXIS, MODEL_AXIS)
+# every axis name a PartitionSpec/collective in this repo may legally
+# name (the shardlint ``unknown-spec-axis`` rule checks literals against
+# this set)
+KNOWN_AXES = frozenset({DATA_AXIS, MODEL_AXIS, GRAPH_AXIS})
+
 # the driver-resolved mesh, consulted by the loaders (leading-axis padding
 # must divide the DATA axis, not the raw device count) and by the obs
 # introspection layer (collective-bytes axis attribution)
@@ -72,7 +87,7 @@ def data_axis_multiple() -> int:
     (the historical default — identical when the default 1-D mesh is in
     use, and the only safe answer when no mesh was resolved yet)."""
     if _active_mesh is not None:
-        return int(dict(_active_mesh.shape).get("data", 1))
+        return int(dict(_active_mesh.shape).get(DATA_AXIS, 1))
     import jax
 
     try:
@@ -102,10 +117,10 @@ def default_mesh(min_devices: int = 2):
     devices = jax.devices()
     if len(devices) < min_devices:
         return None
-    return Mesh(np.asarray(devices), ("data",))
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
+def make_mesh(n_devices: Optional[int] = None, axis: str = DATA_AXIS):
     import jax
     from jax.sharding import Mesh
 
@@ -115,7 +130,7 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
     return Mesh(np.asarray(devices), (axis,))
 
 
-def make_mesh2d(data: int, model: int, axes: Tuple[str, str] = ("data", "model")):
+def make_mesh2d(data: int, model: int, axes: Tuple[str, str] = MESH_AXES):
     """2-D ``(data, model)`` mesh over the first ``data*model`` devices.
     Device order is row-major — one model group is ``model`` CONSECUTIVE
     devices (the ICI-nearest neighbors on a TPU slice, where the
@@ -138,25 +153,22 @@ def mesh_shape_list(mesh):
     if mesh is None:
         return None
     shape = dict(mesh.shape)
-    return [int(shape.get("data", 1)), int(shape.get("model", shape.get("graph", 1)))]
+    return [
+        int(shape.get(DATA_AXIS, 1)),
+        int(shape.get(MODEL_AXIS, shape.get(GRAPH_AXIS, 1))),
+    ]
 
 
 def requested_mesh(training_config: Optional[dict]):
     """(d_or_None, m) requested via ``HYDRAGNN_MESH="d,m"`` (env wins) or
-    ``Training.model_parallel`` / ``Training.mesh_shape`` ([d, m])."""
-    env = os.getenv("HYDRAGNN_MESH")
-    if env and env.strip():
-        parts = [p.strip() for p in env.split(",")]
-        try:
-            if len(parts) == 1:
-                return None, int(parts[0])
-            if len(parts) == 2:
-                return int(parts[0]), int(parts[1])
-        except ValueError:
-            pass
-        raise ValueError(
-            f'HYDRAGNN_MESH={env!r} is not "d,m" or a bare model width'
-        )
+    ``Training.model_parallel`` / ``Training.mesh_shape`` ([d, m]).
+    Parsing routes through :func:`~hydragnn_tpu.utils.envparse.env_mesh`,
+    so a malformed value ("4x2") errors naming the VARIABLE."""
+    from hydragnn_tpu.utils.envparse import env_mesh
+
+    env = env_mesh("HYDRAGNN_MESH")
+    if env is not None:
+        return env
     cfg = training_config or {}
     shape = cfg.get("mesh_shape")
     if shape:
@@ -233,6 +245,31 @@ def shard_parameters(params, mesh):
     bytes are tiny next to activations, so this is a parity/completeness
     knob, not a memory necessity)."""
     return shard_over_data_axis(params, mesh)
+
+
+def jit_replicated(fn, **kwargs):
+    """``jax.jit`` with an EXPLICIT replicated output contract on the
+    active mesh (plain jit when none is registered) — the sanctioned
+    spelling for device-dispatching programs outside ``train/steps.py``'s
+    sharding plan (serve dispatch, ad-hoc eval programs). Shardlint's
+    ``jit-missing-shardings`` rule flags bare ``jax.jit`` at those sites;
+    this helper IS the fix: the contract is declared here once instead of
+    silently inherited from whatever placement the inputs carried."""
+    import jax
+
+    mesh = active_mesh()
+    # membership, not truthiness: out_shardings=None (jit's explicit
+    # "infer from inputs") and empty PartitionSpecs are falsy but ARE a
+    # caller-declared contract this helper must not override
+    if (
+        mesh is not None
+        and "in_shardings" not in kwargs
+        and "out_shardings" not in kwargs
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        kwargs["out_shardings"] = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(fn, **kwargs)
 
 
 def announce_mesh(mesh, trainer=None, resume_meta=None, started_ts=None):

@@ -532,10 +532,16 @@ class InferenceServer:
             # (live telemetry or HYDRAGNN_INTROSPECT=1), every serving
             # bucket's compiled cost/memory analysis is captured at
             # warmup — introspect.captured() carries it even without a
-            # telemetry run. Pure passthrough otherwise.
+            # telemetry run. Pure passthrough otherwise. jit_replicated
+            # declares the sharding contract (replicated outputs on the
+            # active mesh; plain jit without one) instead of inheriting
+            # whatever placement the inputs carried — the shardlint
+            # jit-missing-shardings contract for serve dispatch.
+            from hydragnn_tpu.parallel.mesh import jit_replicated
+
             fn = instrument(
                 f"serve_predict:{entry.name}:v{entry.version}",
-                jax.jit(_apply),
+                jit_replicated(_apply),
             )
             self._predict_fns[entry.key] = fn
         return fn

@@ -89,15 +89,20 @@ def _partition_geometry(config) -> tuple:
     ``partition_axis`` name."""
     import jax
 
-    from hydragnn_tpu.parallel.mesh import best_mesh_shape, requested_mesh
+    from hydragnn_tpu.parallel.mesh import (
+        GRAPH_AXIS,
+        MODEL_AXIS,
+        best_mesh_shape,
+        requested_mesh,
+    )
 
     arch = config["NeuralNetwork"]["Architecture"]
     training = config["NeuralNetwork"].get("Training", {})
     _, m_req = requested_mesh(training)
     if m_req > 1:
         _, m = best_mesh_shape(len(jax.devices()), m_req)
-        return m, "model"
-    return len(jax.devices()), arch.get("partition_axis") or "graph"
+        return m, MODEL_AXIS
+    return len(jax.devices()), arch.get("partition_axis") or GRAPH_AXIS
 
 
 def _build_partitioned(config, arch, train_loader, verbosity):
@@ -108,6 +113,7 @@ def _build_partitioned(config, arch, train_loader, verbosity):
     import jax
 
     from hydragnn_tpu.parallel.mesh import (
+        MODEL_AXIS,
         best_mesh_shape,
         make_mesh,
         make_mesh2d,
@@ -122,7 +128,7 @@ def _build_partitioned(config, arch, train_loader, verbosity):
     arch["partition_axis"] = axis
     model = create_model_config(arch, verbosity)
     ref_model = create_model_config(ref_arch, verbosity)
-    if axis == "model":
+    if axis == MODEL_AXIS:
         d, m = best_mesh_shape(len(jax.devices()), parts)
         mesh = make_mesh2d(d, m)
         if d > 1:

@@ -130,9 +130,11 @@ class PredictMixin:
                 from jax.experimental import multihost_utils
                 from jax.sharding import PartitionSpec as P
 
+                from hydragnn_tpu.parallel.mesh import DATA_AXIS
+
                 outputs = multihost_utils.global_array_to_host_local_array(
                     outputs, self.mesh, jax.tree_util.tree_map(
-                        lambda _: P("data"), outputs
+                        lambda _: P(DATA_AXIS), outputs
                     )
                 )
             outputs = jax.device_get(outputs)

@@ -53,9 +53,11 @@ def _sharding_plan(mesh, state_shardings):
     gate stays clean by construction)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from hydragnn_tpu.parallel.mesh import DATA_AXIS
+
     rep = NamedSharding(mesh, P())
-    batch = NamedSharding(mesh, P("data"))
-    stacked = NamedSharding(mesh, P(None, "data"))
+    batch = NamedSharding(mesh, P(DATA_AXIS))
+    stacked = NamedSharding(mesh, P(None, DATA_AXIS))
     st = state_shardings
     return {
         "train_step": dict(

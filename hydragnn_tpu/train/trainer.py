@@ -296,8 +296,10 @@ class Trainer(PredictMixin):
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from hydragnn_tpu.parallel.mesh import DATA_AXIS
+
             if self._batch_sharding is None:
-                self._batch_sharding = NamedSharding(self.mesh, P("data"))
+                self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
             if jax.process_count() > 1:
                 batch = _offset_local_shard(batch, jax.process_index())
                 return jax.tree_util.tree_map(
@@ -321,8 +323,12 @@ class Trainer(PredictMixin):
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from hydragnn_tpu.parallel.mesh import DATA_AXIS
+
             if self._stacked_sharding is None:
-                self._stacked_sharding = NamedSharding(self.mesh, P(None, "data"))
+                self._stacked_sharding = NamedSharding(
+                    self.mesh, P(None, DATA_AXIS)
+                )
             if jax.process_count() > 1:
                 stacked = _offset_local_shard(stacked, jax.process_index())
                 return jax.tree_util.tree_map(

@@ -8,7 +8,7 @@ shell line that caused it).
 """
 
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 
 def env_int(
@@ -31,3 +31,32 @@ def env_int(
             f"{name} must be >= {minimum}, got {value}"
         )
     return value
+
+
+def env_mesh(
+    name: str = "HYDRAGNN_MESH",
+) -> Optional[Tuple[Optional[int], int]]:
+    """Mesh-shape env knob: ``"d,m"`` -> ``(d, m)``, a bare model width
+    ``"m"`` -> ``(None, m)``, unset/empty -> None. Malformed values
+    (``"4x2"``, three fields, non-integers, non-positive sizes) raise a
+    ``ValueError`` naming the variable — not a bare ``int()`` traceback
+    from inside ``resolve_mesh``."""
+    raw = os.getenv(name)
+    if raw is None or raw.strip() == "":
+        return None
+    parts = [p.strip() for p in raw.split(",")]
+    try:
+        if len(parts) == 1:
+            pair: Tuple[Optional[int], int] = (None, int(parts[0]))
+        elif len(parts) == 2:
+            pair = (int(parts[0]), int(parts[1]))
+        else:
+            raise ValueError
+        if any(v is not None and v < 1 for v in pair):
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f'{name}={raw!r} is not "data,model" or a bare model width '
+            '(expected e.g. "4,2" or "2", positive integers)'
+        ) from None
+    return pair

@@ -295,6 +295,29 @@ def pytest_requested_mesh_env_and_config(monkeypatch):
         requested_mesh({"mesh_shape": [8]})  # [d, m] typo'd to one entry
 
 
+def pytest_env_mesh_names_the_variable(monkeypatch):
+    """HYDRAGNN_MESH parsing routes through utils/envparse.env_mesh: a
+    malformed value errors naming the VARIABLE and the offending text,
+    never a bare int() ValueError from inside resolve_mesh."""
+    from hydragnn_tpu.parallel.mesh import requested_mesh
+    from hydragnn_tpu.utils.envparse import env_mesh
+
+    monkeypatch.delenv("HYDRAGNN_MESH", raising=False)
+    assert env_mesh("HYDRAGNN_MESH") is None
+    monkeypatch.setenv("HYDRAGNN_MESH", "  ")
+    assert env_mesh("HYDRAGNN_MESH") is None
+    monkeypatch.setenv("HYDRAGNN_MESH", "4,2")
+    assert env_mesh("HYDRAGNN_MESH") == (4, 2)
+    monkeypatch.setenv("HYDRAGNN_MESH", " 2 ")
+    assert env_mesh("HYDRAGNN_MESH") == (None, 2)
+    for bad in ("4x2", "4,2,1", "4,", "a,b", "0,2", "-1"):
+        monkeypatch.setenv("HYDRAGNN_MESH", bad)
+        with pytest.raises(ValueError) as e:
+            requested_mesh(None)
+        # names the variable AND the offending text
+        assert "HYDRAGNN_MESH" in str(e.value) and bad in str(e.value)
+
+
 def pytest_resolve_mesh_re_derives_oversized_request(monkeypatch):
     """A requested shape that no longer fits the visible devices (the
     elastic-shrink scenario) re-derives via best_mesh_shape instead of

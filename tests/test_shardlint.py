@@ -1,0 +1,490 @@
+"""shardlint (analysis --suite=sharding): the sharding-correctness suite.
+
+Per rule: a bad snippet that must flag and a good snippet that must not,
+plus the shardlint suppression tag, the per-suite ``--list-rules``
+catalog, multi-suite ``--stats``/github output in ONE invocation, the
+CLI exit-code contract, and the acceptance regressions — the merged tree
+runs clean against the committed (empty) ``.shardlint-baseline.json``,
+and reintroducing a hardcoded axis in a step builder or a contract-less
+serve jit fails the gate.
+
+Everything here is pure-AST: no jax execution. The compiled-HLO half of
+shardlint (``analysis/hlo.py``) is covered by
+``tests/test_shardlint_hlo.py`` and the CI ratchet smoke.
+"""
+
+import json
+import os
+import textwrap
+
+from hydragnn_tpu.analysis import analyze_paths
+from hydragnn_tpu.analysis.__main__ import main as lint_main
+from hydragnn_tpu.analysis.core import all_rules, all_suites, rules_in_suite
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SHARDING_RULES = {
+    "hardcoded-mesh-axis",
+    "jit-missing-shardings",
+    "unknown-spec-axis",
+    "device-put-without-sharding",
+    "legacy-pmap-usage",
+    "reshape-across-sharded-dim",
+}
+
+
+def _lint(tmp_path, files, **kw):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return analyze_paths([str(tmp_path)], root=str(tmp_path), **kw).findings
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def pytest_sharding_suite_registry():
+    assert rules_in_suite("sharding") == SHARDING_RULES
+    assert "sharding" in all_suites()
+
+
+def pytest_axis_vocabulary_matches_parallel_constants():
+    # the rule module's fallback vocabulary and the real constants must
+    # agree — a renamed axis must change BOTH or the lint goes blind
+    from hydragnn_tpu.analysis.rules_sharding import _known_axes
+    from hydragnn_tpu.parallel.mesh import (
+        DATA_AXIS,
+        GRAPH_AXIS,
+        KNOWN_AXES,
+        MODEL_AXIS,
+    )
+
+    assert _known_axes() == frozenset(KNOWN_AXES)
+    assert {DATA_AXIS, MODEL_AXIS, GRAPH_AXIS} == set(KNOWN_AXES)
+
+
+# ---- hardcoded-mesh-axis --------------------------------------------------
+
+_AXIS_BAD = """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def plan(mesh):
+        batch = NamedSharding(mesh, P("data"))
+        stacked = NamedSharding(mesh, P(None, "model"))
+        return batch, stacked
+"""
+
+_AXIS_GOOD = """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hydragnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    def plan(mesh):
+        batch = NamedSharding(mesh, P(DATA_AXIS))
+        stacked = NamedSharding(mesh, P(None, MODEL_AXIS))
+        return batch, stacked
+"""
+
+
+def pytest_hardcoded_axis_flags_literals_outside_parallel(tmp_path):
+    findings = _lint(tmp_path, {"train/steps.py": _AXIS_BAD})
+    hits = [f for f in findings if f.rule == "hardcoded-mesh-axis"]
+    assert len(hits) == 2, findings
+
+
+def pytest_hardcoded_axis_clean_on_constants(tmp_path):
+    findings = _lint(tmp_path, {"train/steps.py": _AXIS_GOOD})
+    assert not [f for f in findings if f.rule == "hardcoded-mesh-axis"]
+
+
+def pytest_hardcoded_axis_exempts_parallel_package(tmp_path):
+    # parallel/ is where the strings are DEFINED — the constants module
+    # and the mesh builders legitimately spell them
+    findings = _lint(tmp_path, {"parallel/mesh.py": _AXIS_BAD})
+    assert not [f for f in findings if f.rule == "hardcoded-mesh-axis"]
+
+
+def pytest_hardcoded_axis_flags_collective_axis_names(tmp_path):
+    src = """
+        import jax
+
+        def pooled(x):
+            return jax.lax.psum(x, "model")
+
+        def indexed(axis_name):
+            return jax.lax.axis_index(axis_name)  # variable: fine
+    """
+    findings = _lint(tmp_path, {"models/common.py": src})
+    hits = [f for f in findings if f.rule == "hardcoded-mesh-axis"]
+    assert len(hits) == 1 and "'model'" in hits[0].message, findings
+
+
+# ---- jit-missing-shardings ------------------------------------------------
+
+_JIT_BAD = """
+    import jax
+
+    def make(model):
+        def _apply(params, batch):
+            return model.apply(params, batch)
+
+        return jax.jit(_apply)
+"""
+
+_JIT_GOOD = """
+    import jax
+
+    from hydragnn_tpu.parallel.mesh import jit_replicated
+
+    def make(model, plan):
+        def _apply(params, batch):
+            return model.apply(params, batch)
+
+        def train_step(state, batch, rng):
+            return state
+
+        a = jit_replicated(_apply)
+        b = jax.jit(train_step, **plan, donate_argnums=(0,))
+        c = jax.jit(_apply, out_shardings=None)
+        d = jax.jit(lambda t: t)  # utility copy: inherits deliberately
+        return a, b, c, d
+"""
+
+
+def pytest_jit_missing_shardings_flags_bare_dispatch_jit(tmp_path):
+    findings = _lint(tmp_path, {"serve/server.py": _JIT_BAD})
+    hits = [f for f in findings if f.rule == "jit-missing-shardings"]
+    assert len(hits) == 1 and "_apply" in hits[0].message, findings
+
+
+def pytest_jit_missing_shardings_sanctioned_spellings(tmp_path):
+    findings = _lint(tmp_path, {"train/steps.py": _JIT_GOOD})
+    assert not [f for f in findings if f.rule == "jit-missing-shardings"]
+
+
+def pytest_jit_missing_shardings_decorator_forms(tmp_path):
+    src = """
+        from functools import partial
+
+        import jax
+
+        @jax.jit
+        def eval_step(params, batch):
+            return params
+
+        @jax.jit(donate_argnums=(0,))
+        def train_step(state, batch, rng):
+            return state
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update_step(state, batch):
+            return state
+
+        @partial(jax.jit, out_shardings=None)
+        def predict_step(params, batch):
+            return params  # declared contract (explicit inherit)
+
+        @jax.jit
+        def _copy_buffers(t):
+            return t  # not a dispatching name: exempt
+    """
+    findings = _lint(tmp_path, {"serve/server.py": src})
+    hits = [f for f in findings if f.rule == "jit-missing-shardings"]
+    assert len(hits) == 3, findings
+    flagged = {m.split("`")[1] for m in (h.message for h in hits) if "`" in m}
+    assert flagged == {"eval_step", "train_step", "update_step"}, hits
+
+
+def pytest_jit_missing_shardings_scoped_to_train_serve(tmp_path):
+    # benches build ad-hoc jits against whatever placement they measure
+    findings = _lint(tmp_path, {"benchmarks/bench.py": _JIT_BAD})
+    assert not [f for f in findings if f.rule == "jit-missing-shardings"]
+
+
+# ---- unknown-spec-axis ----------------------------------------------------
+
+
+def pytest_unknown_spec_axis_flags_typo(tmp_path):
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        from hydragnn_tpu.parallel.mesh import DATA_AXIS
+
+        def specs():
+            bad = P("dat")
+            ok = P(DATA_AXIS)
+            ok2 = P("data", "model")
+            return bad, ok, ok2
+    """
+    # applies INSIDE parallel/ too — a typo there is just as fatal
+    findings = _lint(tmp_path, {"parallel/rules.py": src})
+    hits = [f for f in findings if f.rule == "unknown-spec-axis"]
+    assert len(hits) == 1 and "'dat'" in hits[0].message, findings
+
+
+def pytest_unknown_spec_axis_flags_collective_typo(tmp_path):
+    src = """
+        import jax
+
+        def pooled(x):
+            return jax.lax.psum(x, "graf")
+    """
+    findings = _lint(tmp_path, {"models/base.py": src})
+    assert _rules_of(findings) == ["unknown-spec-axis"], findings
+
+
+# ---- device-put-without-sharding ------------------------------------------
+
+
+def pytest_device_put_without_sharding(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def place(batch, sharding):
+            bad = jax.device_put(batch)
+            good = jax.device_put(batch, sharding)
+            kw = jax.device_put(batch, device=sharding)
+            scalar = jax.device_put(0.0)
+            return bad, good, kw, scalar
+    """
+    findings = _lint(tmp_path, {"train/trainer.py": src})
+    hits = [f for f in findings if f.rule == "device-put-without-sharding"]
+    assert len(hits) == 1 and hits[0].line == 6, findings
+
+
+# ---- legacy-pmap-usage ----------------------------------------------------
+
+
+def pytest_legacy_pmap_flags_calls_and_decorators(tmp_path):
+    src = """
+        import jax
+
+        step = jax.pmap(lambda x: x)
+
+        @jax.pmap
+        def replicated(x):
+            return x
+
+        def mesh_way(fn, shardings):
+            return jax.jit(fn, in_shardings=shardings)
+    """
+    findings = _lint(tmp_path, {"train/old.py": src})
+    hits = [f for f in findings if f.rule == "legacy-pmap-usage"]
+    assert len(hits) == 2, findings
+
+
+# ---- reshape-across-sharded-dim -------------------------------------------
+
+_RESHAPE_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    def step(x, sharding):
+        x = jax.lax.with_sharding_constraint(x, sharding)
+        flat = x.reshape(-1, x.shape[-1])
+        also = jnp.reshape(x, (-1, 4))
+        return flat, also
+"""
+
+_RESHAPE_GOOD = """
+    import jax
+    import jax.numpy as jnp
+
+    def step(x, sharding):
+        x = jax.lax.with_sharding_constraint(x, sharding)
+        keep = x.reshape(x.shape[0], -1)  # leading (sharded) dim kept
+        return keep
+
+    def host_side(a):
+        return a.reshape(-1, 3)  # no sharding pinned in this function
+"""
+
+
+def pytest_reshape_across_sharded_dim_flags_leading_collapse(tmp_path):
+    findings = _lint(tmp_path, {"train/steps.py": _RESHAPE_BAD})
+    hits = [f for f in findings if f.rule == "reshape-across-sharded-dim"]
+    assert len(hits) == 2, findings
+
+
+def pytest_reshape_across_sharded_dim_good_patterns(tmp_path):
+    findings = _lint(tmp_path, {"train/steps.py": _RESHAPE_GOOD})
+    assert not [
+        f for f in findings if f.rule == "reshape-across-sharded-dim"
+    ], findings
+
+
+# ---- suppression tag ------------------------------------------------------
+
+
+def pytest_shardlint_suppression_tag(tmp_path):
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        def specs(mesh):
+            a = P("data")  # shardlint: disable=hardcoded-mesh-axis
+            # justification: doc example rendered into --help output
+            # shardlint: disable=hardcoded-mesh-axis
+            b = P("data")
+            c = P("data")
+            return a, b, c
+    """
+    findings = _lint(tmp_path, {"train/x.py": src})
+    hits = [f for f in findings if f.rule == "hardcoded-mesh-axis"]
+    assert len(hits) == 1, findings  # only c survives
+
+
+# ---- CLI: suite selection, list-rules, multi-suite output -----------------
+
+
+def pytest_suite_cli_selects_sharding(tmp_path, capsys):
+    bad = tmp_path / "train" / "t.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n\n"
+        "def f(x, acc=[]):\n"
+        "    return jax.device_put(x)\n"
+    )
+    assert lint_main([str(bad), "--suite=sharding", "--format=json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert sorted({f["rule"] for f in out["new"]}) == [
+        "device-put-without-sharding"
+    ]
+    # unknown suite is a usage error
+    assert lint_main([str(bad), "--suite=shardzzz"]) == 2
+    capsys.readouterr()
+
+
+def pytest_list_rules_groups_by_suite(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    # one header per suite, naming its gate
+    for header in (
+        "suite jax (jaxlint gate",
+        "suite concurrency (threadlint gate",
+        "suite sharding (shardlint gate",
+    ):
+        assert header in listed, listed
+    # every registered rule appears with its one-line doc
+    for name, rule in all_rules().items():
+        assert f"{name}: " in listed, name
+        assert rule.description.split("\n")[0][:40] in listed.replace(
+            "\n", " "
+        )
+    # --suite filters the catalog
+    assert lint_main(["--list-rules", "--suite=sharding"]) == 0
+    listed = capsys.readouterr().out
+    assert "suite sharding" in listed and "suite jax" not in listed
+    for name in SHARDING_RULES:
+        assert name in listed
+    # unknown suite is a usage error even for --list-rules
+    assert lint_main(["--list-rules", "--suite=nope"]) == 2
+    capsys.readouterr()
+
+
+def pytest_multi_suite_stats_and_github_in_one_invocation(tmp_path, capsys):
+    """One invocation with NO --suite must report findings from all
+    three suites: github annotations for each, and a --stats table
+    listing every suite's rules (satellite: report coverage across
+    suites, previously only exercised per-suite)."""
+    bad = tmp_path / "serve" / "s.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import queue\n"
+        "import jax\n\n"
+        "q = queue.Queue()\n\n"
+        "def f(x, acc=[]):\n"
+        "    return jax.device_put(x)\n"
+    )
+    assert lint_main([str(bad), "--format=github", "--stats"]) == 1
+    out = capsys.readouterr().out
+    # one annotation per finding, each naming its rule
+    for rule in (
+        "queue-misuse",  # concurrency
+        "mutable-default-arg",  # jax
+        "device-put-without-sharding",  # sharding
+    ):
+        assert f"title=jaxlint {rule}" in out, out
+    # the stats table covers all three suites' rules in one run
+    for rule in ("queue-misuse", "mutable-default-arg",
+                 "device-put-without-sharding", "hardcoded-mesh-axis"):
+        assert rule in out.split("new finding(s)")[-1], out
+    # and per-suite baselines compose in one gate each: the sharding
+    # baseline absorbs the sharding finding, the others still fail
+    bl = tmp_path / "bl.json"
+    assert (
+        lint_main(
+            [str(bad), "--suite=sharding", f"--write-baseline={bl}"]
+        )
+        == 0
+    )
+    capsys.readouterr()
+    assert (
+        lint_main(
+            [str(bad), "--suite=sharding", f"--baseline={bl}", "--stats"]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "device-put-without-sharding" in out  # baselined column
+    assert lint_main([str(bad), f"--baseline={bl}"]) == 1
+    capsys.readouterr()
+
+
+# ---- acceptance -----------------------------------------------------------
+
+
+def pytest_merged_tree_is_clean_for_sharding_suite():
+    """`--suite=sharding` exits 0 on the committed tree: every true
+    positive (hardcoded axes in steps/trainer/predict, the serve jit)
+    was FIXED, and the committed baseline is EMPTY."""
+    paths = [
+        os.path.join(REPO_ROOT, d)
+        for d in ("hydragnn_tpu", "examples", "benchmarks")
+    ]
+    result = analyze_paths(
+        paths, select=rules_in_suite("sharding"), root=REPO_ROOT
+    )
+    assert not result.findings, [
+        f"{f.path}:{f.line}: {f.rule}" for f in result.findings
+    ]
+    bl = json.load(open(os.path.join(REPO_ROOT, ".shardlint-baseline.json")))
+    assert bl["findings"] == []
+
+
+def pytest_reintroduction_fails_the_gate(tmp_path):
+    """The two regressions the gate exists for: a hardcoded axis crept
+    back into a step builder, and a serve-side jit added without its
+    sharding contract."""
+    steps = textwrap.dedent(
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def _sharding_plan(mesh, st):
+            return {"train_step": dict(
+                in_shardings=(st, NamedSharding(mesh, P("data")), None),
+            )}
+        """
+    )
+    serve = textwrap.dedent(
+        """
+        import jax
+
+        def build(model):
+            def _predict(params, batch):
+                return model.apply(params, batch)
+
+            return jax.jit(_predict)
+        """
+    )
+    findings = _lint(
+        tmp_path,
+        {"train/steps.py": steps, "serve/server.py": serve},
+        select=rules_in_suite("sharding"),
+    )
+    assert _rules_of(findings) == [
+        "hardcoded-mesh-axis",
+        "jit-missing-shardings",
+    ], findings

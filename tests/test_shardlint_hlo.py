@@ -1,0 +1,293 @@
+"""shardlint's compiled-HLO ratchet (analysis/hlo.py) + sharding sentinel.
+
+Text-level: fingerprint parsing (per-op collectives via the generalized
+``parallel/collectives.parse_collectives``, host-transfer and bf16->f32
+convert counting), budget save/load/check semantics (new collective,
+byte growth vs tolerance, host-transfer regression, stale notes), and
+the injection regression — a synthetic all-gather appended to a
+program's HLO MUST fail the check with a diff naming the program, the
+collective and the bytes.
+
+Runtime: :func:`~hydragnn_tpu.analysis.guards.sharding_sentinel` against
+really-placed arrays on the 8-device CPU mesh, and one compiled e2e —
+two real step programs fingerprinted, budgeted, checked clean, then
+caught regressing.
+"""
+
+import json
+
+import pytest
+
+from hydragnn_tpu.analysis.hlo import (
+    INJECTED_ALL_GATHER,
+    check_fingerprints,
+    count_bf16_upcasts,
+    count_host_transfers,
+    fingerprint_hlo,
+    load_budget,
+    prove_injection,
+    save_budget,
+)
+
+AXES = ("data", "model")
+SHAPE = (4, 2)
+
+# a hand-written optimized-HLO module exercising both replica-group
+# spellings, both convert spellings and a host transfer
+_HLO = """\
+HloModule canonical_test
+
+ENTRY main {
+  %p0 = f32[32,16]{1,0} parameter(0)
+  %h = bf16[8]{0} parameter(1)
+  %h2 = bf16[4]{0} parameter(2)
+  %tok = token[] after-all()
+  %ar = f32[32,16]{1,0} all-reduce(f32[32,16]{1,0} %p0), replica_groups={{0,2,4,6},{1,3,5,7}}, to_apply=%add
+  %ag = f32[64,16]{1,0} all-gather(f32[32,16]{1,0} %p0), replica_groups=[4,2]<=[8], dimensions={0}
+  %c1 = f32[8]{0} convert(bf16[8]{0} %h)
+  %c2 = f32[4]{0} convert(%h2)
+  %c3 = f32[4]{0} convert(%c2)
+  %of = token[] outfeed(f32[32,16]{1,0} %p0, token[] %tok)
+}
+"""
+
+
+def pytest_parse_collectives_per_op_records():
+    from hydragnn_tpu.parallel.collectives import (
+        collective_bytes_by_axis,
+        parse_collectives,
+    )
+
+    recs = parse_collectives(_HLO, AXES, SHAPE)
+    assert {(r["op"], r["axis"], r["bytes"]) for r in recs} == {
+        # {{0,2,4,6},{1,3,5,7}}: stride-2 groups on a (4,2) mesh = data
+        ("all-reduce", "data", 32 * 16 * 4.0),
+        # iota [4,2]<=[8]: consecutive pairs = model
+        ("all-gather", "model", 64 * 16 * 4.0),
+    }
+    # the summed view is the same records aggregated — the two APIs
+    # cannot drift
+    totals = collective_bytes_by_axis(_HLO, AXES, SHAPE)
+    assert totals == {"data": 2048.0, "model": 4096.0}
+
+
+def pytest_host_transfer_and_upcast_counting():
+    assert count_host_transfers(_HLO) == 1  # the outfeed
+    assert count_host_transfers("  %x = f32[2]{0} add(%a, %b)\n") == 0
+    # send marked as host transfer counts too
+    assert (
+        count_host_transfers(
+            '  %s = (f32[2],token[]) send(%a,%tok), is_host_transfer=true\n'
+        )
+        == 1
+    )
+    # c1 (inline bf16 operand) + c2 (resolved through the def table);
+    # c3 converts an f32 — not an upcast
+    assert count_bf16_upcasts(_HLO) == 2
+
+
+def pytest_fingerprint_aggregates_by_op_and_axis():
+    fp = fingerprint_hlo(_HLO + _HLO, AXES, SHAPE)  # duplicated module
+    assert fp["collectives"] == [
+        {"op": "all-gather", "axis": "model", "bytes": 2 * 4096},
+        {"op": "all-reduce", "axis": "data", "bytes": 2 * 2048},
+    ]
+    assert fp["host_transfers"] == 2
+    assert fp["bf16_to_f32_converts"] == 4
+
+
+def pytest_budget_roundtrip_and_version_gate(tmp_path):
+    fp = fingerprint_hlo(_HLO, AXES, SHAPE)
+    path = tmp_path / "budget.json"
+    save_budget(str(path), {"train_step": fp}, AXES, SHAPE, tolerance=0.5)
+    budget = load_budget(str(path))
+    assert budget["programs"]["train_step"] == fp
+    assert budget["mesh"] == {"axes": ["data", "model"], "shape": [4, 2]}
+    assert budget["tolerance"] == 0.5
+    bad = json.loads(path.read_text())
+    bad["version"] = 99
+    path.write_text(json.dumps(bad))
+    with pytest.raises(ValueError, match="version"):
+        load_budget(str(path))
+
+
+def pytest_check_semantics():
+    base = fingerprint_hlo(_HLO, AXES, SHAPE)
+    budget = {"train_step": base}
+
+    # identical -> clean
+    v, n = check_fingerprints({"train_step": base}, budget)
+    assert not v and not n
+
+    # byte growth within tolerance -> clean; beyond -> violation naming
+    # program, collective and bytes
+    grown = json.loads(json.dumps(base))
+    grown["collectives"][1]["bytes"] = int(2048 * 1.2)
+    v, _ = check_fingerprints({"train_step": grown}, budget, tolerance=0.25)
+    assert not v
+    grown["collectives"][1]["bytes"] = int(2048 * 1.3)
+    v, _ = check_fingerprints({"train_step": grown}, budget, tolerance=0.25)
+    assert len(v) == 1 and "train_step" in v[0] and "all-reduce@data" in v[0]
+    assert "2048" in v[0]
+
+    # a NEW (op, axis) pair -> violation even at zero byte growth
+    extra = json.loads(json.dumps(base))
+    extra["collectives"].append(
+        {"op": "reduce-scatter", "axis": "model", "bytes": 8}
+    )
+    v, _ = check_fingerprints({"train_step": extra}, budget)
+    assert len(v) == 1 and "NEW collective reduce-scatter" in v[0]
+
+    # host transfers / upcasts above budget -> violations
+    hot = json.loads(json.dumps(base))
+    hot["host_transfers"] += 1
+    hot["bf16_to_f32_converts"] += 1
+    v, _ = check_fingerprints({"train_step": hot}, budget)
+    assert len(v) == 2 and any("host-transfer" in x for x in v)
+
+    # an unbudgeted program -> violation; a stale budgeted one -> note
+    v, n = check_fingerprints({"new_prog": base}, budget)
+    assert any("new_prog" in x for x in v)
+    assert any("train_step" in x and "stale" in x for x in n)
+
+    # a disappeared collective is a tightening note, not a failure
+    shrunk = json.loads(json.dumps(base))
+    shrunk["collectives"] = shrunk["collectives"][:1]
+    v, n = check_fingerprints({"train_step": shrunk}, budget)
+    assert not v and len(n) == 1 and "no longer emitted" in n[0]
+
+
+def pytest_injection_is_caught():
+    """The reintroduction regression: an implicit-resharding all-gather
+    appended to a budgeted program MUST fail the check."""
+    base = fingerprint_hlo(_HLO, AXES, SHAPE)
+    budget = {"train_step": base}
+    doctored = fingerprint_hlo(_HLO + INJECTED_ALL_GATHER, AXES, SHAPE)
+    v, _ = check_fingerprints({"train_step": doctored}, budget)
+    assert v and "all-gather" in v[0] and "global" in v[0], v
+    # and the CLI's self-proof helper agrees
+    assert prove_injection(
+        {"train_step": _HLO}, budget, AXES, SHAPE, tolerance=0.25
+    )
+
+
+def pytest_jit_replicated_respects_explicit_contracts():
+    """jit_replicated must not override a caller-declared contract even
+    when the value is falsy (out_shardings=None is jit's explicit
+    'infer from inputs'; an empty PartitionSpec is a falsy tuple)."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hydragnn_tpu.parallel.mesh import (
+        jit_replicated,
+        make_mesh2d,
+        set_active_mesh,
+    )
+
+    mesh = make_mesh2d(2, 2)
+    set_active_mesh(mesh)
+    try:
+        x = jnp.zeros((8, 8))
+        # no contract given: replicated outputs on the active mesh
+        out = jit_replicated(lambda a: a * 2)(x)
+        assert tuple(out.sharding.spec) == ()
+        assert getattr(out.sharding, "mesh", None) is not None
+        # explicit falsy contracts are preserved, not overridden
+        out = jit_replicated(lambda a: a * 2, out_shardings=None)(x)
+        assert out.shape == (8, 8)
+        sharded = jit_replicated(
+            lambda a: a, out_shardings=NamedSharding(mesh, P("data"))
+        )(x)
+        assert tuple(sharded.sharding.spec) == ("data",)
+    finally:
+        set_active_mesh(None)
+
+
+# ---- sharding sentinel (runtime) ------------------------------------------
+
+
+def pytest_sharding_sentinel_checks_landed_placement():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hydragnn_tpu.analysis.guards import (
+        ShardingSentinel,
+        ShardingViolation,
+        sharding_sentinel,
+        tree_sharding_mismatches,
+    )
+    from hydragnn_tpu.parallel.mesh import make_mesh2d
+
+    mesh = make_mesh2d(2, 2)
+    sharded = jax.device_put(
+        jnp.zeros((8, 8)), NamedSharding(mesh, P("data"))
+    )
+    replicated = jax.device_put(jnp.zeros((8, 8)), NamedSharding(mesh, P()))
+    tree = {"w": sharded, "b": replicated}
+
+    # declared == landed -> clean (P('data') vs P('data', None) equal)
+    want = {
+        "w": NamedSharding(mesh, P("data", None)),
+        "b": NamedSharding(mesh, P()),
+    }
+    assert not tree_sharding_mismatches(tree, want)
+    ShardingSentinel().check(tree, want)
+
+    # a leaf landed off its declaration -> violation naming the path
+    want_bad = {"w": NamedSharding(mesh, P()), "b": P("model")}
+    mism = tree_sharding_mismatches(tree, want_bad)
+    assert len(mism) == 2
+    with pytest.raises(ShardingViolation, match=r"\['w'\]"):
+        ShardingSentinel().check(tree, want_bad, what="step outputs")
+
+    # deferred context form collects everything, raises at exit
+    with pytest.raises(ShardingViolation, match="2 output"):
+        with sharding_sentinel() as sen:
+            sen.check(tree, want_bad, defer=True)
+
+    # None expectations and host leaves are skipped
+    assert not tree_sharding_mismatches(
+        {"w": sharded, "host": 3.0}, {"w": None, "host": P("data")}
+    )
+
+
+# ---- compiled e2e (two real programs) -------------------------------------
+
+
+def pytest_compiled_programs_fingerprint_and_ratchet(tmp_path):
+    """Compile train_step + eval_step on a real 2x2 mesh, budget them,
+    check clean, then prove the injected all-gather fails — the CI
+    ratchet smoke in miniature."""
+    from hydragnn_tpu.analysis.hlo import (
+        compile_step_programs,
+        run_sharding_sentinel,
+    )
+    from hydragnn_tpu.parallel.mesh import active_mesh
+
+    prev = active_mesh()
+    texts, axes, shape, context = compile_step_programs(
+        (2, 2), programs=("train_step", "eval_step")
+    )
+    assert active_mesh() is prev  # harness mesh did not leak
+    assert axes == ("data", "model") and shape == (2, 2)
+    current = {
+        name: fingerprint_hlo(t, axes, shape) for name, t in texts.items()
+    }
+    # a 2-D-sharded train step MUST communicate: gradients all-reduce
+    # over data, activations/params over model
+    assert current["train_step"]["collectives"], current["train_step"]
+    axes_seen = {c["axis"] for c in current["train_step"]["collectives"]}
+    assert "data" in axes_seen and "model" in axes_seen
+    assert current["train_step"]["host_transfers"] == 0
+
+    path = tmp_path / "hlo.json"
+    save_budget(str(path), current, axes, shape)
+    budget = load_budget(str(path))
+    v, n = check_fingerprints(current, budget["programs"])
+    assert not v and not n
+    assert prove_injection(
+        texts, budget["programs"], axes, shape, tolerance=0.25
+    )
+    # the runtime half: outputs really land at the declared shardings
+    run_sharding_sentinel(context)
