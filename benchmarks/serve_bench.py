@@ -1,17 +1,30 @@
 """Serving A/B: micro-batched bucket-compiled server vs naive
-per-request predict (ISSUE 2 acceptance artifact).
+per-request predict (ISSUE 2 acceptance artifact), plus the fleet
+fault-schedule bench (ISSUE 15).
 
-Drives the in-process :class:`~hydragnn_tpu.serve.InferenceServer` with
-concurrent mixed-size requests (OC20-shaped log-normal sizes, the
-distribution the bucketed-layout work measured) and reports p50/p99
-request latency and sustained throughput against the naive baseline —
-one padded single-graph batch per request, dispatched synchronously,
-which is what calling the offline predict path per request would cost.
+Default mode drives the in-process
+:class:`~hydragnn_tpu.serve.InferenceServer` with concurrent mixed-size
+requests (OC20-shaped log-normal sizes, the distribution the
+bucketed-layout work measured) and reports p50/p99 request latency and
+sustained throughput against the naive baseline — one padded
+single-graph batch per request, dispatched synchronously, which is what
+calling the offline predict path per request would cost.
+
+``--fleet`` instead boots a real :class:`~hydragnn_tpu.serve.fleet.
+ServingFleet` (N replica processes + :class:`~hydragnn_tpu.serve.
+router.FleetRouter`) and replays a two-lane closed-loop traffic mix
+through a scripted fault schedule — steady state, SIGKILL a replica
+mid-load (kill->heal), zero-downtime hot-swap promote, promote of a
+CRC-corrupt candidate (loud rollback) — reporting per-phase p50/p99
+latency, SLO-miss rate, and measured availability.
 
 Usage: ``python benchmarks/serve_bench.py [--num=512] [--clients=8]
-[--buckets=3] [--batch=8] [--hidden=64] [--wait-ms=5]``
+[--buckets=3] [--batch=8] [--hidden=64] [--wait-ms=5]`` or
+``python benchmarks/serve_bench.py --fleet [--replicas=2] [--clients=4]
+[--phase-s=4] [--deadline-ms=2000] [--batch-frac=0.25] [--hidden=16]``
 
-Output: one JSON object per configuration (the BENCH_* line style).
+Output: one JSON object per configuration / fault-schedule phase (the
+BENCH_* line style, appendable).
 """
 
 import json
@@ -141,7 +154,238 @@ def run_naive(registry, plan, requests):
     }
 
 
+# ---- fleet fault-schedule bench (ISSUE 15) ---------------------------------
+
+
+def _fleet_artifacts(workdir, hidden, batch, buckets, seed=0):
+    """Bench-shaped inputs for the shared fleet artifact recipe
+    (tests/_fleet_smoke.py's build_artifacts): a small log-normal
+    graph-size mix and a GIN arch sized so per-bucket warmup stays
+    cheap on CPU."""
+    from tests._fleet_smoke import build_artifacts
+
+    from hydragnn_tpu.data.dataobj import GraphData
+
+    rng = np.random.default_rng(seed)
+    sizes = np.clip(
+        np.round(np.exp(rng.normal(np.log(12.0), 0.45, 48))), 5, 40
+    ).astype(int)
+    samples = []
+    for n in sizes:
+        g = GraphData(
+            x=rng.random((int(n), 1)).astype(np.float32),
+            pos=rng.random((int(n), 3)).astype(np.float32),
+        )
+        src = np.arange(n)
+        dst = (src + 1) % n
+        g.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        samples.append(g)
+
+    arch = _arch("GIN", hidden, 2, int(sizes.max()))
+    spec_path, ckdir, samples = build_artifacts(
+        workdir, arch, samples, batch=batch, buckets=buckets,
+        model_name="bench",
+    )
+    return spec_path, ckdir, arch, samples
+
+
+def _phase_row(phase, recs, deadline_s, **extra):
+    """One BENCH row from this phase's (latency, outcome, lane) recs."""
+    n = len(recs)
+    ok = [l for l, o, _ in recs if o == "ok"]
+    n_shed = sum(1 for _, o, _ in recs if o == "shed")
+    n_deadline = sum(1 for _, o, _ in recs if o == "deadline")
+    n_failed = sum(1 for _, o, _ in recs if o == "failed")
+    shed_by_lane = {}
+    for _, o, lane in recs:
+        if o == "shed":
+            shed_by_lane[lane] = shed_by_lane.get(lane, 0) + 1
+    row = {
+        "mode": "fleet",
+        "phase": phase,
+        "deadline_ms": round(deadline_s * 1e3, 1),
+        "submitted": n,
+        "ok": len(ok),
+        "shed": n_shed,
+        "deadline_missed": n_deadline,
+        "failed": n_failed,
+        "availability": round(len(ok) / max(n, 1), 4),
+        "slo_miss_rate": round(
+            n_deadline / max(len(ok) + n_deadline, 1), 4
+        ),
+        "shed_by_lane": shed_by_lane,
+    }
+    if ok:
+        row.update(_pcts(ok))
+    row.update(extra)
+    return row
+
+
+def run_fleet(replicas, clients, phase_s, deadline_s, batch_frac,
+              hidden, batch, buckets):
+    """Closed-loop load through a scripted fault schedule; one BENCH row
+    per phase: steady -> kill->heal -> promote -> corrupt-rollback."""
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    from hydragnn_tpu.serve import FleetRouter, ServerOverloaded
+    from hydragnn_tpu.serve.fleet import ServingFleet
+    from hydragnn_tpu.serve.server import DeadlineExceeded
+
+    workdir = tempfile.mkdtemp(prefix="hydragnn-fleet-bench-")
+    rows = []
+    try:
+        spec_path, ckdir, arch, samples = _fleet_artifacts(
+            workdir, hidden, batch, buckets
+        )
+        fleet = ServingFleet(
+            os.path.join(workdir, "coord"),
+            replicas,
+            spec_path=spec_path,
+            heartbeat_s=0.1,
+            lease_s=0.75,
+            poll_s=0.05,
+            log_dir=os.path.join(workdir, "log"),
+        )
+        t0 = time.perf_counter()
+        fleet.start(wait_serving=True, timeout=300)
+        boot_s = time.perf_counter() - t0
+        router = FleetRouter(
+            fleet.coord_dir,
+            lease_s=0.75,
+            scan_interval_s=0.1,
+            max_attempts=6,
+            retry_base_delay_s=0.05,
+        )
+
+        stop = threading.Event()
+        lock = threading.Lock()
+        phase = ["steady"]
+        recs = {}  # phase -> [(latency_s, outcome, lane)]
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                g = samples[int(rng.integers(0, len(samples)))]
+                lane = (
+                    "batch" if rng.random() < batch_frac else "default"
+                )
+                t1 = time.perf_counter()
+                try:
+                    router.route(g, lane=lane, deadline_s=deadline_s)
+                    outcome = "ok"
+                except ServerOverloaded:
+                    outcome = "shed"
+                except DeadlineExceeded:
+                    outcome = "deadline"
+                except Exception:
+                    outcome = "failed"
+                with lock:
+                    recs.setdefault(phase[0], []).append(
+                        (time.perf_counter() - t1, outcome, lane)
+                    )
+
+        threads = [
+            threading.Thread(target=client, args=(1000 + i,), daemon=True)
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+
+        try:
+            # phase 1: steady state
+            time.sleep(phase_s)
+
+            # phase 2: SIGKILL one replica mid-load -> detect + respawn
+            with lock:
+                phase[0] = "kill_heal"
+            victim = replicas - 1
+            os.kill(fleet.replica_pid(victim), signal.SIGKILL)
+            t_kill = time.perf_counter()
+            deadline = t_kill + 300
+            while time.perf_counter() < deadline:
+                if fleet.metrics.snapshot()["replica_respawns_total"] >= 1:
+                    break
+                time.sleep(0.05)
+            heal_s = time.perf_counter() - t_kill
+            time.sleep(phase_s)  # measure the healed fleet under load
+
+            # phase 3: zero-downtime hot-swap promote
+            with lock:
+                phase[0] = "promote"
+            t1 = time.perf_counter()
+            res = fleet.promote(
+                "cand", path=ckdir, arch_config=arch, name="bench",
+                timeout=300,
+            )
+            promote_s = time.perf_counter() - t1
+            time.sleep(phase_s)
+
+            # phase 4: corrupt candidate -> loud rollback, v2 keeps serving
+            with lock:
+                phase[0] = "rollback"
+            t1 = time.perf_counter()
+            res2 = fleet.promote(
+                "broken", path=ckdir, arch_config=arch, name="bench",
+                timeout=300,
+            )
+            rollback_s = time.perf_counter() - t1
+            time.sleep(phase_s)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            fleet.stop()
+
+        with lock:
+            per_phase = {p: list(v) for p, v in recs.items()}
+        snap = router.metrics.snapshot()
+        rows.append(_phase_row(
+            "steady", per_phase.get("steady", []), deadline_s,
+            replicas=replicas, clients=clients, boot_s=round(boot_s, 2),
+        ))
+        rows.append(_phase_row(
+            "kill_heal", per_phase.get("kill_heal", []), deadline_s,
+            heal_s=round(heal_s, 2),
+        ))
+        rows.append(_phase_row(
+            "promote", per_phase.get("promote", []), deadline_s,
+            promote_s=round(promote_s, 2),
+            promote_status=res["status"],
+        ))
+        rows.append(_phase_row(
+            "rollback", per_phase.get("rollback", []), deadline_s,
+            rollback_s=round(rollback_s, 2),
+            rollback_status=res2["status"],
+        ))
+        everything = [r for v in per_phase.values() for r in v]
+        rows.append(_phase_row(
+            "overall", everything, deadline_s,
+            slo_miss_ratio_router=snap["slo_miss_ratio"],
+        ))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return rows
+
+
 def main():
+    if _arg("fleet"):
+        for row in run_fleet(
+            replicas=int(_arg("replicas", 2)),
+            clients=int(_arg("clients", 4)),
+            phase_s=float(_arg("phase-s", 4)),
+            deadline_s=float(_arg("deadline-ms", 2000)) / 1e3,
+            batch_frac=float(_arg("batch-frac", 0.25)),
+            hidden=int(_arg("hidden", 16)),
+            batch=int(_arg("batch", 4)),
+            buckets=int(_arg("buckets", 2)),
+        ):
+            print(json.dumps(row), flush=True)
+        return
     num = int(_arg("num", 512))
     clients = int(_arg("clients", 8))
     buckets = int(_arg("buckets", 3))

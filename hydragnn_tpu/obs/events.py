@@ -96,6 +96,29 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # completed|failed|killed, reason names the failure/kill cause
     # (garbled_output, heartbeat_timeout, divergence, timeout, exit_<rc>)
     "hpo_trial": ("trial", "status"),
+    # serving fleet (serve/fleet.py): a replica's lease expired or its
+    # process died — the serving twin of host_lost (reason is
+    # exit|lease_expired|killed)
+    "replica_lost": ("replica", "reason"),
+    # serving fleet: the supervisor respawned a lost replica and its new
+    # incarnation reported serving; downtime_s spans detection -> first
+    # serving lease (the serving twin of world_resize's recovery_s)
+    "replica_respawned": ("replica", "downtime_s"),
+    # hot-swap (serve/fleet.py + serve/registry.py): a candidate version
+    # was warmed on every live replica (per-bucket, compile-counter
+    # verified) and atomically promoted to serve version-less requests
+    "model_promoted": ("name", "version"),
+    # hot-swap: a candidate was rejected (CRC/strict-load failure, warmup
+    # failure, ack timeout) — the old version never stopped serving
+    "model_rollback": ("name", "reason"),
+    # serving fleet: live replica count dropped below target (the
+    # degradation ladder's trigger — the router sheds low-priority lanes
+    # while this holds)
+    "fleet_degraded": ("live", "target"),
+    # closed-loop load generator (benchmarks/serve_bench.py --fleet,
+    # tests/_fleet_smoke.py): one measured traffic window — availability
+    # = terminally-succeeded / submitted logical requests
+    "fleet_report": ("submitted", "succeeded", "availability"),
     # goodput ledger (obs/ledger.py): one per epoch window — `seconds`
     # and `fractions` map every CATEGORIES entry (compute/data_stall/
     # collective/checkpoint/compile/guard_recovery/eval/other) to its

@@ -278,6 +278,14 @@ class ServeMetrics:
         with self._lock:
             self.shed_total += 1
 
+    def on_response(self, n: int = 1):
+        """A request reached a successful terminal response without a
+        packed batch to account it (the fleet router's path; the
+        in-process server counts responses per batch via
+        :meth:`on_batch`)."""
+        with self._lock:
+            self.responses_total += n
+
     def on_timeout(self, n: int = 1):
         # an in-queue expiry IS a missed deadline (only deadline-carrying
         # requests can time out)

@@ -26,9 +26,19 @@ from hydragnn_tpu.serve.buckets import (
     plan_from_layout,
     plan_from_samples,
 )
+from hydragnn_tpu.serve.fleet import (
+    FleetMetrics,
+    ReplicaServer,
+    ServingFleet,
+)
 from hydragnn_tpu.serve.http import ObservabilityServer
 from hydragnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
 from hydragnn_tpu.serve.registry import ModelEntry, ModelRegistry
+from hydragnn_tpu.serve.router import (
+    FleetRouter,
+    NoLiveReplica,
+    RetryBudget,
+)
 from hydragnn_tpu.serve.server import (
     DeadlineExceeded,
     InferenceServer,
@@ -39,16 +49,22 @@ from hydragnn_tpu.serve.server import (
 __all__ = [
     "BucketCapacity",
     "DeadlineExceeded",
+    "FleetMetrics",
+    "FleetRouter",
     "GraphTooLarge",
     "InferenceServer",
     "LatencyHistogram",
     "ModelEntry",
     "ModelRegistry",
+    "NoLiveReplica",
     "ObservabilityServer",
+    "ReplicaServer",
+    "RetryBudget",
     "ServeFuture",
     "ServeMetrics",
     "ServerOverloaded",
     "ServingBucketPlan",
+    "ServingFleet",
     "plan_from_layout",
     "plan_from_samples",
 ]

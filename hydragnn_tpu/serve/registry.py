@@ -12,6 +12,21 @@ Multiple models serve side by side (one entry per name); re-registering
 a name bumps its version and new requests pick up the new entry at the
 next micro-batch — in-flight batches keep the entry they were packed
 with (each batch captures the frozen entry, not the name).
+
+**Promote / rollback** (the hot-swap contract, ``serve/fleet.py``):
+:meth:`ModelRegistry.promote` pins which version answers version-less
+``get(name)`` calls; until the first promote, the latest registered
+version serves (the historical behavior, unchanged).
+:meth:`ModelRegistry.promote_checkpoint` is the atomic
+load-register-promote: a candidate whose checkpoint fails CRC or the
+strict v2 load raises BEFORE anything is registered or promoted — the
+old version keeps serving and the registry holds no half-registered
+state. Double-promoting the already-active version is an idempotent
+no-op (no history entry, so a later :meth:`rollback` still reverts to
+the genuinely previous version). :meth:`rollback` re-activates the
+version that was serving before the last effective promote; both record
+nothing but the activation — entries stay frozen and registered, so a
+rolled-back candidate remains inspectable.
 """
 
 import dataclasses
@@ -45,6 +60,9 @@ class ModelRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: Dict[str, List[ModelEntry]] = {}
+        # activation history per name: [..., previous, ACTIVE]. Empty =
+        # never explicitly promoted -> latest registered version serves.
+        self._active: Dict[str, List[int]] = {}
 
     def register(
         self,
@@ -111,34 +129,137 @@ class ModelRegistry:
         )
 
     def get(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        """The entry that should serve ``name``: the explicit ``version``
+        when given, else the ACTIVE version (last promote; latest
+        registered when nothing was ever promoted)."""
         with self._lock:
             history = self._entries.get(name)
             if not history:
                 raise KeyError(f"no model registered under {name!r}")
             if version is None:
-                return history[-1]
+                stack = self._active.get(name)
+                version = stack[-1] if stack else history[-1].version
             for entry in history:
                 if entry.version == version:
                     return entry
             raise KeyError(f"model {name!r} has no version {version}")
+
+    def active_version(self, name: str) -> int:
+        """The version a version-less :meth:`get` would serve right now."""
+        return self.get(name).version
+
+    def promote(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        """Activate ``version`` of ``name`` (default: latest registered)
+        for version-less :meth:`get` calls. In-flight micro-batches keep
+        the entry they were packed with, so the swap lands exactly at a
+        batch boundary — no response is ever computed by a mix of
+        versions within one batch. Promoting the already-active version
+        is an idempotent no-op (no activation-history entry). Raises
+        ``KeyError`` (registry unchanged) for unknown names/versions."""
+        with self._lock:
+            history = self._entries.get(name)
+            if not history:
+                raise KeyError(f"no model registered under {name!r}")
+            if version is None:
+                version = history[-1].version
+            entry = next(
+                (e for e in history if e.version == version), None
+            )
+            if entry is None:
+                raise KeyError(f"model {name!r} has no version {version}")
+            stack = self._active.setdefault(name, [])
+            current = stack[-1] if stack else history[-1].version
+            if current == version and stack:
+                return entry  # double-promote: idempotent
+            if not stack:
+                # seed with the implicit active so the first rollback
+                # has a "before" to return to
+                stack.append(current)
+            if stack[-1] != version:
+                stack.append(version)
+            return entry
+
+    def rollback(self, name: str) -> ModelEntry:
+        """Re-activate the version that served before the last effective
+        promote. Raises ``ValueError`` when there is nothing to roll back
+        to (never promoted, or already rolled back to the original)."""
+        with self._lock:
+            stack = self._active.get(name)
+            if not stack or len(stack) < 2:
+                raise ValueError(
+                    f"model {name!r} has no previous promoted version to "
+                    "roll back to"
+                )
+            stack.pop()
+            version = stack[-1]
+            history = self._entries.get(name, ())
+            entry = next(
+                (e for e in history if e.version == version), None
+            )
+            if entry is None:  # unreachable: entries are never removed
+                raise KeyError(f"model {name!r} has no version {version}")
+            return entry
+
+    def promote_checkpoint(
+        self,
+        checkpoint_name: str,
+        arch_config: Optional[dict] = None,
+        path: str = "./logs/",
+        name: Optional[str] = None,
+        verbosity: int = 0,
+    ) -> ModelEntry:
+        """Atomic load + register + promote of a candidate checkpoint.
+
+        The strict v2 load (CRC verification, no rolling fallback) runs
+        FIRST: a corrupt or truncated candidate raises here with the
+        registry untouched — no version is registered, the activation
+        history does not move, and the old version keeps serving every
+        request. Only a fully loaded candidate is registered (as the next
+        version of ``name``) and promoted, as one registry transition."""
+        serving_name = name or checkpoint_name
+        try:
+            # pin the CURRENT ACTIVE version first (not the latest
+            # registered — a previously rolled-back candidate may be
+            # newer): registering the candidate must not implicitly flip
+            # serving onto it, and a later rollback() must have the
+            # genuine pre-promote version to return to
+            self.promote(serving_name, self.active_version(serving_name))
+        except KeyError:
+            pass  # first registration under this name: nothing to pin
+        entry = self.load_checkpoint(
+            checkpoint_name,
+            arch_config=arch_config,
+            path=path,
+            name=name,
+            verbosity=verbosity,
+        )
+        return self.promote(entry.name, entry.version)
 
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._entries)
 
     def describe(self) -> Dict[str, Dict]:
-        """Registry summary for ``/healthz``."""
+        """Registry summary for ``/healthz`` — ``version`` is the ACTIVE
+        (serving) version, ``latest`` the newest registered one; they
+        differ only mid-hot-swap or after a rollback."""
         with self._lock:
-            return {
-                name: {
-                    "version": history[-1].version,
+            out = {}
+            for name, history in self._entries.items():
+                stack = self._active.get(name)
+                active = stack[-1] if stack else history[-1].version
+                serving = next(
+                    e for e in history if e.version == active
+                )
+                out[name] = {
+                    "version": active,
+                    "latest": history[-1].version,
                     "versions": len(history),
-                    "output_type": list(history[-1].output_type),
-                    "output_dim": list(history[-1].output_dim),
-                    "source": history[-1].source,
+                    "output_type": list(serving.output_type),
+                    "output_dim": list(serving.output_dim),
+                    "source": serving.source,
                 }
-                for name, history in self._entries.items()
-            }
+            return out
 
     def __len__(self):
         with self._lock:

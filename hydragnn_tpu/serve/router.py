@@ -1,0 +1,416 @@
+"""Fleet front-end: deadline-aware routing, budgeted retry, priority lanes.
+
+One :class:`FleetRouter` per client process turns "a pool of replica
+processes behind a coordination directory" into the single-endpoint
+surface callers already know from
+:class:`~hydragnn_tpu.serve.server.InferenceServer`: ``route()`` a graph,
+get per-head outputs back, or one of the SAME exceptions the in-process
+server raises (``ServerOverloaded`` with a retry-after hint,
+``GraphTooLarge``, ``DeadlineExceeded``) — the degradation contract is
+spelled identically whether the shed happened at a replica's queue or at
+the router's admission gate.
+
+Routing rules, in the order they bite:
+
+- **Discovery is the lease scan**: live replicas are the ones holding a
+  fresh ``replicas/replica-<k>.json`` lease in ``serving`` state (the
+  same files the fleet supervisor heals from — the router needs no
+  channel to the supervisor). Scans are cached for one heartbeat
+  interval; the supervisor's ``fleet.json`` supplies the target count
+  for the degradation check.
+- **Admission control with priority lanes**: every request names a lane
+  (default ``"default"``); each lane has a priority (0 = most
+  important). While the fleet is degraded (live < target), lanes with
+  priority >= ``shed_priority_when_degraded`` are rejected IMMEDIATELY
+  with ``ServerOverloaded`` + retry-after — load-shedding the
+  background traffic is what keeps the interactive lane's latency
+  bounded while the supervisor heals. With ZERO live replicas
+  everything sheds (never an unbounded client-side queue).
+- **Deadline-aware retry with jittered backoff, budgeted**: a replica
+  attempt that fails for a RETRYABLE reason (connection refused/reset —
+  the replica died; 503 — it shed or is draining) is retried against
+  the next replica after the shared ``utils/retry.py`` backoff curve,
+  as long as (a) the request's deadline has room for another attempt
+  and (b) the fleet-wide :class:`RetryBudget` grants a token. The
+  budget earns a fraction of a token per SUCCESS (default 0.1) up to a
+  small reserve: under total outage retries self-extinguish at ~10% of
+  the success rate instead of amplifying the overload into a retry
+  storm. Non-retryable failures (400/413/500 — the request itself is
+  bad or genuinely failed) propagate immediately.
+- **SLO accounting**: the router owns a
+  :class:`~hydragnn_tpu.serve.metrics.ServeMetrics` — every
+  deadline-carrying request lands in the PR 11 deadline series
+  (``deadline_met/missed``, ``slo_miss_ratio``) measured END TO END
+  (queueing + retries + transport), plus the ``hydragnn_fleet_*``
+  per-lane shed/retry gauges from :class:`~hydragnn_tpu.serve.fleet.
+  FleetMetrics`.
+"""
+
+import glob
+import http.client
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from hydragnn_tpu import coord
+from hydragnn_tpu.serve.fleet import (
+    DEFAULT_HEARTBEAT_S,
+    DEFAULT_LEASE_S,
+    REPLICA,
+    FleetMetrics,
+    encode_graph,
+    lease_serving,
+)
+from hydragnn_tpu.serve.metrics import ServeMetrics
+from hydragnn_tpu.serve.server import DeadlineExceeded, ServerOverloaded
+from hydragnn_tpu.utils.retry import backoff_delay
+
+
+class NoLiveReplica(ConnectionError):
+    """Every routed attempt failed and no retry was possible."""
+
+
+class RetryBudget:
+    """Token bucket that caps fleet-wide retries to a fraction of the
+    success rate (the classic retry-budget rule: a retry storm must not
+    amplify an outage). Starts with ``reserve`` tokens so the FIRST
+    failures of a healthy fleet can retry immediately; each success
+    earns ``ratio`` tokens back, capped at the reserve."""
+
+    def __init__(self, ratio: float = 0.1, reserve: float = 10.0):
+        if ratio < 0 or reserve <= 0:
+            raise ValueError("ratio must be >= 0 and reserve > 0")
+        self.ratio = float(ratio)
+        self.reserve = float(reserve)
+        self._lock = threading.Lock()
+        self._tokens = float(reserve)
+
+    def on_success(self):
+        with self._lock:
+            self._tokens = min(self._tokens + self.ratio, self.reserve)
+
+    def try_acquire(self) -> bool:
+        """Take one retry token; False = budget exhausted, fail the
+        request rather than add load."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+class FleetRouter:
+    """Route requests to the live replicas of one coordination dir."""
+
+    def __init__(
+        self,
+        coord_dir: str,
+        target_replicas: Optional[int] = None,
+        lanes: Optional[Dict[str, int]] = None,
+        shed_priority_when_degraded: int = 1,
+        lease_s: float = DEFAULT_LEASE_S,
+        scan_interval_s: float = DEFAULT_HEARTBEAT_S,
+        retry_budget: Optional[RetryBudget] = None,
+        retry_base_delay_s: float = 0.02,
+        max_attempts: int = 4,
+        default_deadline_s: Optional[float] = None,
+        connect_timeout_s: float = 5.0,
+    ):
+        self.coord_dir = coord_dir
+        self._target = target_replicas
+        self.lanes = dict(lanes or {"default": 0, "batch": 1})
+        self.shed_priority_when_degraded = int(shed_priority_when_degraded)
+        self.lease_s = float(lease_s)
+        self.scan_interval_s = float(scan_interval_s)
+        self.budget = retry_budget or RetryBudget()
+        self.retry_base_delay_s = float(retry_base_delay_s)
+        self.max_attempts = max(int(max_attempts), 1)
+        self.default_deadline_s = default_deadline_s
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.metrics = ServeMetrics()  # the PR 11 deadline/SLO series
+        self.fleet_metrics = FleetMetrics()
+        self._lock = threading.Lock()  # guards the scan cache + cursor
+        self._scan_ts = 0.0
+        self._cached: List[Tuple[int, int]] = []  # [(replica, port)]
+        self._target_ts = float("-inf")  # fleet.json cache, same TTL
+        self._target_cached: Optional[int] = None
+        self._rr = 0  # round-robin cursor
+
+    # ---- discovery -----------------------------------------------------
+    def _scan(self, now: Optional[float] = None) -> List[Tuple[int, int]]:
+        """Fresh (replica, port) list from the lease files."""
+        now = time.time() if now is None else now
+        live = []
+        pattern = os.path.join(
+            self.coord_dir, f"{REPLICA}s", f"{REPLICA}-*.json"
+        )
+        for path in sorted(glob.glob(pattern)):
+            m = re.search(rf"{REPLICA}-(\d+)\.json$", path)
+            if not m:
+                continue
+            lease = coord.read_json(path)
+            if not lease_serving(lease, self.lease_s, now):
+                continue
+            if not lease.get("port"):
+                continue
+            live.append((int(m.group(1)), int(lease["port"])))
+        return live
+
+    def live_replicas(self) -> List[Tuple[int, int]]:
+        """Live (replica, port) pairs, cached for one scan interval."""
+        now = time.time()
+        with self._lock:
+            if now - self._scan_ts <= self.scan_interval_s:
+                return list(self._cached)
+        live = self._scan(now)
+        with self._lock:
+            self._cached = live
+            self._scan_ts = now
+            return list(self._cached)
+
+    def _invalidate(self, replica: int):
+        """Drop a replica we just watched fail from the cache — the next
+        pick must not hand the same dead port out for a whole interval."""
+        with self._lock:
+            self._cached = [
+                (rid, port) for rid, port in self._cached if rid != replica
+            ]
+
+    def target_replicas(self) -> Optional[int]:
+        if self._target is not None:
+            return self._target
+        # cached like the lease scan: admission runs on every request
+        # and must not pay a fleet.json read (a network round trip on a
+        # shared coordination dir) per routed graph
+        now = time.time()
+        with self._lock:
+            if now - self._target_ts <= self.scan_interval_s:
+                return self._target_cached
+        status = coord.read_json(
+            os.path.join(self.coord_dir, "fleet.json")
+        )
+        target = None if status is None else int(status.get("target", 0))
+        with self._lock:
+            self._target_cached, self._target_ts = target, now
+        return target
+
+    def degraded(self) -> bool:
+        target = self.target_replicas()
+        if not target:
+            return False
+        return len(self.live_replicas()) < target
+
+    # ---- admission -----------------------------------------------------
+    def _admit(self, lane: str):
+        if lane not in self.lanes:
+            raise ValueError(
+                f"unknown lane {lane!r}; configured: {sorted(self.lanes)}"
+            )
+        live = self.live_replicas()
+        if not live:
+            # nothing to route to: shed EVERYTHING with a hint scaled to
+            # the heal cadence (supervisor respawn ~ boots + warms)
+            self.metrics.on_shed()
+            self.fleet_metrics.on_lane_shed(lane)
+            raise ServerOverloaded(retry_after_s=max(self.lease_s, 0.1))
+        if (
+            self.degraded()
+            and self.lanes[lane] >= self.shed_priority_when_degraded
+        ):
+            self.metrics.on_shed()
+            self.fleet_metrics.on_lane_shed(lane)
+            raise ServerOverloaded(
+                retry_after_s=max(self.scan_interval_s * 4, 0.1)
+            )
+        return live
+
+    def _pick(self, live: List[Tuple[int, int]],
+              exclude: set) -> Optional[Tuple[int, int]]:
+        candidates = [r for r in live if r[0] not in exclude] or live
+        if not candidates:
+            return None
+        with self._lock:
+            self._rr += 1
+            return candidates[self._rr % len(candidates)]
+
+    # ---- routing -------------------------------------------------------
+    def route(
+        self,
+        graph,
+        model: Optional[str] = None,
+        lane: str = "default",
+        deadline_s: Optional[float] = None,
+        raw: bool = False,
+    ):
+        """Route one graph; returns the per-head numpy outputs (or the
+        full response dict with ``raw=True`` — version/batch_seq/replica
+        included, the hot-swap tests' view). Raises
+        :class:`ServerOverloaded` (shed — admission gate, zero live
+        replicas, or every live replica shedding),
+        :class:`DeadlineExceeded`, or :class:`NoLiveReplica` (attempts
+        exhausted on non-shed failures)."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        t0 = time.monotonic()
+        deadline = None if deadline_s is None else t0 + deadline_s
+        live = self._admit(lane)  # ServerOverloaded propagates
+        self.metrics.on_submit()
+        self.fleet_metrics.registry.inc("requests_routed_total")
+        tried: set = set()
+        shed_hint: Optional[float] = None
+        last_error: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            if attempt > 0:
+                # retry gate: the deadline must have room for backoff +
+                # an attempt BEFORE a budget token is taken — a request
+                # that cannot retry anyway must not drain the budget
+                # other requests need; then the budget (a storm must
+                # die here)
+                delay = backoff_delay(attempt - 1, self.retry_base_delay_s)
+                if deadline is not None and (
+                    time.monotonic() + delay >= deadline
+                ):
+                    break
+                if not self.budget.try_acquire():
+                    break
+                time.sleep(delay)
+                self.metrics_on_retry(lane)
+                live = self.live_replicas()
+                if not live:
+                    last_error = NoLiveReplica("no live replica to retry")
+                    break
+            pick = self._pick(live, tried)
+            if pick is None:
+                last_error = NoLiveReplica("no live replica")
+                break
+            rid, port = pick
+            tried.add(rid)
+            remaining = (
+                None if deadline is None
+                else max(deadline - time.monotonic(), 0.0)
+            )
+            if remaining is not None and remaining <= 0.0:
+                self.metrics.on_timeout()
+                raise DeadlineExceeded(
+                    f"deadline expired after {time.monotonic() - t0:.3f}s "
+                    f"({attempt} attempt(s))"
+                )
+            try:
+                status, body = self._post(rid, port, graph, model,
+                                          remaining)
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, OSError, TimeoutError) as e:
+                # transport failure: the replica just died or is being
+                # respawned — retryable (HTTPException covers a kill
+                # landing mid-response: IncompleteRead/BadStatusLine)
+                self._invalidate(rid)
+                self.fleet_metrics.registry.inc("replica_errors_total")
+                last_error = e
+                continue
+            if status == 200:
+                now = time.monotonic()
+                self.budget.on_success()
+                self.metrics.on_response()
+                self.metrics.on_response_latency(now - t0)
+                if deadline is not None:
+                    self.metrics.on_deadline(now <= deadline)
+                if raw:
+                    return body
+                return [np.asarray(h) for h in body["heads"]]
+            if status == 503:
+                # the replica shed (queue full / draining): retryable,
+                # and its hint rides along if we end up giving up
+                shed_hint = float(body.get("retry_after_s", 0.05))
+                self.fleet_metrics.registry.inc("replica_errors_total")
+                last_error = ServerOverloaded(retry_after_s=shed_hint)
+                continue
+            if status == 504:
+                if deadline is not None:
+                    self.metrics.on_timeout()
+                else:
+                    # the replica's own wait cap expired on a request
+                    # that carried no deadline: a serving failure, not
+                    # an SLO outcome (the deadline series must only see
+                    # deadline-carrying requests)
+                    self.metrics.on_error()
+                raise DeadlineExceeded(
+                    body.get("error", "replica-side deadline expiry")
+                )
+            # 400/413/500: the request is bad or genuinely failed —
+            # retrying cannot help, propagate as a loud failure
+            self.metrics.on_error()
+            raise RuntimeError(
+                f"replica {rid} answered {status}: "
+                f"{body.get('error', 'unknown error')}"
+            )
+        if deadline is not None and time.monotonic() >= deadline:
+            self.metrics.on_timeout()
+            raise DeadlineExceeded(
+                f"deadline expired after {time.monotonic() - t0:.3f}s "
+                f"({len(tried)} replica(s) tried)"
+            )
+        if shed_hint is not None:
+            # every reachable replica shed: the caller sees retry-after
+            # exactly like the in-process queue-full path. This request
+            # was already counted in requests_total at admission, so its
+            # terminal outcome lands in errors_total — ServeMetrics'
+            # shed_total is reserved for never-accepted rejections (the
+            # admission gate above); the per-lane fleet gauge still
+            # classifies it as a shed
+            self.metrics.on_error()
+            self.fleet_metrics.on_lane_shed(lane)
+            raise ServerOverloaded(retry_after_s=shed_hint)
+        self.metrics.on_error()
+        raise NoLiveReplica(
+            f"all {len(tried)} attempted replica(s) failed"
+            + (f": {last_error}" if last_error else "")
+        )
+
+    def metrics_on_retry(self, lane: str):
+        self.fleet_metrics.registry.inc("retries_total")
+        self.fleet_metrics.on_lane_retry(lane)
+
+    def _post(self, rid: int, port: int, graph, model: Optional[str],
+              deadline_s: Optional[float]) -> Tuple[int, Dict]:
+        payload = {"graph": encode_graph(graph)}
+        if model is not None:
+            payload["model"] = model
+        if deadline_s is not None:
+            payload["deadline_s"] = deadline_s
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        # urllib's timeout bounds the WHOLE request, not just the
+        # connect: a deadline-less request must be allowed a slow
+        # predict (the replica's own wait cap answers 504 within 60s),
+        # not be misread as replica death at connect_timeout_s
+        timeout = (
+            max(self.connect_timeout_s, 120.0)
+            if deadline_s is None
+            else max(min(deadline_s + 1.0, 120.0), 0.05)
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except ValueError:
+                body = {}
+            return e.code, body

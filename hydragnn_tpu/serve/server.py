@@ -56,13 +56,21 @@ class DeadlineExceeded(TimeoutError):
 
 
 class ServeFuture:
-    """Minimal future resolved by the batcher thread."""
+    """Minimal future resolved by the batcher thread.
+
+    ``version`` and ``batch_seq`` are stamped by the dispatching batch
+    just before the result lands: which model version computed the
+    answer and which micro-batch carried it — the hot-swap tests assert
+    every response in one batch_seq shares one version (the registry's
+    batch-boundary swap contract made observable)."""
 
     def __init__(self):
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result = None
         self._error: Optional[BaseException] = None
+        self.version: Optional[int] = None
+        self.batch_seq: Optional[int] = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -153,6 +161,7 @@ class InferenceServer:
         self._seen_shapes: Set[Tuple] = set()
         self._thread: Optional[threading.Thread] = None
         self._running = threading.Event()
+        self._batch_seq = 0  # mutated only by the dispatching thread
         # guards the stopped-check + enqueue pair in submit() against
         # stop(): without it a submit could pass the check, then enqueue
         # AFTER stop()'s sweep — a request no one would ever answer
@@ -276,18 +285,87 @@ class InferenceServer:
         """Compile every (model, bucket) executable before traffic: one
         dispatch of the plan's warmup sample per bucket per model. After
         this, any request the plan admits reuses a cached program."""
+        for name in self.registry.names():
+            self.warmup_entry(self.registry.get(name))
+        self._warm = True
+
+    def warmup_entry(self, entry: ModelEntry):
+        """Warm ONE model version across every bucket by direct dispatch
+        (startup path — the batcher is not running yet). For warming a
+        candidate version on a LIVE server use :meth:`warm_version`,
+        which routes through the batcher so traffic keeps being served
+        between warmup batches."""
+        sample = self._warmup_sample()
+        for b in range(self.plan.num_buckets):
+            batch, _ = self.plan.pack([sample], b)
+            self._dispatch_compiled(entry, b, batch)
+
+    def _warmup_sample(self):
         sample = self.plan.warmup_sample
         if sample is None:
             raise ValueError(
                 "plan has no warmup_sample; pass one (a small GraphData) "
                 "or build the plan via plan_from_samples/plan_from_layout"
             )
-        for name in self.registry.names():
-            entry = self.registry.get(name)
+        return sample
+
+    def warm_version(
+        self,
+        name: str,
+        version: Optional[int] = None,
+        timeout: float = 120.0,
+        passes: int = 2,
+    ) -> Dict[str, int]:
+        """Warm a (usually freshly registered) model version THROUGH the
+        running batcher: one pinned-bucket warmup request per bucket per
+        pass, interleaving with live traffic — the zero-downtime half of
+        a hot-swap promote. Returns per-pass compile-counter deltas so
+        the caller can verify the warm took: pass 1 must compile exactly
+        ``num_buckets`` novel shapes, every later pass ZERO (a non-zero
+        later pass means the candidate's executables did not cache — a
+        promote gated on this never swaps onto a version that would
+        recompile under traffic). Requires a started server."""
+        if not self._running.is_set():
+            raise RuntimeError(
+                "warm_version needs a running batcher; call start() first "
+                "(startup warmup uses warmup_entry directly)"
+            )
+        entry = self.registry.get(name, version)
+        sample = self._warmup_sample()
+        deltas: List[int] = []
+        for _ in range(max(int(passes), 1)):
+            before = self.metrics.compiles_total
+            futures = []
             for b in range(self.plan.num_buckets):
-                batch, _ = self.plan.pack([sample], b)
-                self._dispatch_compiled(entry, b, batch)
-        self._warm = True
+                futures.append(self._submit_pinned(sample, entry, b))
+            for fut in futures:
+                fut.result(timeout)  # dispatch errors propagate loudly
+            deltas.append(self.metrics.compiles_total - before)
+        return {
+            "buckets": self.plan.num_buckets,
+            "first_pass_compiles": deltas[0],
+            "later_pass_compiles": sum(deltas[1:]),
+            "verified": (
+                deltas[0] == self.plan.num_buckets
+                and sum(deltas[1:]) == 0
+            ),
+        }
+
+    def _submit_pinned(self, graph, entry: ModelEntry,
+                       bucket: int) -> ServeFuture:
+        """Enqueue one request pinned to an explicit (entry, bucket) —
+        the warm-version path. Same atomic stopped-check/enqueue as
+        submit(); counted in the normal metrics lifecycle so the
+        accepted == terminal invariant holds for warmup traffic too."""
+        sizes = self.plan.request_sizes(graph)
+        req = _Request(graph, entry, bucket, sizes, None, fallback=False)
+        with self._submit_lock:
+            if self._stopped:
+                raise RuntimeError("server stopped; submits are refused")
+            self._queue.put_nowait(req)  # queue.Full propagates: a warm
+            # attempt must not silently evaporate under pressure
+        self.metrics.on_submit()
+        return req.future
 
     def is_warm(self) -> bool:
         return self._warm
@@ -487,6 +565,8 @@ class InferenceServer:
                 req.future.set_exception(e)
             return
         now = time.monotonic()
+        self._batch_seq += 1
+        batch_seq = self._batch_seq
         for req, (g, off, n) in zip(requests, coords):
             per_head = []
             for ihead, kind in enumerate(entry.output_type):
@@ -494,6 +574,10 @@ class InferenceServer:
                     per_head.append(outputs[ihead][g])
                 else:
                     per_head.append(outputs[ihead][off: off + n])
+            # stamped before resolution: a waiter that wakes on
+            # set_result reads a consistent (version, batch) pair
+            req.future.version = entry.version
+            req.future.batch_seq = batch_seq
             req.future.set_result(per_head)
             self.metrics.on_response_latency(now - req.enqueued_at)
             # SLO accounting: a deadline-carrying request that still got

@@ -74,10 +74,26 @@ import json
 import os
 import re
 import subprocess
-import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
+from hydragnn_tpu.coord import (  # noqa: F401  (re-exported API — the
+    # lease/heartbeat/tombstone/watchdog core was extracted to
+    # hydragnn_tpu.coord so the serving fleet (serve/fleet.py) shares one
+    # implementation; this module keeps the historical names alive)
+    DEFAULT_HEARTBEAT_S,
+    DEFAULT_LEASE_S,
+    Heartbeat,
+    dead_members,
+    heartbeat_age,
+    read_tombstone,
+    write_tombstone,
+)
+from hydragnn_tpu.coord import PeerWatchdog as _CoordPeerWatchdog
+from hydragnn_tpu.coord import hb_path as _hb_path  # noqa: F401
+from hydragnn_tpu.coord import read_json as _read_json  # noqa: F401
+from hydragnn_tpu.coord import tomb_path as _tomb_path  # noqa: F401
+from hydragnn_tpu.coord import write_json as _write_json  # noqa: F401
 from hydragnn_tpu.obs import runtime as obs
 
 # worker exit codes the agent keys on (distinct from faults.KILL_EXIT_CODE
@@ -87,9 +103,6 @@ EXIT_EVICTED = 115  # I was declared dead by the others; do not respawn
 EXIT_GEN_TIMEOUT = 116  # no next-generation file appeared in time
 
 _GEN_RE = re.compile(r"gen-(\d+)\.json$")
-
-DEFAULT_HEARTBEAT_S = 1.0
-DEFAULT_LEASE_S = 6.0
 
 
 # ---- progress hooks (no-op cheap when no heartbeat is live) ---------------
@@ -173,104 +186,13 @@ def note_guard_restore():
     _progress["guard_restores"] = _progress["guard_restores"] + 1
 
 
-# ---- coordination-directory primitives ------------------------------------
-
-
-def _write_json(path: str, obj: Dict):
-    """Atomic JSON write (tmp + rename): a reader never sees a torn file."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-    os.replace(tmp, path)
-
-
-def _read_json(path: str) -> Optional[Dict]:
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None  # mid-rename/missing — the caller polls again
-
-
-def _hb_path(coord_dir: str, kind: str, host: int) -> str:
-    return os.path.join(coord_dir, f"{kind}s", f"host-{int(host)}.json")
-
-
-def _tomb_path(coord_dir: str, host: int) -> str:
-    return os.path.join(coord_dir, "dead", f"host-{int(host)}.json")
+# ---- coordination-directory primitives (generation files stay here —
+# the agent's leader-elected re-mesh is elastic-specific; everything else
+# lives in hydragnn_tpu.coord and is re-exported above) ---------------------
 
 
 def _gen_path(coord_dir: str, gen: int) -> str:
     return os.path.join(coord_dir, "gens", f"gen-{int(gen):06d}.json")
-
-
-def write_tombstone(coord_dir: str, host: int, reason: str, by: int):
-    """Idempotent: the FIRST detection timestamp is the one recoveries are
-    measured from, so an existing tombstone is never overwritten."""
-    path = _tomb_path(coord_dir, host)
-    if os.path.exists(path):
-        return
-    _write_json(
-        path,
-        {"host": int(host), "ts": time.time(), "reason": reason,
-         "by": int(by)},
-    )
-
-
-def read_tombstone(coord_dir: str, host: int) -> Optional[Dict]:
-    return _read_json(_tomb_path(coord_dir, host))
-
-
-def heartbeat_age(coord_dir: str, kind: str, host: int,
-                  now: Optional[float] = None) -> Optional[float]:
-    """Seconds since ``host`` last heartbeat as ``kind``; None = never."""
-    hb = _read_json(_hb_path(coord_dir, kind, host))
-    if hb is None or "ts" not in hb:
-        return None
-    return (now if now is not None else time.time()) - float(hb["ts"])
-
-
-def dead_members(
-    coord_dir: str,
-    members: List[int],
-    lease_s: float,
-    kind: str = "agent",
-    now: Optional[float] = None,
-    current_gen: Optional[int] = None,
-) -> Dict[int, float]:
-    """``{host: detect_ts}`` for every member that is tombstoned or whose
-    ``kind`` heartbeat lease expired. A member that never heartbeat at all
-    is NOT dead — it may still be bootstrapping; the lease only starts
-    ticking once a first heartbeat exists. With ``current_gen``, a lease
-    from an EARLIER generation is treated the same way: worker leases
-    persist at one path across re-meshes, so a respawned peer that has
-    not yet written its first new-gen lease must read as bootstrapping,
-    not as stale (its old lease is necessarily older than the downtime)."""
-    now = time.time() if now is None else now
-    dead: Dict[int, float] = {}
-    for m in members:
-        tomb = read_tombstone(coord_dir, m)
-        if tomb is not None:
-            dead[m] = float(tomb.get("ts", now))
-            continue
-        hb = _read_json(_hb_path(coord_dir, kind, m))
-        if hb is None or "ts" not in hb:
-            continue  # never heartbeat: still bootstrapping, not dead
-        if (
-            current_gen is not None
-            and int(hb.get("gen", current_gen)) < current_gen
-        ):
-            continue  # pre-resize lease: the new-gen worker is booting
-        if hb.get("done"):
-            # a CLEANLY finished member stops heartbeating forever — end
-            # of run, not a death. Without this, rank 0's post-training
-            # tail (final checkpoint, reports) would outlive the other
-            # ranks' leases and a bogus host_lost would kill it mid-write.
-            continue
-        if now - float(hb["ts"]) > lease_s:
-            dead[m] = now
-    return dead
 
 
 def latest_gen(coord_dir: str):
@@ -289,55 +211,12 @@ def latest_gen(coord_dir: str):
     return best, payload
 
 
-# ---- heartbeat + watchdog threads -----------------------------------------
+# ---- heartbeat + watchdog threads (core in hydragnn_tpu.coord) ------------
 
 
-class Heartbeat:
-    """Background lease writer: one atomic JSON write per interval.
-
-    The thread is daemon (a crashed owner must not hang interpreter
-    exit) with an explicit lifecycle: :meth:`stop` joins it bounded."""
-
-    def __init__(self, path: str, payload: Callable[[], Dict],
-                 interval_s: float):
-        self.path = path
-        self._payload = payload
-        self.interval_s = float(interval_s)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, name="hydragnn-heartbeat", daemon=True
-        )
-
-    def start(self) -> "Heartbeat":
-        self._write()  # the lease exists before start() returns
-        self._thread.start()
-        return self
-
-    def _write(self):
-        try:
-            rec = dict(self._payload())
-            rec["ts"] = time.time()
-            rec["pid"] = os.getpid()
-            _write_json(self.path, rec)
-        except OSError:
-            pass  # a full/flaky shared FS must not kill the run
-
-    def _run(self):
-        while not self._stop.wait(self.interval_s):
-            self._write()
-
-    def stop(self):
-        self._stop.set()
-        if self._thread.is_alive():
-            self._thread.join(timeout=max(self.interval_s * 4, 5.0))
-        # final flush: the file must end on the TRUE last progress (a run
-        # whose tail beat the next tick would otherwise read one interval
-        # stale forever — e.g. an HPO trial's final step count)
-        self._write()
-
-
-class PeerWatchdog:
-    """Declares peers lost when their worker lease expires.
+class PeerWatchdog(_CoordPeerWatchdog):
+    """The elastic-training watchdog: :class:`hydragnn_tpu.coord.
+    PeerWatchdog` with the training teeth installed as defaults.
 
     Runs off the training thread so a collective hung on a dead peer
     still gets detected and broken (the default ``on_loss`` hard-exits
@@ -345,47 +224,6 @@ class PeerWatchdog:
     pending async checkpoint writes). Also notices this host's OWN
     tombstone — a partitioned straggler must evict itself rather than
     rejoin a world that already re-formed without it."""
-
-    def __init__(
-        self,
-        coord_dir: str,
-        host: int,
-        members: List[int],
-        lease_s: float,
-        interval_s: float,
-        on_loss: Optional[Callable[[Dict[int, float]], None]] = None,
-        on_evicted: Optional[Callable[[], None]] = None,
-        gen: int = 0,
-    ):
-        self.coord_dir = coord_dir
-        self.host = int(host)
-        self.peers = [int(m) for m in members if int(m) != int(host)]
-        self.lease_s = float(lease_s)
-        self.interval_s = float(interval_s)
-        self.gen = int(gen)
-        self._on_loss = on_loss or self._default_on_loss
-        self._on_evicted = on_evicted or self._default_on_evicted
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, name="hydragnn-peer-watchdog", daemon=True
-        )
-
-    def start(self) -> "PeerWatchdog":
-        self._thread.start()
-        return self
-
-    def _run(self):
-        while not self._stop.wait(self.interval_s):
-            if read_tombstone(self.coord_dir, self.host) is not None:
-                self._on_evicted()
-                return
-            dead = dead_members(
-                self.coord_dir, self.peers, self.lease_s, kind="worker",
-                current_gen=self.gen,
-            )
-            if dead:
-                self._on_loss(dead)
-                return
 
     def _default_on_loss(self, dead: Dict[int, float]):
         for h, ts in sorted(dead.items()):
@@ -409,11 +247,6 @@ class PeerWatchdog:
 
     def _default_on_evicted(self):
         os._exit(EXIT_EVICTED)
-
-    def stop(self):
-        self._stop.set()
-        if self._thread.is_alive():
-            self._thread.join(timeout=max(self.interval_s * 4, 5.0))
 
 
 # ---- worker-side runtime ---------------------------------------------------

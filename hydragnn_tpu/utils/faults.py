@@ -30,6 +30,29 @@ is a cheap no-op, so the harness costs nothing outside tests. The points
   exercises the flight-recorder stall detection and the HPO launcher's
   heartbeat-staleness early kill without any host actually dying.
 
+Serving-side knobs (consumed by ``serve/fleet.py`` replicas and
+``tests/test_fleet.py`` — the serving twin of the host-loss injections):
+
+- ``HYDRAGNN_FAULT_KILL_REPLICA_AT_REQUEST=REPLICA:K`` — hard-kill THIS
+  process (``os._exit``, the SIGKILL-mid-request analog) when it is
+  serving replica ``REPLICA`` (``HYDRAGNN_FLEET_REPLICA`` env) and its
+  ``K``-th accepted request arrives (1-based; bare ``K`` targets replica
+  0). Exercises lease-expiry detection + supervisor respawn + router
+  retry with in-flight requests genuinely lost on the dead replica.
+- ``HYDRAGNN_FAULT_SLOW_REPLICA=REPLICA:SPEC@SECONDS`` — sleep
+  ``SECONDS`` before dispatching each request whose 0-based ordinal is
+  covered by ``SPEC`` (NAN_AT_STEP grammar) on replica ``REPLICA``
+  (bare ``SPEC@SECONDS`` targets replica 0; ``SECONDS`` defaults to
+  0.25). The slow-replica injection: exercises deadline-aware routing
+  and SLO-miss accounting without killing anything.
+- ``HYDRAGNN_FAULT_CORRUPT_CANDIDATE=K`` — the ``K``-th candidate
+  checkpoint a hot-swap promote loads in this process (1-based;
+  ``all`` corrupts every one) is read through a byte-flipped COPY, so
+  the strict v2 CRC check fails exactly as it would for real on-disk
+  corruption (the shared original is untouched — other replicas must
+  see the pristine file). Exercises the promote -> reject -> rollback
+  path with the old version still serving.
+
 Counters are process-global and monotonic; :func:`reset` exists for tests
 that exercise several scenarios in one process.
 """
@@ -39,7 +62,12 @@ import threading
 import time
 
 _lock = threading.Lock()
-_counters = {"ckpt_writes": 0, "flaky_reads": 0}
+_counters = {
+    "ckpt_writes": 0,
+    "flaky_reads": 0,
+    "replica_requests": 0,
+    "candidate_loads": 0,
+}
 
 KILL_EXIT_CODE = 113  # distinctive, checked by the kill-and-resume e2e test
 
@@ -122,6 +150,84 @@ def slow_step(step: int) -> None:
     member, _, secs = spec.partition("@")
     if _parse_step_spec(member)(int(step)):
         time.sleep(float(secs) if secs else 0.25)
+
+
+def _this_replica() -> int:
+    """The serving replica id of THIS process (0 when unset — matches
+    the bare-spec default the step-side injections use for rank)."""
+    try:
+        return int(os.getenv("HYDRAGNN_FLEET_REPLICA", "0"))
+    except ValueError:
+        return 0
+
+
+def kill_replica_at_request() -> None:
+    """Replica-death injection: hard-exit when this replica's K-th
+    accepted request arrives. Spec is ``"REPLICA:K"`` (bare ``"K"`` =
+    replica 0, K 1-based). Called once per accepted request by the
+    replica's request path; the counter advances ONLY when the knob is
+    set and names this replica, so the fire point is exact regardless of
+    traffic served before the knob applies. Same no-cleanup ``os._exit``
+    as :func:`kill_at_step` — in-flight work dies with the process and
+    only the router's retry resurrects it."""
+    spec = os.getenv("HYDRAGNN_FAULT_KILL_REPLICA_AT_REQUEST")
+    if spec is None:
+        return
+    replica_s, _, req_s = spec.rpartition(":")
+    target = int(replica_s) if replica_s else 0
+    if _this_replica() != target:
+        return
+    with _lock:
+        _counters["replica_requests"] += 1
+        ordinal = _counters["replica_requests"]
+    if ordinal == int(req_s):
+        os._exit(KILL_EXIT_CODE)
+
+
+def slow_replica(request_ordinal: int) -> None:
+    """Slow-replica injection: sleep before dispatching each covered
+    request. Spec is ``"REPLICA:SPEC@SECONDS"`` (``"1:0:50@0.2"`` slows
+    replica 1's first 50 requests by 0.2 s). A colon-free bare spec
+    (``"7@0.5"``) targets replica 0; range/list specs containing ``:``
+    need the explicit replica prefix. ``SECONDS`` defaults to 0.25."""
+    spec = os.getenv("HYDRAGNN_FAULT_SLOW_REPLICA")
+    if spec is None:
+        return
+    member, _, secs = spec.partition("@")
+    replica_s, sep, step_spec = member.partition(":")
+    if not sep:
+        target, step_spec = 0, member
+    else:
+        target = int(replica_s)
+    if _this_replica() != target:
+        return
+    if _parse_step_spec(step_spec)(int(request_ordinal)):
+        time.sleep(float(secs) if secs else 0.25)
+
+
+def corrupt_candidate(path: str) -> str:
+    """Candidate-corruption injection: when this process's selected
+    hot-swap candidate load arrives, return a byte-flipped COPY of
+    ``path`` for the loader to read (the original stays pristine — the
+    other replicas' loads must succeed). Spec is the 1-based load
+    ordinal (``all`` = every load); unset or unselected loads return
+    ``path`` unchanged."""
+    spec = os.getenv("HYDRAGNN_FAULT_CORRUPT_CANDIDATE")
+    if spec is None:
+        return path
+    with _lock:
+        _counters["candidate_loads"] += 1
+        ordinal = _counters["candidate_loads"]
+    if spec != "all" and int(spec) != ordinal:
+        return path
+    corrupt = f"{path}.injected-corrupt"
+    with open(path, "rb") as src:
+        blob = bytearray(src.read())
+    if blob:
+        blob[len(blob) // 2] ^= 0xFF
+    with open(corrupt, "wb") as dst:
+        dst.write(bytes(blob))
+    return corrupt
 
 
 def nan_at_step(step: int) -> bool:

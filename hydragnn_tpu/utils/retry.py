@@ -20,6 +20,16 @@ import random
 import time
 
 
+def backoff_delay(attempt: int, base_delay: float) -> float:
+    """Delay before retry ``attempt`` (0-based): exponential with
+    uniform +0..50% jitter — THE repo backoff curve, shared by
+    :func:`retry_io` and the serving fleet router
+    (``serve/router.py``), so every retry storm in the system
+    decorrelates the same way. Bounds: ``base * 2^attempt`` to
+    ``1.5x`` that."""
+    return base_delay * (2.0 ** attempt) * (1.0 + random.uniform(0.0, 0.5))
+
+
 def retry_io(fn, *, what: str = "", attempts=None, base_delay=None):
     """Call ``fn()``; on transient ``OSError`` retry with exponential
     backoff + uniform jitter. Re-raises the last error once attempts are
@@ -39,6 +49,5 @@ def retry_io(fn, *, what: str = "", attempts=None, base_delay=None):
             last = e
             if i == attempts - 1:
                 break
-            delay = base_delay * (2.0 ** i) * (1.0 + random.uniform(0.0, 0.5))
-            time.sleep(delay)
+            time.sleep(backoff_delay(i, base_delay))
     raise last

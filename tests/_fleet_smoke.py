@@ -1,0 +1,328 @@
+"""CI fleet kill-and-heal + hot-swap smoke (standalone, NOT a pytest module).
+
+The bounded-wall-time serving twin of ``tests/_elastic_smoke.py``: two
+spec-driven replica processes behind a :class:`ServingFleet` supervisor
+and a :class:`FleetRouter`, under closed-loop load from concurrent
+clients, through the full fault schedule —
+
+1. steady state (baseline latency),
+2. SIGKILL replica 1 mid-load -> lease/process-exit detection, respawn,
+   ``replica_lost`` + ``fleet_degraded`` + ``replica_respawned`` events
+   with the measured downtime,
+3. zero-downtime hot-swap promote of a candidate checkpoint (per-bucket
+   warm on every replica, compile-counter verified, atomic publish),
+4. promote of a CRC-corrupt candidate -> loud rollback with the good
+   version still serving.
+
+Asserts zero requests lost beyond the retry budget (every submitted
+request reaches a terminal outcome; none fail), validates the whole
+event stream against the documented schema, and emits a ``fleet_report``
+with the measured availability.
+
+Usage: python tests/_fleet_smoke.py <workdir>
+"""
+
+import json
+import os
+import pickle
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_CLIENTS = 3
+REQUEST_DEADLINE_S = 30.0
+
+ARCH = {
+    "model_type": "GIN",
+    "input_dim": 1,
+    "hidden_dim": 8,
+    "num_conv_layers": 2,
+    "output_dim": [1, 1],
+    "output_type": ["graph", "node"],
+    "output_heads": {
+        "graph": {
+            "num_sharedlayers": 1,
+            "dim_sharedlayers": 8,
+            "num_headlayers": 1,
+            "dim_headlayers": [8],
+        },
+        "node": {"num_headlayers": 1, "dim_headlayers": [8], "type": "mlp"},
+    },
+    "task_weights": [1.0, 1.0],
+}
+
+
+def make_graphs(num, seed):
+    import numpy as np
+
+    from hydragnn_tpu.data.dataobj import GraphData
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        n = int(rng.integers(5, 14))
+        g = GraphData(
+            x=rng.random((n, 1)).astype(np.float32),
+            pos=rng.random((n, 3)).astype(np.float32),
+        )
+        src = np.arange(n)
+        dst = (src + 1) % n
+        g.edge_index = np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+        out.append(g)
+    return out
+
+
+def build_artifacts(workdir, arch=None, samples=None, *, batch=4,
+                    buckets=2, model_name="m", max_wait_s=0.003,
+                    queue_capacity=256):
+    """Base + bumped-candidate (+ CRC-corrupt) checkpoints, plan
+    samples, and the fleet spec — THE fleet artifact recipe, shared
+    with ``benchmarks/serve_bench.py --fleet`` (which passes its own
+    arch + graph-size distribution)."""
+    import jax
+
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.serve.buckets import plan_from_samples
+    from hydragnn_tpu.train.checkpoint import save_model
+    from hydragnn_tpu.train.trainer import Trainer
+
+    arch = dict(ARCH) if arch is None else dict(arch)
+    if samples is None:
+        samples = make_graphs(32, seed=11)
+    plan = plan_from_samples(
+        samples, max_batch_graphs=batch, num_buckets=buckets
+    )
+    model = create_model_config(dict(arch))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    init_batch, _ = plan.pack([samples[0]], 0)
+    state = trainer.init_state(init_batch, seed=0)
+    ckdir = os.path.join(workdir, "ck")
+    save_model(state, "base", path=ckdir)
+    bumped = state.replace(
+        params=jax.tree_util.tree_map(lambda x: x + 0.05, state.params)
+    )
+    save_model(bumped, "cand", path=ckdir)
+    # the corrupt candidate: cand's bytes with one payload byte flipped —
+    # the strict v2 CRC on every replica must refuse it
+    cand_pk = os.path.join(ckdir, "cand", "cand.pk")
+    blob = bytearray(open(cand_pk, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    os.makedirs(os.path.join(ckdir, "broken"), exist_ok=True)
+    with open(os.path.join(ckdir, "broken", "broken.pk"), "wb") as f:
+        f.write(bytes(blob))
+
+    samples_path = os.path.join(workdir, "samples.pkl")
+    with open(samples_path, "wb") as f:
+        pickle.dump(samples, f)
+    spec = {
+        "checkpoint": {"name": "base", "path": ckdir},
+        "arch": arch,
+        "model_name": model_name,
+        "samples": samples_path,
+        "plan": {"max_batch_graphs": batch, "num_buckets": buckets},
+        "server": {"max_wait_s": max_wait_s,
+                   "queue_capacity": queue_capacity},
+    }
+    spec_path = os.path.join(workdir, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    return spec_path, ckdir, samples
+
+
+def main(workdir):
+    os.makedirs(workdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.serve import FleetRouter, ServerOverloaded
+    from hydragnn_tpu.serve.fleet import ServingFleet
+
+    spec_path, ckdir, samples = build_artifacts(workdir)
+    coord_dir = os.path.join(workdir, "coord")
+    log_dir = os.path.join(workdir, "log")
+    fleet = ServingFleet(
+        coord_dir,
+        2,
+        spec_path=spec_path,
+        heartbeat_s=0.1,
+        lease_s=0.75,
+        poll_s=0.05,
+        log_dir=log_dir,
+    )
+    t_boot = time.monotonic()
+    fleet.start(wait_serving=True, timeout=300)
+    boot_s = time.monotonic() - t_boot
+    assert fleet.health()["live"] == 2, fleet.health()
+
+    router = FleetRouter(
+        coord_dir,
+        lease_s=0.75,
+        scan_interval_s=0.1,
+        max_attempts=6,
+        retry_base_delay_s=0.05,
+    )
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    results = []  # (t, latency_s, outcome)
+    failures = []
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            g = samples[int(rng.integers(0, len(samples)))]
+            t0 = time.monotonic()
+            try:
+                router.route(g, deadline_s=REQUEST_DEADLINE_S)
+                outcome = "ok"
+            except ServerOverloaded:
+                outcome = "shed"  # explicit, terminal, retry-after
+            except Exception as e:
+                outcome = "failed"
+                with lock:
+                    failures.append(repr(e))
+            with lock:
+                results.append(
+                    (t0, time.monotonic() - t0, outcome)
+                )
+
+    clients = [
+        threading.Thread(target=client, args=(100 + i,), daemon=True)
+        for i in range(NUM_CLIENTS)
+    ]
+    for t in clients:
+        t.start()
+
+    try:
+        # phase 1: steady state
+        time.sleep(2.0)
+        with lock:
+            assert any(o == "ok" for _, _, o in results), "no traffic served"
+
+        # phase 2: SIGKILL replica 1 mid-load -> detect + respawn
+        pid = fleet.replica_pid(1)
+        os.kill(pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            snap = fleet.metrics.snapshot()
+            if snap["replica_respawns_total"] >= 1:
+                break
+            time.sleep(0.1)
+        heal_s = time.monotonic() - t_kill
+        snap = fleet.metrics.snapshot()
+        assert snap["replica_losses_total"] >= 1, snap
+        assert snap["replica_respawns_total"] >= 1, (
+            f"replica never respawned within 240s: {snap}"
+        )
+        assert snap["last_recovery_seconds"] > 0, snap
+
+        # phase 3: hot-swap promote mid-load (both replicas warm + verify)
+        res = fleet.promote("cand", path=ckdir, arch_config=ARCH,
+                            name="m", timeout=240)
+        assert res["status"] == "promoted", res
+        assert res["propagated"], res  # every replica REPORTS v2 active
+        assert all(
+            a["status"] == "warmed" and a["compiles"] == 2
+            for a in res["acks"].values()
+        ), res
+        # every response routed from here on computes on the candidate
+        seen = set()
+        for _ in range(12):
+            raw = router.route(
+                samples[0], deadline_s=REQUEST_DEADLINE_S, raw=True
+            )
+            seen.add((raw["replica"], raw["version"]))
+        assert all(v == 2 for _, v in seen), seen
+        assert len({r for r, _ in seen}) == 2, (
+            f"expected both replicas serving, saw {seen}"
+        )
+
+        # phase 4: corrupt candidate -> loud rollback, v2 never blinks
+        res2 = fleet.promote("broken", path=ckdir, arch_config=ARCH,
+                             name="m", timeout=240)
+        assert res2["status"] == "rolled_back", res2
+        assert "corrupt" in res2["reason"], res2
+        raw = router.route(
+            samples[0], deadline_s=REQUEST_DEADLINE_S, raw=True
+        )
+        assert raw["version"] == 2, raw
+        time.sleep(1.0)
+
+        stop.set()
+        for t in clients:
+            t.join(timeout=60)
+        with lock:
+            done = list(results)
+            failed = list(failures)
+        # zero requests lost beyond the retry budget: every submitted
+        # request reached a terminal outcome, and none FAILED — kills
+        # were healed by retry, sheds (if any) answered with retry-after
+        assert not failed, f"{len(failed)} lost request(s): {failed[:5]}"
+        n_ok = sum(1 for _, _, o in done if o == "ok")
+        n_shed = sum(1 for _, _, o in done if o == "shed")
+        assert n_ok + n_shed == len(done)
+        availability = n_ok / max(len(done), 1)
+        lat = sorted(l for _, l, o in done if o == "ok")
+        p50 = lat[len(lat) // 2]
+        p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+        slo = router.metrics.snapshot()
+        fleet.emit(
+            "fleet_report",
+            submitted=len(done),
+            succeeded=n_ok,
+            availability=round(availability, 6),
+            shed=n_shed,
+            p50_ms=round(p50 * 1e3, 3),
+            p99_ms=round(p99 * 1e3, 3),
+            slo_miss_ratio=slo["slo_miss_ratio"],
+            kill_heal_s=round(heal_s, 3),
+        )
+        assert availability > 0.9, (
+            f"availability {availability} with {n_shed} sheds"
+        )
+    finally:
+        # ALWAYS tear the fleet down — a failed phase must not leave
+        # orphaned replica processes holding CI's stdout open
+        stop.set()
+        for t in clients:
+            t.join(timeout=60)
+        fleet.stop()
+
+    recs = validate_events(
+        os.path.join(log_dir, "events.jsonl"),
+        require=[
+            "replica_lost", "replica_respawned", "fleet_degraded",
+            "model_promoted", "model_rollback", "fleet_report",
+        ],
+    )
+    lost = [r for r in recs if r["event"] == "replica_lost"][0]
+    assert lost["replica"] == 1, lost
+    respawned = [r for r in recs if r["event"] == "replica_respawned"][0]
+    assert 0 < respawned["downtime_s"] < 240, respawned
+    promoted = [r for r in recs if r["event"] == "model_promoted"][0]
+    assert promoted["name"] == "m" and promoted["version"] == 2, promoted
+    rolled = [r for r in recs if r["event"] == "model_rollback"]
+    assert any("corrupt" in r["reason"] for r in rolled), rolled
+
+    print(
+        "fleet smoke OK: boot {:.1f}s, kill->heal {:.1f}s "
+        "(downtime {:.1f}s), promote+rollback verified, {} requests "
+        "({} shed), availability {:.4f}, p50 {:.0f}ms p99 {:.0f}ms".format(
+            boot_s, heal_s, respawned["downtime_s"], len(done), n_shed,
+            availability, p50 * 1e3, p99 * 1e3,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,779 @@
+"""Self-healing serving fleet (serve/fleet.py + serve/router.py).
+
+Acceptance (ISSUE 15): N InferenceServer replicas behind one router,
+coordinated through the shared-dir lease/tombstone protocol extracted
+from elastic training into ``hydragnn_tpu.coord``; replica death and
+wedge detected + healed by the supervisor; zero-downtime registry-driven
+hot-swap with CRC-bad candidates rolling back loudly; deadline-aware
+budgeted retry and priority-lane load shedding at the router.
+
+The subprocess kill-and-heal + promote e2e lives in
+``tests/_fleet_smoke.py`` (the CI gate) with a ``slow``-marked pytest
+wrapper here; everything in-process below reuses the test_serve harness
+so the tier-1 cost stays one jit warmup.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu import coord
+from hydragnn_tpu.serve import (
+    DeadlineExceeded,
+    FleetRouter,
+    InferenceServer,
+    ModelRegistry,
+    ReplicaServer,
+    RetryBudget,
+    ServerOverloaded,
+)
+from hydragnn_tpu.serve.fleet import REPLICA, ServingFleet
+from hydragnn_tpu.serve.router import NoLiveReplica
+from hydragnn_tpu.utils import faults
+from hydragnn_tpu.utils.retry import backoff_delay
+
+from test_models_forward import arch_config
+from test_serve import _graph, _harness
+
+
+# ---- coord extraction ------------------------------------------------------
+
+
+def pytest_coord_replica_prefix_paths_and_dead_members(tmp_path):
+    """The extracted core speaks replica leases as fluently as host
+    leases: kind/prefix generalization + tombstone lifecycle."""
+    d = str(tmp_path)
+    assert coord.hb_path(d, REPLICA, 3, prefix=REPLICA).endswith(
+        "replicas/replica-3.json"
+    )
+    now = time.time()
+    coord.write_json(
+        coord.hb_path(d, REPLICA, 0, prefix=REPLICA), {"ts": now}
+    )
+    coord.write_json(
+        coord.hb_path(d, REPLICA, 1, prefix=REPLICA), {"ts": now - 60}
+    )
+    dead = coord.dead_members(
+        d, [0, 1, 2], lease_s=5.0, kind=REPLICA, prefix=REPLICA
+    )
+    assert dead == {1: pytest.approx(now, abs=5.0)}
+    # tombstone + clear (the respawn path lifts the sentence)
+    coord.write_tombstone(d, 0, reason="wedged", by=-1, prefix=REPLICA)
+    assert coord.read_tombstone(d, 0, prefix=REPLICA)["reason"] == "wedged"
+    assert 0 in coord.dead_members(
+        d, [0], lease_s=5.0, kind=REPLICA, prefix=REPLICA
+    )
+    coord.clear_tombstone(d, 0, prefix=REPLICA)
+    assert coord.read_tombstone(d, 0, prefix=REPLICA) is None
+    assert 0 not in coord.dead_members(
+        d, [0], lease_s=5.0, kind=REPLICA, prefix=REPLICA
+    )
+    # elastic still re-exports the same implementation (one core, two
+    # consumers — the satellite's whole point)
+    from hydragnn_tpu.train import elastic
+
+    assert elastic.Heartbeat is coord.Heartbeat
+    assert elastic.dead_members is coord.dead_members
+    assert issubclass(elastic.PeerWatchdog, coord.PeerWatchdog)
+
+
+# ---- registry promote / rollback -------------------------------------------
+
+
+def pytest_registry_promote_rollback_and_idempotence():
+    h = _harness()
+    registry = ModelRegistry()
+    e1 = registry.register("m", h["model"], h["state"].params,
+                           h["state"].batch_stats)
+    e2 = registry.register("m", h["model"], h["state"].params,
+                           h["state"].batch_stats)
+    # never promoted: latest registered serves (historical behavior)
+    assert registry.get("m") is e2
+    assert registry.promote("m", 1) is e1
+    assert registry.get("m") is e1
+    assert registry.describe()["m"]["version"] == 1
+    assert registry.describe()["m"]["latest"] == 2
+    # double-promote of the active version is an idempotent no-op: the
+    # later rollback still reverts to the GENUINE previous version
+    assert registry.promote("m", 1) is e1
+    assert registry.rollback("m") is e2
+    assert registry.get("m") is e2
+    with pytest.raises(ValueError, match="roll back"):
+        registry.rollback("m")
+    with pytest.raises(KeyError):
+        registry.promote("m", 99)
+    with pytest.raises(KeyError):
+        registry.promote("nope")
+
+
+def pytest_registry_promote_checkpoint_rejects_corrupt_atomically(tmp_path):
+    """A candidate failing CRC/strict load is rejected with NO registry
+    mutation: no half-registered version, active version untouched."""
+    from hydragnn_tpu.train.checkpoint import save_model
+
+    h = _harness()
+    save_model(h["state"], "base", path=str(tmp_path))
+    save_model(h["state"], "cand", path=str(tmp_path))
+    registry = ModelRegistry()
+    registry.load_checkpoint(
+        "base", arch_config=arch_config("SAGE"), path=str(tmp_path),
+        name="m",
+    )
+    assert registry.get("m").version == 1
+
+    # flip a payload byte: the strict v2 loader must refuse
+    fname = tmp_path / "cand" / "cand.pk"
+    raw = bytearray(fname.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    fname.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="corrupt"):
+        registry.promote_checkpoint(
+            "cand", arch_config=arch_config("SAGE"), path=str(tmp_path),
+            name="m",
+        )
+    # atomicity: nothing registered, nothing promoted
+    desc = registry.describe()["m"]
+    assert desc["versions"] == 1 and desc["version"] == 1
+    assert registry.get("m").version == 1
+
+    # the intact candidate promotes in one step
+    save_model(h["state"], "cand", path=str(tmp_path))
+    entry = registry.promote_checkpoint(
+        "cand", arch_config=arch_config("SAGE"), path=str(tmp_path),
+        name="m",
+    )
+    assert entry.version == 2 and registry.get("m").version == 2
+    assert registry.rollback("m").version == 1
+
+
+def pytest_registry_promote_checkpoint_pins_active_not_latest(tmp_path):
+    """promote_checkpoint in the rolled-back state (active v1 while the
+    rejected candidate v2 is still registered) must pin the ACTIVE
+    version: the rejected candidate never serves during the load window,
+    and rollback after the fixed promote returns to the genuine
+    pre-promote version, not the rejected one."""
+    from hydragnn_tpu.train.checkpoint import save_model
+
+    h = _harness()
+    for ck in ("base", "bad", "fixed"):
+        save_model(h["state"], ck, path=str(tmp_path))
+    registry = ModelRegistry()
+    registry.load_checkpoint(
+        "base", arch_config=arch_config("SAGE"), path=str(tmp_path),
+        name="m",
+    )
+    registry.promote_checkpoint(
+        "bad", arch_config=arch_config("SAGE"), path=str(tmp_path),
+        name="m",
+    )
+    assert registry.get("m").version == 2
+    registry.rollback("m")
+    assert registry.get("m").version == 1  # bad candidate benched
+    entry = registry.promote_checkpoint(
+        "fixed", arch_config=arch_config("SAGE"), path=str(tmp_path),
+        name="m",
+    )
+    assert entry.version == 3 and registry.get("m").version == 3
+    # the rollback stack never picked the benched v2 back up
+    assert registry.rollback("m").version == 1
+
+
+def pytest_respawn_skips_history_and_rolls_back_to_booted_base(tmp_path):
+    """The respawn path's two subtle contracts: (a) promote commands
+    already on disk are NEVER replayed at boot (a failed promote's
+    candidate must not be re-warmed, its ack not overwritten); (b) a
+    replica respawned after a resolved promote adopts the candidate but
+    keeps the version it BOOTED with as the cmd-0 base, so a fleet-wide
+    rollback() reverts it to the true base instead of the candidate."""
+    from hydragnn_tpu.train.checkpoint import save_model
+
+    h = _harness()
+    ckdir = tmp_path / "ck"
+    save_model(h["state"], "base", path=str(ckdir))
+    save_model(h["state"], "cand", path=str(ckdir))
+
+    def boot(coord_dir):
+        registry = ModelRegistry()
+        registry.load_checkpoint(
+            "base", arch_config=arch_config("SAGE"), path=str(ckdir),
+            name="m",
+        )
+        server = InferenceServer(
+            registry, h["plan"], default_model="m", max_wait_s=0.002
+        )
+        rep = ReplicaServer(
+            server, coord_dir, 0, heartbeat_s=0.05, model_name="m",
+            arch_config=arch_config("SAGE"), poll_s=0.02,
+        )
+        return registry, rep
+
+    # (a) a failed promote's cmd file with NO published active version
+    d1 = str(tmp_path / "c1")
+    os.makedirs(os.path.join(d1, "promote"))
+    coord.write_json(
+        os.path.join(d1, "promote", "cmd-000001.json"),
+        {"cmd_id": 1, "checkpoint": "cand", "path": str(ckdir)},
+    )
+    registry1, rep1 = boot(d1)
+    rep1.start()
+    try:
+        time.sleep(0.2)  # several watcher ticks
+        assert rep1._last_cmd_handled == 1
+        assert registry1.describe()["m"]["versions"] == 1  # no replay
+        assert not os.path.exists(
+            os.path.join(d1, "promote", "ack-000001-r0.json")
+        )
+    finally:
+        rep1.shutdown()
+
+    # (b) respawn after the promote RESOLVED: adopt, then roll back
+    d2 = str(tmp_path / "c2")
+    os.makedirs(os.path.join(d2, "promote"))
+    coord.write_json(
+        os.path.join(d2, "promote", "cmd-000001.json"),
+        {"cmd_id": 1, "checkpoint": "cand", "path": str(ckdir)},
+    )
+    coord.write_json(
+        os.path.join(d2, "promote", "active.json"),
+        {"seq": 1, "cmd_id": 1, "latest_cmd": 1},
+    )
+    registry2, rep2 = boot(d2)
+    rep2.start()
+    try:
+        assert registry2.get("m").version == 2  # serving the candidate
+        assert rep2._warmed[0] == 1  # base = the BOOTED version
+        coord.write_json(
+            os.path.join(d2, "promote", "active.json"),
+            {"seq": 2, "cmd_id": 0, "latest_cmd": 1},
+        )
+        deadline = time.monotonic() + 20
+        while (
+            time.monotonic() < deadline
+            and registry2.get("m").version != 1
+        ):
+            time.sleep(0.02)
+        assert registry2.get("m").version == 1  # true base, no split
+    finally:
+        rep2.shutdown()
+
+
+# ---- fault-injection knobs (each fires exactly once at its trigger,
+# inert when unset — the PR 8 fault-unit pattern) ---------------------------
+
+
+def pytest_fault_kill_replica_fires_once_at_trigger(monkeypatch):
+    exits = []
+    monkeypatch.setattr(os, "_exit", exits.append)
+    faults.reset()
+    # inert when unset
+    monkeypatch.delenv("HYDRAGNN_FAULT_KILL_REPLICA_AT_REQUEST",
+                       raising=False)
+    for _ in range(3):
+        faults.kill_replica_at_request()
+    assert exits == []
+    # inert for a different replica id even at the matching ordinal
+    monkeypatch.setenv("HYDRAGNN_FLEET_REPLICA", "0")
+    monkeypatch.setenv("HYDRAGNN_FAULT_KILL_REPLICA_AT_REQUEST", "1:1")
+    faults.kill_replica_at_request()
+    assert exits == []
+    # fires exactly once, at the configured (replica, ordinal)
+    faults.reset()
+    monkeypatch.setenv("HYDRAGNN_FAULT_KILL_REPLICA_AT_REQUEST", "0:2")
+    faults.kill_replica_at_request()
+    assert exits == []  # ordinal 1 != 2
+    faults.kill_replica_at_request()
+    assert exits == [faults.KILL_EXIT_CODE]  # ordinal 2: fire
+    faults.kill_replica_at_request()
+    assert exits == [faults.KILL_EXIT_CODE]  # ordinal 3: once only
+    faults.reset()
+
+
+def pytest_fault_slow_replica_spec(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", sleeps.append)
+    monkeypatch.delenv("HYDRAGNN_FAULT_SLOW_REPLICA", raising=False)
+    faults.slow_replica(0)
+    assert sleeps == []  # inert when unset
+    monkeypatch.setenv("HYDRAGNN_FLEET_REPLICA", "1")
+    monkeypatch.setenv("HYDRAGNN_FAULT_SLOW_REPLICA", "1:3@0.2")
+    for i in range(6):
+        faults.slow_replica(i)
+    assert sleeps == [0.2]  # exactly once, at request ordinal 3
+    monkeypatch.setenv("HYDRAGNN_FAULT_SLOW_REPLICA", "0:3@0.2")
+    faults.slow_replica(3)
+    assert sleeps == [0.2]  # other replica targeted: inert here
+    # bare colon-free spec targets replica 0, default 0.25 s
+    monkeypatch.setenv("HYDRAGNN_FLEET_REPLICA", "0")
+    monkeypatch.setenv("HYDRAGNN_FAULT_SLOW_REPLICA", "5")
+    faults.slow_replica(5)
+    assert sleeps == [0.2, 0.25]
+
+
+def pytest_fault_corrupt_candidate_fires_once(tmp_path, monkeypatch):
+    blob = bytes(range(64))
+    src = tmp_path / "cand.pk"
+    src.write_bytes(blob)
+    faults.reset()
+    monkeypatch.delenv("HYDRAGNN_FAULT_CORRUPT_CANDIDATE", raising=False)
+    assert faults.corrupt_candidate(str(src)) == str(src)  # inert unset
+    monkeypatch.setenv("HYDRAGNN_FAULT_CORRUPT_CANDIDATE", "2")
+    faults.reset()
+    assert faults.corrupt_candidate(str(src)) == str(src)  # load 1: no
+    out = faults.corrupt_candidate(str(src))  # load 2: fires
+    assert out != str(src)
+    corrupted = open(out, "rb").read()
+    assert corrupted != blob and len(corrupted) == len(blob)
+    assert corrupted[len(blob) // 2] == blob[len(blob) // 2] ^ 0xFF
+    assert src.read_bytes() == blob  # the shared original is untouched
+    assert faults.corrupt_candidate(str(src)) == str(src)  # once only
+    faults.reset()
+
+
+# ---- retry budget + backoff ------------------------------------------------
+
+
+def pytest_retry_budget_token_bucket():
+    b = RetryBudget(ratio=0.5, reserve=2.0)
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()  # reserve exhausted: a storm dies here
+    for _ in range(2):
+        b.on_success()
+    assert b.tokens == 1.0
+    assert b.try_acquire() and not b.try_acquire()
+    for _ in range(100):
+        b.on_success()
+    assert b.tokens == 2.0  # earned tokens cap at the reserve
+    with pytest.raises(ValueError):
+        RetryBudget(ratio=-1)
+
+
+def pytest_backoff_delay_shared_curve():
+    for attempt in range(4):
+        lo = 0.05 * 2.0 ** attempt
+        for _ in range(20):
+            d = backoff_delay(attempt, 0.05)
+            assert lo <= d <= lo * 1.5 + 1e-12
+
+
+# ---- router admission / shedding (no live replicas needed) ----------------
+
+
+def pytest_router_sheds_with_retry_after_when_fleet_empty(tmp_path):
+    router = FleetRouter(str(tmp_path), target_replicas=2,
+                         scan_interval_s=0.0)
+    g = _graph(8, np.random.default_rng(0), with_targets=False)
+    with pytest.raises(ServerOverloaded) as exc:
+        router.route(g)
+    assert exc.value.retry_after_s > 0  # the queue-full contract, fleet-wide
+    assert router.metrics.shed_total == 1
+    with pytest.raises(ValueError, match="unknown lane"):
+        router.route(g, lane="nope")
+
+
+def pytest_router_degraded_sheds_low_priority_lane_only(tmp_path):
+    d = str(tmp_path)
+    # one live lease of a target-2 fleet: degraded
+    coord.write_json(
+        coord.hb_path(d, REPLICA, 0, prefix=REPLICA),
+        {"ts": time.time(), "state": "serving", "port": 1,
+         "replica": 0},
+    )
+    coord.write_json(
+        os.path.join(d, "fleet.json"),
+        {"live": 1, "target": 2, "degraded": True, "ts": time.time()},
+    )
+    router = FleetRouter(
+        d, lanes={"interactive": 0, "batch": 1},
+        shed_priority_when_degraded=1, scan_interval_s=0.0,
+        max_attempts=2, retry_base_delay_s=0.001,
+    )
+    g = _graph(8, np.random.default_rng(1), with_targets=False)
+    # the batch lane sheds at admission, with a retry-after hint and the
+    # per-lane gauge moving
+    with pytest.raises(ServerOverloaded) as exc:
+        router.route(g, lane="batch")
+    assert exc.value.retry_after_s > 0
+    snap = router.fleet_metrics.snapshot()
+    assert snap["lane_shed_total"] == {"lane=batch": 1}
+    # the interactive lane is still admitted — port 1 answers nothing, so
+    # it burns its attempts against connection failures and fails LOUDLY
+    with pytest.raises(NoLiveReplica):
+        router.route(g, lane="interactive")
+    assert router.fleet_metrics.snapshot()["replica_errors_total"] >= 1
+
+
+# ---- in-process replica: routing, stop-under-load, hot-swap ---------------
+
+
+def _fresh_server(**kw):
+    """A fresh registry + InferenceServer over the shared harness model
+    (promote state must not leak into the module harness)."""
+    h = _harness()
+    registry = ModelRegistry()
+    registry.register("sage", h["model"], h["state"].params,
+                      h["state"].batch_stats)
+    kw.setdefault("max_wait_s", 0.002)
+    return InferenceServer(registry, h["plan"], default_model="sage", **kw)
+
+
+def pytest_replica_roundtrip_and_router_parity(tmp_path):
+    """Route through lease discovery + HTTP and get the same numbers the
+    in-process server returns; raw mode carries version/batch/replica."""
+    server = _fresh_server()
+    rep = ReplicaServer(server, str(tmp_path), 0, heartbeat_s=0.05)
+    rep.start()
+    try:
+        router = FleetRouter(str(tmp_path), target_replicas=1,
+                             lease_s=2.0, scan_interval_s=0.05)
+        g = _graph(12, np.random.default_rng(2), with_targets=False)
+        heads = router.route(g, deadline_s=30.0)
+        direct = server.predict(g, timeout=30)
+        for a, b in zip(heads, direct):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+        raw = router.route(g, deadline_s=30.0, raw=True)
+        assert raw["replica"] == 0 and raw["version"] == 1
+        assert raw["batch_seq"] >= 1
+        # an unknown model name is the REQUEST's fault: 400, propagated
+        # immediately — never burned against the retry budget
+        with pytest.raises(RuntimeError, match="answered 400"):
+            router.route(g, model="nope", deadline_s=10.0)
+        # the deadline series counted the met deadlines end to end
+        assert router.metrics.snapshot()["deadline_met_total"] == 2
+        # /healthz over the replica port carries replica identity
+        host, port = rep.address
+        health = json.load(
+            urllib.request.urlopen(f"http://{host}:{port}/healthz")
+        )
+        assert health["replica"] == 0 and health["state"] == "serving"
+        assert "hydragnn_serve_requests_total" in (
+            urllib.request.urlopen(f"http://{host}:{port}/metrics")
+            .read().decode()
+        )
+    finally:
+        rep.shutdown()
+    # a drained replica releases a done-marked lease: not dead, not live
+    lease = coord.read_json(
+        coord.hb_path(str(tmp_path), REPLICA, 0, prefix=REPLICA)
+    )
+    assert lease["done"] and lease["state"] == "stopped"
+    assert coord.dead_members(
+        str(tmp_path), [0], lease_s=0.0, kind=REPLICA, prefix=REPLICA
+    ) == {}
+
+
+def pytest_replica_stop_under_load_terminal_outcomes(tmp_path):
+    """The PR 6 stop-under-load contract extended to the respawn path:
+    a fleet-orchestrated replica teardown resolves EVERY accepted
+    request with a terminal outcome — a result, or an explicit shed
+    whose retry-after matches the queue-full contract. No hangs, no
+    silent drops."""
+    server = _fresh_server(queue_capacity=64)
+    rep = ReplicaServer(server, str(tmp_path), 0, heartbeat_s=0.05)
+    rep.start()
+    router = FleetRouter(str(tmp_path), target_replicas=1,
+                         scan_interval_s=0.05, max_attempts=1)
+    rng = np.random.default_rng(3)
+    graphs = [
+        _graph(int(n), rng, with_targets=False)
+        for n in rng.integers(4, 30, 40)
+    ]
+    outcomes = []
+    lock = threading.Lock()
+
+    def client(chunk):
+        for g in chunk:
+            try:
+                router.route(g, deadline_s=20.0)
+                out = "ok"
+            except ServerOverloaded as e:
+                assert e.retry_after_s > 0
+                out = "shed"
+            except (NoLiveReplica, DeadlineExceeded):
+                out = "unreachable"
+            with lock:
+                outcomes.append(out)
+
+    threads = [
+        threading.Thread(target=client, args=(graphs[i::4],))
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # load in flight
+    rep.shutdown(drain=True, timeout=20.0)
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "client thread hung past shutdown"
+    assert len(outcomes) == len(graphs)  # every request terminal
+    assert outcomes.count("ok") >= 1
+    # shutting down mid-burst: the tail was answered, shed with a hint,
+    # or found the lease already released — never silently dropped
+    assert all(o in ("ok", "shed", "unreachable") for o in outcomes)
+    # the replica-side metrics lifecycle invariant survived the teardown
+    snap = server.metrics.snapshot()
+    assert snap["requests_total"] == (
+        snap["responses_total"] + snap["timeouts_total"]
+        + snap["errors_total"]
+    )
+
+
+def pytest_hot_swap_promote_and_corrupt_rollback_in_process(tmp_path):
+    """The hot-swap e2e, replica-side: a candidate checkpoint is loaded
+    + warmed through the LIVE batcher (compile-counter verified) and
+    atomically promoted under load with zero failed requests and no
+    micro-batch mixing versions; a corrupt candidate acks failed and the
+    old version never stops serving."""
+    from hydragnn_tpu.train.checkpoint import save_model
+
+    h = _harness()
+    ckdir = tmp_path / "ck"
+    save_model(h["state"], "base", path=str(ckdir))
+    bumped = h["state"].replace(
+        params=__import__("jax").tree_util.tree_map(
+            lambda x: x + 0.05, h["state"].params
+        )
+    )
+    save_model(bumped, "cand", path=str(ckdir))
+
+    coord_dir = str(tmp_path / "coord")
+    registry = ModelRegistry()
+    registry.load_checkpoint(
+        "base", arch_config=arch_config("SAGE"), path=str(ckdir), name="m"
+    )
+    server = InferenceServer(
+        registry, h["plan"], default_model="m", max_wait_s=0.002,
+        queue_capacity=256,
+    )
+    rep = ReplicaServer(
+        server, coord_dir, 0, heartbeat_s=0.05,
+        model_name="m", arch_config=arch_config("SAGE"),
+    )
+    rep.start()
+    try:
+        router = FleetRouter(coord_dir, target_replicas=1,
+                             scan_interval_s=0.05)
+        g = _graph(10, np.random.default_rng(4), with_targets=False)
+        before = router.route(g, deadline_s=30.0, raw=True)
+        assert before["version"] == 1
+
+        # closed-loop load through the whole swap
+        stop = threading.Event()
+        responses = []
+        failures = []
+        lock = threading.Lock()
+
+        def pump():
+            rng = np.random.default_rng(5)
+            while not stop.is_set():
+                gg = _graph(int(rng.integers(4, 30)), rng,
+                            with_targets=False)
+                try:
+                    raw = router.route(gg, deadline_s=30.0, raw=True)
+                    with lock:
+                        responses.append(
+                            (raw["batch_seq"], raw["version"])
+                        )
+                except Exception as e:  # any failure breaks the promise
+                    with lock:
+                        failures.append(repr(e))
+
+        pumps = [threading.Thread(target=pump) for _ in range(2)]
+        for t in pumps:
+            t.start()
+        try:
+            # supervisor-side command, replica-side execution
+            pdir = os.path.join(coord_dir, "promote")
+            coord.write_json(
+                os.path.join(pdir, "cmd-000001.json"),
+                {"cmd_id": 1, "checkpoint": "cand", "path": str(ckdir)},
+            )
+            deadline = time.monotonic() + 60
+            ack = None
+            while time.monotonic() < deadline and ack is None:
+                ack = coord.read_json(
+                    os.path.join(pdir, "ack-000001-r0.json")
+                )
+                time.sleep(0.05)
+            assert ack is not None, "promote never acked"
+            assert ack["status"] == "warmed", ack
+            assert ack["version"] == 2
+            # per-bucket warm, compile-counter verified, old version
+            # still the active one until the publish
+            assert ack["compiles"] == h["plan"].num_buckets
+            assert registry.get("m").version == 1
+            coord.write_json(
+                os.path.join(pdir, "active.json"),
+                {"seq": 1, "cmd_id": 1, "latest_cmd": 1},
+            )
+            deadline = time.monotonic() + 30
+            while (
+                time.monotonic() < deadline
+                and registry.get("m").version != 2
+            ):
+                time.sleep(0.02)
+            assert registry.get("m").version == 2
+            after = router.route(g, deadline_s=30.0, raw=True)
+            assert after["version"] == 2
+            # v2 really is the candidate's weights
+            np.testing.assert_allclose(
+                np.asarray(after["heads"][0]),
+                np.asarray(
+                    server.predict(g, model="m", timeout=30)[0]
+                ),
+                atol=1e-6,
+            )
+            assert not np.allclose(
+                np.asarray(after["heads"][0]),
+                np.asarray(before["heads"][0]),
+            )
+
+            # corrupt candidate: strict load refuses, ack says failed,
+            # active version keeps serving every request
+            raw2 = bytearray((ckdir / "cand" / "cand.pk").read_bytes())
+            raw2[len(raw2) // 2] ^= 0xFF
+            (ckdir / "broken" / "broken.pk").parent.mkdir(parents=True)
+            (ckdir / "broken" / "broken.pk").write_bytes(bytes(raw2))
+            coord.write_json(
+                os.path.join(pdir, "cmd-000002.json"),
+                {"cmd_id": 2, "checkpoint": "broken", "path": str(ckdir)},
+            )
+            deadline = time.monotonic() + 60
+            ack2 = None
+            while time.monotonic() < deadline and ack2 is None:
+                ack2 = coord.read_json(
+                    os.path.join(pdir, "ack-000002-r0.json")
+                )
+                time.sleep(0.05)
+            assert ack2 is not None and ack2["status"] == "failed", ack2
+            assert "corrupt" in ack2["error"]
+            assert registry.get("m").version == 2  # untouched
+            assert router.route(g, deadline_s=30.0, raw=True)[
+                "version"
+            ] == 2
+        finally:
+            stop.set()
+            for t in pumps:
+                t.join(timeout=30.0)
+        # zero failed requests through kill-free swap + rejected promote
+        assert failures == []
+        assert len(responses) > 0
+        # no micro-batch mixed versions: every batch_seq maps to ONE
+        # version (in-flight batches kept their packed entry)
+        by_batch = {}
+        for seq, version in responses:
+            by_batch.setdefault(seq, set()).add(version)
+        assert all(len(v) == 1 for v in by_batch.values()), by_batch
+        assert {v for s in by_batch.values() for v in s} <= {1, 2}
+    finally:
+        rep.shutdown()
+
+
+# ---- supervisor logic (in-process, fake processes) ------------------------
+
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.pid = 4242
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+def pytest_supervisor_heals_exit_and_wedge_and_prices_events(tmp_path):
+    """ServingFleet._tick against fake replica processes: death by exit
+    and by stale lease both tombstone-for-the-record, respawn at the
+    next incarnation, and price the transitions as schema-valid events
+    + gauges; the respawned replica's serving lease closes the loop with
+    replica_respawned + downtime."""
+    from hydragnn_tpu.obs.events import validate_events
+
+    d = str(tmp_path / "coord")
+    fleet = ServingFleet(
+        d, 2, worker_cmd=["true"], lease_s=0.5, poll_s=0.05,
+        log_dir=str(tmp_path / "log"),
+    )
+    for sub in (f"{REPLICA}s", "dead", "promote"):
+        os.makedirs(os.path.join(d, sub), exist_ok=True)
+    spawned = []
+    fleet._spawn = lambda h: (  # no real processes in this unit
+        spawned.append((h.rid, h.incarnation)),
+        setattr(h, "proc", _FakeProc()),
+        setattr(h, "spawned_ts", time.time()),
+        setattr(h, "was_serving", False),
+    )
+    h0, h1 = fleet._replicas[0], fleet._replicas[1]
+    h0.proc, h1.proc = _FakeProc(), _FakeProc()
+    now = time.time()
+    for rid in (0, 1):
+        coord.write_json(
+            coord.hb_path(d, REPLICA, rid, prefix=REPLICA),
+            {"ts": now, "gen": 0, "state": "serving", "port": 1000 + rid},
+        )
+    fleet._tick(now)
+    assert fleet.metrics.snapshot()["live_replicas"] == 2.0
+    assert fleet.metrics.snapshot()["availability"] == 1.0
+
+    # replica 0 exits; replica 1 wedges (stale lease, process alive)
+    h0.proc.rc = -9
+    coord.write_json(
+        coord.hb_path(d, REPLICA, 1, prefix=REPLICA),
+        {"ts": now - 60, "gen": 0, "state": "serving", "port": 1001},
+    )
+    fleet._tick(now + 1.0)
+    assert [s[0] for s in spawned] == [0, 1]  # both respawned
+    assert h0.incarnation == 1 and h1.incarnation == 1
+    assert h1.proc.killed or spawned  # the wedged one was killed first
+    snap = fleet.metrics.snapshot()
+    assert snap["replica_losses_total"] == 2
+    assert snap["degraded"] == 1.0 and snap["live_replicas"] == 0.0
+    # tombstones were lifted for the respawn
+    assert coord.read_tombstone(d, 0, prefix=REPLICA) is None
+    # a stale lease from the OLD incarnation reads as booting, not dead
+    fleet._tick(now + 1.5)
+    assert fleet.metrics.snapshot()["replica_losses_total"] == 2
+
+    # the respawned replicas report serving at the new incarnation
+    for rid in (0, 1):
+        coord.write_json(
+            coord.hb_path(d, REPLICA, rid, prefix=REPLICA),
+            {"ts": now + 2.0, "gen": 1, "state": "serving",
+             "port": 2000 + rid},
+        )
+    fleet._tick(now + 2.0)
+    snap = fleet.metrics.snapshot()
+    assert snap["replica_respawns_total"] == 2
+    assert snap["live_replicas"] == 2.0 and snap["degraded"] == 0.0
+    assert snap["last_recovery_seconds"] > 0
+    fleet.events.close()
+    recs = validate_events(
+        str(tmp_path / "log" / "events.jsonl"),
+        require=["replica_lost", "replica_respawned", "fleet_degraded"],
+    )
+    lost = [r for r in recs if r["event"] == "replica_lost"]
+    assert {r["reason"] for r in lost} == {"exit_-9", "lease_expired"}
+    respawned = [r for r in recs if r["event"] == "replica_respawned"]
+    assert all(r["downtime_s"] > 0 for r in respawned)
+
+
+# ---- subprocess e2e (the CI smoke, wrapped) -------------------------------
+
+
+@pytest.mark.slow  # 2 replica processes x jax import + warmup
+def pytest_fleet_smoke_e2e(tmp_path):
+    import _fleet_smoke
+
+    _fleet_smoke.main(str(tmp_path / "smoke"))
