@@ -1,6 +1,6 @@
 """Serving A/B: micro-batched bucket-compiled server vs naive
 per-request predict (ISSUE 2 acceptance artifact), plus the fleet
-fault-schedule bench (ISSUE 15).
+fault-schedule bench (ISSUE 15) and its canary phases (ISSUE 16).
 
 Default mode drives the in-process
 :class:`~hydragnn_tpu.serve.InferenceServer` with concurrent mixed-size
@@ -15,8 +15,13 @@ ServingFleet` (N replica processes + :class:`~hydragnn_tpu.serve.
 router.FleetRouter`) and replays a two-lane closed-loop traffic mix
 through a scripted fault schedule — steady state, SIGKILL a replica
 mid-load (kill->heal), zero-downtime hot-swap promote, promote of a
-CRC-corrupt candidate (loud rollback) — reporting per-phase p50/p99
-latency, SLO-miss rate, and measured availability.
+CRC-corrupt candidate (loud rollback), then the canary flywheel: a
+published candidate shadow-evaluated off mirrored live traffic and
+promoted through the gates (canary_promote — prices the shadow-path
+overhead against the steady row, plus samples/shed and gate latency)
+and a CRC-corrupt candidate whose canary crash-loops at boot and is
+rejected without the fleet ever swapping (canary_reject) — reporting
+per-phase p50/p99 latency, SLO-miss rate, and measured availability.
 
 Usage: ``python benchmarks/serve_bench.py [--num=512] [--clients=8]
 [--buckets=3] [--batch=8] [--hidden=64] [--wait-ms=5]`` or
@@ -232,7 +237,13 @@ def run_fleet(replicas, clients, phase_s, deadline_s, batch_frac,
     import tempfile
     import threading
 
-    from hydragnn_tpu.serve import FleetRouter, ServerOverloaded
+    from hydragnn_tpu.serve import (
+        CanaryController,
+        CanaryGates,
+        CandidateChannel,
+        FleetRouter,
+        ServerOverloaded,
+    )
     from hydragnn_tpu.serve.fleet import ServingFleet
     from hydragnn_tpu.serve.server import DeadlineExceeded
 
@@ -296,6 +307,9 @@ def run_fleet(replicas, clients, phase_s, deadline_s, batch_frac,
         for t in threads:
             t.start()
 
+        controller = None
+        dec_promote = dec_reject = None
+        canary_promote_s = canary_reject_s = float("nan")
         try:
             # phase 1: steady state
             time.sleep(phase_s)
@@ -335,10 +349,60 @@ def run_fleet(replicas, clients, phase_s, deadline_s, batch_frac,
             )
             rollback_s = time.perf_counter() - t1
             time.sleep(phase_s)
+
+            # phase 5: canary shadow-promotion — publish a candidate,
+            # mirror half the live 200s into a subprocess canary, pass
+            # the gates, all-acked hot-swap. Tolerances are wide open
+            # (the bumped candidate legitimately disagrees with the
+            # active version); the row prices the SHADOW PATH — live
+            # latency vs the steady row, samples/shed, gate latency.
+            with lock:
+                phase[0] = "canary_promote"
+            channel = CandidateChannel(os.path.join(workdir, "chan"))
+            controller = CanaryController(
+                fleet,
+                channel,
+                spec_path,
+                fraction=0.5,
+                gates=CanaryGates(
+                    min_samples=8,
+                    min_bucket_samples=1,
+                    head_mae_tol=100.0,
+                    head_mae_rel_tol=100.0,
+                    latency_ratio_tol=100.0,
+                    latency_slack_s=5.0,
+                    max_crashes=1,
+                    decide_timeout_s=300.0,
+                ),
+                poll_s=0.05,
+                boot_timeout_s=240.0,
+                heartbeat_s=0.1,
+            )
+            controller.attach(router)
+            controller.start()
+            t1 = time.perf_counter()
+            channel.publish("cand", ckdir, note="bench")
+            dec_promote = controller.wait_decision(1, timeout=300.0)
+            canary_promote_s = time.perf_counter() - t1
+            time.sleep(phase_s)
+
+            # phase 6: CRC-corrupt candidate — the canary replica's
+            # strict load refuses it at boot, the controller burns the
+            # respawn budget and rejects with crash_loop; the fleet
+            # never swaps and live traffic never notices
+            with lock:
+                phase[0] = "canary_reject"
+            t1 = time.perf_counter()
+            channel.publish("broken", ckdir, note="bench-corrupt")
+            dec_reject = controller.wait_decision(2, timeout=300.0)
+            canary_reject_s = time.perf_counter() - t1
+            time.sleep(phase_s)
         finally:
             stop.set()
             for t in threads:
                 t.join(timeout=60)
+            if controller is not None:
+                controller.stop()
             fleet.stop()
 
         with lock:
@@ -362,6 +426,25 @@ def run_fleet(replicas, clients, phase_s, deadline_s, batch_frac,
             rollback_s=round(rollback_s, 2),
             rollback_status=res2["status"],
         ))
+        if dec_promote is not None:
+            snapc = controller.metrics.snapshot()
+            rows.append(_phase_row(
+                "canary_promote", per_phase.get("canary_promote", []),
+                deadline_s,
+                canary_decision_s=round(canary_promote_s, 2),
+                canary_verdict=dec_promote["verdict"],
+                gate_latency_s=dec_promote.get("gate_latency_s"),
+                shadow_samples=int(snapc.get("shadow_samples_total", 0)),
+                shadow_shed=int(snapc.get("shadow_shed_total", 0)),
+            ))
+        if dec_reject is not None:
+            rows.append(_phase_row(
+                "canary_reject", per_phase.get("canary_reject", []),
+                deadline_s,
+                canary_decision_s=round(canary_reject_s, 2),
+                canary_verdict=dec_reject["verdict"],
+                canary_reason=dec_reject.get("reason"),
+            ))
         everything = [r for v in per_phase.values() for r in v]
         rows.append(_phase_row(
             "overall", everything, deadline_s,

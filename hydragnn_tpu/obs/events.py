@@ -119,6 +119,24 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # tests/_fleet_smoke.py): one measured traffic window — availability
     # = terminally-succeeded / submitted logical requests
     "fleet_report": ("submitted", "succeeded", "availability"),
+    # canary channel (serve/registry.py CandidateChannel): rank 0 of the
+    # training side published a candidate checkpoint snapshot at
+    # end-of-epoch cadence for the canary controller to prove out —
+    # `candidate` is the channel sequence number (NOT the envelope seq)
+    "candidate_published": ("candidate", "checkpoint"),
+    # canary controller (serve/canary.py): a published candidate booted
+    # on a dedicated canary replica and entered shadow evaluation —
+    # live traffic is mirrored to it, its answers never returned
+    "canary_started": ("candidate", "checkpoint"),
+    # canary controller: every statistical gate passed over >= the
+    # min-sample floor and the PR 15 all-acked hot-swap promoted the
+    # candidate to active
+    "canary_promoted": ("candidate", "checkpoint", "samples"),
+    # canary controller: the candidate was rejected before ever serving
+    # a live request — reason names the failed gate (nan_outputs,
+    # head_mae, latency, shadow_errors, crash_loop, insufficient_samples,
+    # superseded, or the hot-swap's own rollback reason)
+    "canary_rejected": ("candidate", "checkpoint", "reason"),
     # goodput ledger (obs/ledger.py): one per epoch window — `seconds`
     # and `fractions` map every CATEGORIES entry (compute/data_stall/
     # collective/checkpoint/compile/guard_recovery/eval/other) to its

@@ -161,11 +161,32 @@ def build_report(records: List[Dict]) -> Dict:
         (r for r in reversed(records) if r["event"] == "mesh_shape"), None
     )
 
+    # canary ladder (serve/canary.py): every candidate's journey from
+    # publication to verdict, in stream order — a rejected candidate's
+    # reason string is the post-mortem
+    canary = []
+    for r in records:
+        if r["event"] not in (
+            "candidate_published", "canary_started",
+            "canary_promoted", "canary_rejected",
+        ):
+            continue
+        canary.append(
+            {
+                "event": r["event"],
+                "candidate": r.get("candidate"),
+                "checkpoint": r.get("checkpoint"),
+                "samples": _num(r.get("samples")),
+                "reason": r.get("reason"),
+            }
+        )
+
     counts = {
         key: sum(1 for r in records if r["event"] == key)
         for key in (
             "compile", "stall", "checkpoint_saved", "checkpoint_restored",
             "guard_skip", "guard_restore", "resume", "staged", "fit_chunk",
+            "candidate_published", "canary_promoted", "canary_rejected",
         )
     }
     counts["profile_done"] = sum(
@@ -204,6 +225,18 @@ def build_report(records: List[Dict]) -> Dict:
             desc = f"epoch={r.get('epoch')}"
         elif ev == "profile":
             desc = f"{r.get('status')} ({r.get('trace_dir', '')})"
+        elif ev in ("candidate_published", "canary_started"):
+            desc = f"candidate={r.get('candidate')} {r.get('checkpoint')}"
+        elif ev == "canary_promoted":
+            desc = (
+                f"candidate={r.get('candidate')} {r.get('checkpoint')} "
+                f"samples={r.get('samples')}"
+            )
+        elif ev == "canary_rejected":
+            desc = (
+                f"candidate={r.get('candidate')} {r.get('checkpoint')}: "
+                f"{r.get('reason')}"
+            )
         else:
             continue
         timeline.append(
@@ -230,6 +263,7 @@ def build_report(records: List[Dict]) -> Dict:
             "mesh_axes": mesh.get("axes") if mesh else None,
         },
         "epochs": epochs,
+        "canary": canary,
         "throughput": throughput,
         "programs": programs,
         "collectives": collectives,
@@ -385,6 +419,22 @@ def _summary_lines(report) -> List[str]:
     return lines
 
 
+_CANARY_HEADERS = ("event", "candidate", "checkpoint", "samples", "reason")
+
+
+def _canary_rows(report) -> List[List[str]]:
+    return [
+        [
+            str(c.get("event") or "-"),
+            _fmt(c.get("candidate")),
+            str(c.get("checkpoint") or "-"),
+            _fmt(c.get("samples"), 4),
+            str(c.get("reason") or "-"),
+        ]
+        for c in report.get("canary", [])
+    ]
+
+
 def _goodput_cols(report):
     """(headers, rows) of the per-epoch goodput table — epoch, wall, and
     one fraction column per category that ever appeared."""
@@ -419,6 +469,9 @@ def render_text(report: Dict) -> str:
         lines += ["", "-- goodput (wall-time fraction per category) --"]
         headers, rows = _goodput_cols(report)
         lines += _text_table(headers, rows)
+    if report.get("canary"):
+        lines += ["", "-- canary ladder (publish -> shadow -> verdict) --"]
+        lines += _text_table(list(_CANARY_HEADERS), _canary_rows(report))
     if report["programs"]:
         lines += ["", "-- compiled programs (XLA cost/memory) --"]
         lines += _text_table(
@@ -450,6 +503,9 @@ def render_markdown(report: Dict) -> str:
         lines += ["", "## Goodput (wall-time fraction per category)", ""]
         headers, rows = _goodput_cols(report)
         lines += _md_table(headers, rows)
+    if report.get("canary"):
+        lines += ["", "## Canary ladder (publish -> shadow -> verdict)", ""]
+        lines += _md_table(list(_CANARY_HEADERS), _canary_rows(report))
     if report["programs"]:
         lines += ["", "## Compiled programs (XLA cost/memory)", ""]
         lines += _md_table(

@@ -26,6 +26,11 @@ from hydragnn_tpu.serve.buckets import (
     plan_from_layout,
     plan_from_samples,
 )
+from hydragnn_tpu.serve.canary import (
+    CanaryController,
+    CanaryGates,
+    CanaryMetrics,
+)
 from hydragnn_tpu.serve.fleet import (
     FleetMetrics,
     ReplicaServer,
@@ -33,7 +38,12 @@ from hydragnn_tpu.serve.fleet import (
 )
 from hydragnn_tpu.serve.http import ObservabilityServer
 from hydragnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
-from hydragnn_tpu.serve.registry import ModelEntry, ModelRegistry
+from hydragnn_tpu.serve.registry import (
+    CandidateChannel,
+    ModelEntry,
+    ModelRegistry,
+    publish_candidate,
+)
 from hydragnn_tpu.serve.router import (
     FleetRouter,
     NoLiveReplica,
@@ -48,6 +58,10 @@ from hydragnn_tpu.serve.server import (
 
 __all__ = [
     "BucketCapacity",
+    "CanaryController",
+    "CanaryGates",
+    "CanaryMetrics",
+    "CandidateChannel",
     "DeadlineExceeded",
     "FleetMetrics",
     "FleetRouter",
@@ -67,4 +81,5 @@ __all__ = [
     "ServingFleet",
     "plan_from_layout",
     "plan_from_samples",
+    "publish_candidate",
 ]

@@ -82,6 +82,11 @@ from hydragnn_tpu.obs.metrics import MetricsRegistry
 from hydragnn_tpu.utils import faults
 
 REPLICA = "replica"  # coord kind AND member prefix for fleet leases
+# canary replicas lease under a DIFFERENT kind (<dir>/canarys/): the
+# router's discovery scan globs replicas/ only, so a canary is invisible
+# to routing and capacity math by construction — no filtering logic to
+# get wrong (serve/canary.py is the sole consumer of these leases)
+CANARY = "canary"
 
 # serving leases turn over much faster than training ones: a replica
 # outage is user-visible latency, not a lost epoch
@@ -247,10 +252,15 @@ class ReplicaServer:
         model_name: Optional[str] = None,
         arch_config: Optional[dict] = None,
         poll_s: float = 0.1,
+        role: str = REPLICA,
     ):
+        if role not in (REPLICA, CANARY):
+            raise ValueError(f"unknown replica role {role!r}")
         self.server = server
         self.coord_dir = coord_dir
         self.replica_id = int(replica_id)
+        self.role = role
+        self.is_canary = role == CANARY
         self.incarnation = int(incarnation)
         self.model_name = model_name or (
             server.default_model or server.registry.names()[0]
@@ -288,8 +298,11 @@ class ReplicaServer:
         base_version = self.server.registry.get(self.model_name).version
         # catch up on an already-published active version BEFORE taking
         # traffic: a replica respawned mid/after a promote must come up
-        # serving what the fleet serves, not the stale base checkpoint
-        self._catch_up_promotes()
+        # serving what the fleet serves, not the stale base checkpoint.
+        # A CANARY never catches up: it exists to serve exactly the
+        # candidate it booted with, not whatever the fleet promoted
+        if not self.is_canary:
+            self._catch_up_promotes()
         self.server.start()  # warms every registered model per bucket
         # PIN the currently-active version: without an explicit promote
         # the registry serves the LATEST registered version, so merely
@@ -318,19 +331,24 @@ class ReplicaServer:
             self._state = "serving"
         self.heartbeat = coord.Heartbeat(
             coord.hb_path(
-                self.coord_dir, REPLICA, self.replica_id, prefix=REPLICA
+                self.coord_dir, self.role, self.replica_id,
+                prefix=self.role,
             ),
             self._lease_payload,
             self.heartbeat_s,
         ).start()
-        watch = threading.Thread(
-            target=self._watch_promotes,
-            name=f"hydragnn-promote-watch-{self.replica_id}",
-            daemon=True,
-        )
-        watch.start()
-        with self._lock:
-            self._watch_thread = watch
+        if not self.is_canary:
+            # a canary runs NO promote watcher: following active.json
+            # would flip it off its candidate, and acking the fleet's
+            # promote commands would corrupt the all-replica quorum
+            watch = threading.Thread(
+                target=self._watch_promotes,
+                name=f"hydragnn-promote-watch-{self.replica_id}",
+                daemon=True,
+            )
+            watch.start()
+            with self._lock:
+                self._watch_thread = watch
         return self
 
     @property
@@ -358,6 +376,7 @@ class ReplicaServer:
             active_info = None
         return {
             "replica": self.replica_id,
+            "role": self.role,
             "gen": self.incarnation,
             "state": state,
             "port": port,
@@ -481,6 +500,11 @@ class ReplicaServer:
             ordinal = self._served
             self._served += 1
         faults.slow_replica(ordinal)
+        if self.is_canary:
+            # bad-candidate injections fire ONLY on the canary role —
+            # a fleet-wide env can regress the candidate under test but
+            # never a live replica's answers or latency
+            faults.slow_candidate(ordinal)
         try:
             graph = decode_graph(payload["graph"])
         except (KeyError, ValueError, TypeError):
@@ -531,6 +555,11 @@ class ReplicaServer:
             )
         except Exception as e:  # dispatch error: failed, not dropped
             return 500, {"error": str(e)}, {}
+        if self.is_canary and faults.nan_candidate(ordinal + 1):
+            heads = [
+                np.full(np.shape(np.asarray(h)), np.nan, np.float32)
+                for h in heads
+            ]
         return (
             200,
             {
@@ -1364,6 +1393,10 @@ def replica_main(spec_path: str) -> int:
         ),
         model_name=name,
         arch_config=arch,
+        # the canary controller spawns this same entry point with
+        # HYDRAGNN_FLEET_CANARY=1: same server, canary lease namespace,
+        # no promote watcher
+        role=CANARY if os.getenv("HYDRAGNN_FLEET_CANARY") else REPLICA,
     )
     replica.serve_forever()
     return 0

@@ -27,12 +27,28 @@ the genuinely previous version). :meth:`rollback` re-activates the
 version that was serving before the last effective promote; both record
 nothing but the activation — entries stay frozen and registered, so a
 rolled-back candidate remains inspectable.
+
+**Publication channel** (the train -> serve handoff,
+``serve/canary.py``): :class:`CandidateChannel` is a file-backed queue
+of candidate checkpoint SNAPSHOTS under one root directory. The
+training side (rank 0, end-of-epoch cadence, ordered behind the
+async-checkpoint writer so a snapshot is only ever taken of a durable
+checkpoint) calls :func:`publish_candidate`; the canary controller
+consumes ``pending()`` manifests, proves each candidate against live
+traffic, and pins the promoted/rollback-base versions so retention GC
+(:meth:`CandidateChannel.gc`, the keep-last-K mirror of the PR 1
+rolling-checkpoint policy) can never collect a version the fleet might
+still need to serve or revert to.
 """
 
 import dataclasses
+import glob
 import json
 import os
+import re
+import shutil
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -264,3 +280,174 @@ class ModelRegistry:
     def __len__(self):
         with self._lock:
             return len(self._entries)
+
+
+# ---- candidate publication channel -----------------------------------------
+
+
+class CandidateChannel:
+    """File-backed train -> serve candidate queue under one root dir::
+
+        <root>/candidates/cand-<seq:06d>.json   # manifest (commit point)
+        <root>/versions/v<seq:06d>/<ck>/<ck>.pk # checkpoint SNAPSHOT
+        <root>/promoted.json                    # {active_seq, base_seq}
+
+    ``publish`` COPIES the checkpoint into a per-seq version directory
+    before writing the manifest: the training side's rolling saves
+    overwrite ``<name>.pk`` in place, so a consumer loading the
+    publisher's live path could read a half-written or newer file. The
+    snapshot directory keeps the ``<path>/<name>/<name>.pk`` layout the
+    strict loader (and ``ServingFleet.promote``) already reads, and the
+    atomic manifest write is the commit point — a consumer never sees a
+    manifest whose snapshot is incomplete.
+
+    Single publisher (training rank 0), any number of consumers. All
+    methods are safe to call concurrently with a consumer's reads.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._cand_dir = os.path.join(root, "candidates")
+        self._ver_dir = os.path.join(root, "versions")
+
+    # -- paths ---------------------------------------------------------------
+    def manifest_path(self, seq: int) -> str:
+        return os.path.join(self._cand_dir, f"cand-{int(seq):06d}.json")
+
+    def version_dir(self, seq: int) -> str:
+        """The snapshot dir for ``seq`` — usable directly as the ``path``
+        of a strict checkpoint load or a fleet promote."""
+        return os.path.join(self._ver_dir, f"v{int(seq):06d}")
+
+    # -- publisher side ------------------------------------------------------
+    def publish(self, checkpoint: str, path: str,
+                **meta) -> Dict:
+        """Snapshot ``<path>/<checkpoint>/<checkpoint>.pk`` (plus its
+        ``config.json`` when present) as the next candidate version and
+        commit its manifest. Extra ``meta`` (epoch, val_loss, run name)
+        rides along for the controller's event payloads."""
+        from hydragnn_tpu import coord
+
+        seq = self.latest_seq() + 1
+        src = os.path.join(path, checkpoint)
+        src_pk = os.path.join(src, f"{checkpoint}.pk")
+        if not os.path.exists(src_pk):
+            raise FileNotFoundError(
+                f"cannot publish {checkpoint!r}: {src_pk} does not exist"
+            )
+        dst = os.path.join(self.version_dir(seq), checkpoint)
+        # a crashed previous publish may have left a manifest-less
+        # version dir under this seq — overwrite it, the manifest never
+        # committed so nothing can be reading it
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        os.makedirs(dst, exist_ok=True)
+        # copy to a temp name + rename so even the snapshot file itself
+        # is never observable half-written
+        tmp = os.path.join(dst, f".{checkpoint}.pk.tmp")
+        shutil.copyfile(src_pk, tmp)
+        os.replace(tmp, os.path.join(dst, f"{checkpoint}.pk"))
+        cfg = os.path.join(src, "config.json")
+        if os.path.exists(cfg):
+            shutil.copyfile(cfg, os.path.join(dst, "config.json"))
+        manifest = {
+            "seq": seq,
+            "checkpoint": checkpoint,
+            "path": os.path.abspath(self.version_dir(seq)),
+            "source_path": os.path.abspath(path),
+            "ts": time.time(),
+        }
+        manifest.update(meta)
+        coord.write_json(self.manifest_path(seq), manifest)
+        return manifest
+
+    # -- consumer side -------------------------------------------------------
+    def _seqs(self) -> List[int]:
+        out = []
+        for p in glob.glob(os.path.join(self._cand_dir, "cand-*.json")):
+            m = re.search(r"cand-(\d+)\.json$", p)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_seq(self) -> int:
+        seqs = self._seqs()
+        return seqs[-1] if seqs else 0
+
+    def read(self, seq: int) -> Optional[Dict]:
+        from hydragnn_tpu import coord
+
+        return coord.read_json(self.manifest_path(seq))
+
+    def pending(self, after_seq: int = 0) -> List[Dict]:
+        """Committed manifests with ``seq > after_seq``, oldest first."""
+        out = []
+        for seq in self._seqs():
+            if seq <= after_seq:
+                continue
+            man = self.read(seq)
+            if man is not None:
+                out.append(man)
+        return out
+
+    # -- retention -----------------------------------------------------------
+    def record_promotion(self, seq: int):
+        """Pin ``seq`` as the ACTIVE published version; the previously
+        active one becomes the rollback BASE pin. Both survive any GC —
+        the fleet may be serving one and reverting onto the other."""
+        from hydragnn_tpu import coord
+
+        pins = coord.read_json(
+            os.path.join(self.root, "promoted.json")
+        ) or {}
+        coord.write_json(
+            os.path.join(self.root, "promoted.json"),
+            {"active_seq": int(seq),
+             "base_seq": pins.get("active_seq"),
+             "ts": time.time()},
+        )
+
+    def pinned(self) -> set:
+        from hydragnn_tpu import coord
+
+        pins = coord.read_json(
+            os.path.join(self.root, "promoted.json")
+        ) or {}
+        return {
+            int(s) for s in (pins.get("active_seq"), pins.get("base_seq"))
+            if s is not None
+        }
+
+    def gc(self, keep_last: int) -> List[int]:
+        """Collect published versions outside the newest ``keep_last``,
+        never touching the pinned active/rollback-base versions — the
+        keep-last-K mirror of the training side's rolling-checkpoint
+        retention. Manifest goes first (consumers discover through it),
+        then the snapshot dir. Returns the collected seqs."""
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        seqs = self._seqs()
+        keep = set(seqs[-keep_last:]) | self.pinned()
+        removed = []
+        for seq in seqs:
+            if seq in keep:
+                continue
+            try:
+                os.remove(self.manifest_path(seq))
+            except OSError:
+                continue  # already collected by a racing GC
+            shutil.rmtree(self.version_dir(seq), ignore_errors=True)
+            removed.append(seq)
+        return removed
+
+
+def publish_candidate(root: str, checkpoint: str, path: str,
+                      keep_last: Optional[int] = None, **meta) -> Dict:
+    """One-shot publish into the channel at ``root`` (the training-side
+    convenience ``epoch_driver`` calls): snapshot + manifest, then
+    retention GC when ``keep_last`` is given. Returns the manifest."""
+    channel = CandidateChannel(root)
+    manifest = channel.publish(checkpoint, path, **meta)
+    if keep_last is not None:
+        channel.gc(keep_last)
+    return manifest

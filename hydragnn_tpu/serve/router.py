@@ -147,6 +147,20 @@ class FleetRouter:
         self._target_ts = float("-inf")  # fleet.json cache, same TTL
         self._target_cached: Optional[int] = None
         self._rr = 0  # round-robin cursor
+        # shadow tap (serve/canary.py): called with every successful
+        # response so a canary controller can mirror live traffic —
+        # MUST be non-blocking and may never raise into the live path
+        self._shadow = None
+
+    # ---- shadow routing ------------------------------------------------
+    def set_shadow(self, tap) -> None:
+        """Install ``tap(graph, body, latency_s)`` on the success path.
+        The tap sees the routed graph and the full response body of
+        every 200 AFTER the client's answer is already decided — a
+        shadow comparison can never change, delay (the tap's contract is
+        to enqueue-or-drop, never block) or fail a live response. Pass
+        ``None`` to detach."""
+        self._shadow = tap
 
     # ---- discovery -----------------------------------------------------
     def _scan(self, now: Optional[float] = None) -> List[Tuple[int, int]]:
@@ -326,6 +340,15 @@ class FleetRouter:
                 self.metrics.on_response_latency(now - t0)
                 if deadline is not None:
                     self.metrics.on_deadline(now <= deadline)
+                shadow = self._shadow
+                if shadow is not None:
+                    try:
+                        shadow(graph, body, now - t0)
+                    except Exception:
+                        # the shadow path can NEVER fail a live
+                        # response — a broken tap is the canary's
+                        # problem, not the client's
+                        pass
                 if raw:
                     return body
                 return [np.asarray(h) for h in body["heads"]]

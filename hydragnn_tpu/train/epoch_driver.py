@@ -171,6 +171,72 @@ def train_validate_test(
     # Drained at end of run (and by the elastic watchdog on preemption).
     ckpt_writer = resolve_async_writer(training)
 
+    # canary publication (HYDRAGNN_PUBLISH_DIR / Training.publish_dir):
+    # each resume-cadence save also snapshots the checkpoint into the
+    # serving side's CandidateChannel for SLO-gated canary promotion
+    # (serve/canary.py). Rank 0 only, and — crucially — the publish
+    # thunk rides the SAME async writer queue as the save: the writer
+    # executes in strict submission order, so the snapshot is only ever
+    # taken of a fully durable (fsync'd + renamed) checkpoint.
+    publish_dir = os.getenv(
+        "HYDRAGNN_PUBLISH_DIR", training.get("publish_dir") or ""
+    ) or None
+    publish_every = int(
+        os.getenv(
+            "HYDRAGNN_PUBLISH_EVERY", str(training.get("publish_every", 1))
+        )
+    )
+    publish_keep = int(
+        os.getenv(
+            "HYDRAGNN_PUBLISH_KEEP",
+            str(training.get("publish_keep_last", 4)),
+        )
+    )
+
+    def _publish_candidate(epoch, val_loss=None):
+        if publish_dir is None:
+            return
+        if publish_every > 0 and (epoch + 1) % publish_every != 0:
+            return
+        from hydragnn_tpu.parallel.distributed import get_comm_size_and_rank
+
+        _, rank = get_comm_size_and_rank()
+        if rank != 0:
+            return
+
+        def _do_publish():
+            from hydragnn_tpu.serve.registry import publish_candidate
+
+            try:
+                manifest = publish_candidate(
+                    publish_dir,
+                    log_name,
+                    checkpoint_path,
+                    keep_last=publish_keep,
+                    epoch=int(epoch),
+                    val_loss=(
+                        None if val_loss is None else float(val_loss)
+                    ),
+                )
+            except OSError as e:
+                # a full/unwritable publish dir must not kill a run that
+                # would otherwise finish — serving just sees no candidate
+                print_distributed(
+                    verbosity, f"candidate publish failed: {e}"
+                )
+                return
+            obs.emit(
+                "candidate_published",
+                candidate=int(manifest["seq"]),
+                checkpoint=manifest["checkpoint"],
+                epoch=int(epoch),
+            )
+
+        if ckpt_writer is not None:
+            ckpt_writer.submit(_do_publish)
+        else:
+            _do_publish()
+
     def _stream_state():
         """Streaming loaders expose their mix cursor; everything else
         contributes no ``stream`` section to the resume meta."""
@@ -501,6 +567,9 @@ def train_validate_test(
                 )
                 trainer.final_train_meta = fit_meta
                 trainer.final_state_saved = True
+                _publish_candidate(
+                    epoch0 - 1, val_loss=series["val_loss"][n - 1]
+                )
             if bool(np.asarray(sched.stopped)):
                 ep_stop = epoch0 - n + int(np.argmax(series["stopped"]))
                 print_distributed(
@@ -665,6 +734,7 @@ def train_validate_test(
             # checkpoint still carries loop state (continue = no-op resume)
             trainer.final_train_meta = meta
             trainer.final_state_saved = True
+            _publish_candidate(epoch, val_loss=val_loss)
         if stopping:
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             obs.emit("early_stop", epoch=int(epoch))
@@ -688,6 +758,7 @@ def train_validate_test(
                 )
                 trainer.final_train_meta = meta
                 trainer.final_state_saved = True
+                _publish_candidate(epoch, val_loss=val_loss)
             print_distributed(
                 verbosity, "Stopping: not enough job wall-clock time left"
             )

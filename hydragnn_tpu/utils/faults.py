@@ -52,6 +52,16 @@ Serving-side knobs (consumed by ``serve/fleet.py`` replicas and
   corruption (the shared original is untouched — other replicas must
   see the pristine file). Exercises the promote -> reject -> rollback
   path with the old version still serving.
+- ``HYDRAGNN_FAULT_NAN_CANDIDATE=K`` — the ``K``-th request a CANARY
+  replica serves answers with every head full of NaN (1-based; ``all``
+  poisons every canary answer). The call site gates on the replica's
+  canary role, so a globally-set knob can never poison live traffic —
+  it exercises the canary controller's hard NaN veto.
+- ``HYDRAGNN_FAULT_SLOW_CANDIDATE=SPEC@SECONDS`` — sleep ``SECONDS``
+  before dispatching each canary request whose 0-based ordinal is
+  covered by ``SPEC`` (NAN_AT_STEP grammar; ``SECONDS`` defaults to
+  0.25). Canary-only for the same reason: exercises the per-bucket
+  latency-regression gate without touching live SLOs.
 
 Counters are process-global and monotonic; :func:`reset` exists for tests
 that exercise several scenarios in one process.
@@ -202,6 +212,35 @@ def slow_replica(request_ordinal: int) -> None:
     if _this_replica() != target:
         return
     if _parse_step_spec(step_spec)(int(request_ordinal)):
+        time.sleep(float(secs) if secs else 0.25)
+
+
+def nan_candidate(request_ordinal: int) -> bool:
+    """Bad-candidate injection: True when the canary replica's request
+    at ``request_ordinal`` (1-based, the replica's own accepted-request
+    counter) should answer all-NaN heads. Spec is the 1-based ordinal
+    (``all`` = every request). The ONLY call site is the canary branch
+    of ``ReplicaServer.handle_predict`` — live replicas never consult
+    this knob, so setting it fleet-wide cannot corrupt live answers."""
+    spec = os.getenv("HYDRAGNN_FAULT_NAN_CANDIDATE")
+    if spec is None:
+        return False
+    if spec == "all":
+        return True
+    return int(spec) == int(request_ordinal)
+
+
+def slow_candidate(request_ordinal: int) -> None:
+    """Latency-regression injection: sleep before dispatching each
+    canary request whose 0-based ordinal is covered. Spec is
+    ``"SPEC@SECONDS"`` (``"0:50@0.2"`` slows the first 50 shadow
+    requests by 0.2 s); ``SECONDS`` defaults to 0.25. Canary-only, same
+    call-site gate as :func:`nan_candidate`."""
+    spec = os.getenv("HYDRAGNN_FAULT_SLOW_CANDIDATE")
+    if spec is None:
+        return
+    member, _, secs = spec.partition("@")
+    if _parse_step_spec(member)(int(request_ordinal)):
         time.sleep(float(secs) if secs else 0.25)
 
 

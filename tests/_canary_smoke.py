@@ -1,0 +1,212 @@
+"""CI canary-promotion smoke (standalone, NOT a pytest module).
+
+The train->serve flywheel's last mile, end to end with real processes:
+a 2-replica :class:`ServingFleet` under closed-loop client load, a
+:class:`CanaryController` consuming a :class:`CandidateChannel`, and a
+SUBPROCESS canary replica per candidate —
+
+1. a POISONED candidate (``HYDRAGNN_FAULT_NAN_CANDIDATE=all``, the
+   canary-only NaN injection) is shadow-evaluated and REJECTED with a
+   schema-valid ``canary_rejected`` carrying the ``nan_outputs`` veto;
+   the active version never blinks,
+2. a good candidate accumulates shadow evidence from mirrored live
+   traffic, passes every gate, and is PROMOTED through the all-acked
+   hot-swap — with ZERO failed live requests across both phases and
+   zero live requests ever routed to the canary.
+
+Validates the whole event stream against the documented schema and
+prints the shadow overhead (samples / shed / gate latency) the bench
+tracks.
+
+Usage: python tests/_canary_smoke.py <workdir>
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_CLIENTS = 2
+REQUEST_DEADLINE_S = 30.0
+DECISION_TIMEOUT_S = 300.0
+
+
+def main(workdir):
+    os.makedirs(workdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    import _fleet_smoke
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.serve import (
+        CanaryController,
+        CanaryGates,
+        CandidateChannel,
+        FleetRouter,
+        ServerOverloaded,
+    )
+    from hydragnn_tpu.serve.fleet import ServingFleet
+
+    spec_path, ckdir, samples = _fleet_smoke.build_artifacts(workdir)
+    coord_dir = os.path.join(workdir, "coord")
+    log_dir = os.path.join(workdir, "log")
+    fleet = ServingFleet(
+        coord_dir,
+        2,
+        spec_path=spec_path,
+        heartbeat_s=0.1,
+        lease_s=0.75,
+        poll_s=0.05,
+        log_dir=log_dir,
+    )
+    fleet.start(wait_serving=True, timeout=300)
+    assert fleet.health()["live"] == 2, fleet.health()
+
+    router = FleetRouter(
+        coord_dir,
+        lease_s=0.75,
+        scan_interval_s=0.1,
+        max_attempts=6,
+        retry_base_delay_s=0.05,
+    )
+
+    channel = CandidateChannel(os.path.join(workdir, "chan"))
+    # the bumped candidate legitimately disagrees with base (+0.05 on
+    # every param), so the MAE tolerance is wide open here: this smoke
+    # locks the PIPELINE (publish -> shadow -> gates -> swap), the gate
+    # decision table itself is unit-locked in tests/test_canary.py
+    gates = CanaryGates(
+        min_samples=8,
+        min_bucket_samples=1,
+        head_mae_tol=100.0,
+        head_mae_rel_tol=100.0,
+        latency_ratio_tol=100.0,
+        latency_slack_s=5.0,
+        max_crashes=2,
+        decide_timeout_s=DECISION_TIMEOUT_S,
+    )
+    controller = CanaryController(
+        fleet,
+        channel,
+        spec_path,
+        fraction=0.5,
+        gates=gates,
+        poll_s=0.05,
+        boot_timeout_s=240.0,
+        heartbeat_s=0.1,
+    )
+    controller.attach(router)  # mirror live 200s into the shadow queue
+    controller.start()
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    results = []
+    failures = []
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            g = samples[int(rng.integers(0, len(samples)))]
+            try:
+                raw = router.route(
+                    g, deadline_s=REQUEST_DEADLINE_S, raw=True
+                )
+                outcome = ("ok", raw["replica"], raw["version"])
+            except ServerOverloaded:
+                outcome = ("shed", None, None)
+            except Exception as e:
+                outcome = ("failed", None, None)
+                with lock:
+                    failures.append(repr(e))
+            with lock:
+                results.append(outcome)
+
+    clients = [
+        threading.Thread(target=client, args=(200 + i,), daemon=True)
+        for i in range(NUM_CLIENTS)
+    ]
+    for t in clients:
+        t.start()
+
+    try:
+        # phase 1: poisoned candidate -> NaN veto, never promoted
+        os.environ["HYDRAGNN_FAULT_NAN_CANDIDATE"] = "all"
+        t0 = time.monotonic()
+        channel.publish("cand", ckdir, note="poisoned")
+        dec1 = controller.wait_decision(1, timeout=DECISION_TIMEOUT_S)
+        reject_s = time.monotonic() - t0
+        assert dec1["verdict"] == "rejected", dec1
+        assert dec1["reason"].startswith("nan_outputs"), dec1
+        del os.environ["HYDRAGNN_FAULT_NAN_CANDIDATE"]
+        raw = router.route(
+            samples[0], deadline_s=REQUEST_DEADLINE_S, raw=True
+        )
+        assert raw["version"] == 1, raw  # active never blinked
+
+        # phase 2: good candidate -> gates pass -> all-acked hot-swap
+        t0 = time.monotonic()
+        channel.publish("cand", ckdir, note="good")
+        dec2 = controller.wait_decision(2, timeout=DECISION_TIMEOUT_S)
+        promote_s = time.monotonic() - t0
+        assert dec2["verdict"] == "promoted", dec2
+        assert dec2["samples"] >= gates.min_samples, dec2
+        seen = set()
+        for _ in range(12):
+            raw = router.route(
+                samples[0], deadline_s=REQUEST_DEADLINE_S, raw=True
+            )
+            seen.add((raw["replica"], raw["version"]))
+        assert all(v == 2 for _, v in seen), seen
+        assert len({r for r, _ in seen}) == 2, seen
+        time.sleep(0.5)
+    finally:
+        stop.set()
+        for t in clients:
+            t.join(timeout=60)
+        controller.stop()
+        fleet.stop()
+
+    with lock:
+        done = list(results)
+        failed = list(failures)
+    # ZERO failed live requests through both canary phases — the shadow
+    # path and the swap never cost a client anything
+    assert not failed, f"{len(failed)} failed live request(s): {failed[:5]}"
+    assert all(r in (0, 1) for o, r, _ in done if o == "ok"), (
+        "a live request reached a non-fleet replica"
+    )
+    n_ok = sum(1 for o, _, _ in done if o == "ok")
+    assert n_ok > 0, "no live traffic served"
+
+    recs = validate_events(
+        os.path.join(log_dir, "events.jsonl"),
+        require=[
+            "canary_started", "canary_rejected", "canary_promoted",
+            "model_promoted",
+        ],
+    )
+    rejected = [r for r in recs if r["event"] == "canary_rejected"][0]
+    assert rejected["candidate"] == 1, rejected
+    assert rejected["reason"].startswith("nan_outputs"), rejected
+    promoted = [r for r in recs if r["event"] == "canary_promoted"][0]
+    assert promoted["candidate"] == 2, promoted
+    assert promoted["samples"] >= gates.min_samples, promoted
+    assert channel.pinned() == {2}
+
+    snap = controller.metrics.snapshot()
+    print(
+        "canary smoke OK: poisoned rejected in {:.1f}s ({}), good promoted "
+        "in {:.1f}s ({} shadow samples, {} shed, {} live requests, "
+        "0 failed)".format(
+            reject_s, rejected["reason"].split(":")[0], promote_s,
+            int(snap["shadow_samples_total"]),
+            int(snap["shadow_shed_total"]), n_ok,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,600 @@
+"""SLO-gated canary promotion (serve/canary.py + serve/registry.py
+publication channel).
+
+Acceptance (ISSUE 16): training publishes candidate snapshots into a
+``CandidateChannel``; a ``CanaryController`` shadow-routes a fraction of
+live traffic to a canary replica and promotes through statistical gates
+(per-head MAE, per-bucket latency, NaN/error vetoes, min-sample floors)
+or rejects loudly. Chaos locks: a crash-looping / NaN-emitting /
+latency-regressing candidate can NEVER reach active; the shadow path
+can never degrade live SLOs (canary invisible to the router's capacity
+math, shadow shed before any priority lane).
+
+The subprocess publish->shadow->promote e2e lives in
+``tests/_canary_smoke.py`` (the CI gate) with a ``slow``-marked wrapper
+here; everything in-process below reuses the test_serve harness so the
+tier-1 cost stays one jit warmup.
+"""
+
+import json
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu import coord
+from hydragnn_tpu.serve import (
+    CanaryController,
+    CanaryGates,
+    CandidateChannel,
+    FleetRouter,
+    InferenceServer,
+    ModelRegistry,
+    ReplicaServer,
+    ServerOverloaded,
+    publish_candidate,
+)
+from hydragnn_tpu.serve.buckets import plan_from_samples
+from hydragnn_tpu.serve.canary import _CandidateStats, evaluate_gates
+from hydragnn_tpu.serve.fleet import CANARY
+from hydragnn_tpu.utils import faults
+
+from test_models_forward import arch_config
+from test_serve import _graph, _harness
+
+
+# ---- publication channel ---------------------------------------------------
+
+
+def pytest_candidate_channel_snapshot_pending_pins_gc(tmp_path):
+    """publish() snapshots the checkpoint BEFORE committing the manifest
+    (the training side's rolling saves overwrite in place), pending() is
+    a committed-only oldest-first cursor, and GC keeps last-K plus the
+    active/rollback-base pins."""
+    src = tmp_path / "ck" / "m"
+    src.mkdir(parents=True)
+    (src / "m.pk").write_bytes(b"weights-v1")
+    ch = CandidateChannel(str(tmp_path / "chan"))
+    assert ch.latest_seq() == 0 and ch.pending() == []
+    man1 = ch.publish("m", str(tmp_path / "ck"), epoch=0)
+    assert man1["seq"] == 1 and man1["epoch"] == 0
+    snap1 = os.path.join(man1["path"], "m", "m.pk")
+    assert open(snap1, "rb").read() == b"weights-v1"
+    # the publisher overwrites its live file; the committed snapshot
+    # must not move under the consumer
+    (src / "m.pk").write_bytes(b"weights-v2")
+    man2 = ch.publish("m", str(tmp_path / "ck"))
+    assert open(snap1, "rb").read() == b"weights-v1"
+    assert open(
+        os.path.join(man2["path"], "m", "m.pk"), "rb"
+    ).read() == b"weights-v2"
+    assert [m["seq"] for m in ch.pending()] == [1, 2]
+    assert [m["seq"] for m in ch.pending(after_seq=1)] == [2]
+    # a torn manifest is invisible to consumers (commit point honored)
+    with open(ch.manifest_path(3), "w") as f:
+        f.write('{"seq": 3, "torn')
+    assert [m["seq"] for m in ch.pending()] == [1, 2]
+    os.remove(ch.manifest_path(3))
+    for _ in (3, 4):
+        ch.publish("m", str(tmp_path / "ck"))
+    # promotion pins: the new active + the previous active (rollback base)
+    ch.record_promotion(2)
+    assert ch.pinned() == {2}
+    ch.record_promotion(4)
+    assert ch.pinned() == {2, 4}
+    removed = ch.gc(keep_last=1)
+    assert removed == [1, 3]  # 4 = last-K, {2, 4} = pins
+    assert ch.read(1) is None and not os.path.isdir(ch.version_dir(3))
+    assert [m["seq"] for m in ch.pending()] == [2, 4]
+    with pytest.raises(ValueError, match="keep_last"):
+        ch.gc(0)
+    # the one-shot training-side convenience: publish + retention
+    publish_candidate(str(tmp_path / "chan"), "m", str(tmp_path / "ck"),
+                      keep_last=1)
+    assert [m["seq"] for m in ch.pending()] == [2, 4, 5]
+    with pytest.raises(FileNotFoundError):
+        ch.publish("ghost", str(tmp_path / "ck"))
+
+
+# ---- fault-injection knobs (inert unset, exact fire point) -----------------
+
+
+def pytest_fault_nan_and_slow_candidate_unit(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_FAULT_NAN_CANDIDATE", raising=False)
+    monkeypatch.delenv("HYDRAGNN_FAULT_SLOW_CANDIDATE", raising=False)
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", sleeps.append)
+    for i in range(4):  # both knobs inert when unset
+        assert faults.nan_candidate(i + 1) is False
+        faults.slow_candidate(i)
+    assert sleeps == []
+    # NaN: the configured 1-based ordinal only, or every request
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_CANDIDATE", "2")
+    assert [faults.nan_candidate(k) for k in (1, 2, 3)] == [
+        False, True, False,
+    ]
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_CANDIDATE", "all")
+    assert all(faults.nan_candidate(k) for k in (1, 2, 9))
+    # slow: fires exactly once at the configured 0-based ordinal
+    monkeypatch.setenv("HYDRAGNN_FAULT_SLOW_CANDIDATE", "3@0.1")
+    for i in range(6):
+        faults.slow_candidate(i)
+    assert sleeps == [0.1]
+    # range spec (NAN_AT_STEP grammar) + the 0.25 s default
+    monkeypatch.setenv("HYDRAGNN_FAULT_SLOW_CANDIDATE", "0:2@0.2")
+    for i in range(4):
+        faults.slow_candidate(i)
+    assert sleeps == [0.1, 0.2, 0.2]
+    monkeypatch.setenv("HYDRAGNN_FAULT_SLOW_CANDIDATE", "5")
+    faults.slow_candidate(5)
+    assert sleeps == [0.1, 0.2, 0.2, 0.25]
+
+
+# ---- gates: pure decision table --------------------------------------------
+
+
+def _stats(**over):
+    base = {
+        "samples": 10, "errors": 0, "nans": 0,
+        "head_mae": {0: 1e-4, 1: 1e-4},
+        "head_live_mag": {0: 1.0, 1: 1.0},
+        "buckets": {0: {"n": 5, "live_mean_s": 0.010,
+                        "canary_mean_s": 0.012}},
+    }
+    base.update(over)
+    return base
+
+
+def pytest_evaluate_gates_decision_table():
+    gates = CanaryGates(
+        min_samples=4, min_bucket_samples=2, head_mae_tol=1e-3,
+        head_mae_rel_tol=0.1, latency_ratio_tol=2.0, latency_slack_s=0.0,
+        max_shadow_errors=0,
+    )
+    assert evaluate_gates(_stats(), gates)["verdict"] == "promote"
+    # vetoes precede everything — one NaN rejects even below the floor
+    d = evaluate_gates(_stats(samples=0, nans=1), gates)
+    assert d["verdict"] == "reject" and d["reason"].startswith("nan_outputs")
+    d = evaluate_gates(_stats(errors=1), gates)
+    assert d["verdict"] == "reject"
+    assert d["reason"].startswith("shadow_errors")
+    # below the floor: wait, never promote on thin evidence
+    assert evaluate_gates(_stats(samples=3), gates)["verdict"] == "wait"
+    # head MAE vs max(abs tol, rel tol x live magnitude)
+    d = evaluate_gates(_stats(head_mae={0: 0.2, 1: 1e-4}), gates)
+    assert d["verdict"] == "reject" and "head_mae: head 0" in d["reason"]
+    assert evaluate_gates(  # 0.05 <= 0.1 * |live|: rel tol admits it
+        _stats(head_mae={0: 0.05, 1: 1e-4}), gates
+    )["verdict"] == "promote"
+    # per-bucket latency: mean canary > live x ratio + slack rejects,
+    # but a bucket under min_bucket_samples carries no verdict weight
+    slow = {0: {"n": 5, "live_mean_s": 0.010, "canary_mean_s": 0.030}}
+    d = evaluate_gates(_stats(buckets=slow), gates)
+    assert d["verdict"] == "reject" and "latency: bucket 0" in d["reason"]
+    thin = {0: {"n": 1, "live_mean_s": 0.010, "canary_mean_s": 9.0}}
+    assert evaluate_gates(
+        _stats(buckets=thin), gates
+    )["verdict"] == "promote"
+    # every failed gate is named in the reason, not just the first
+    d = evaluate_gates(
+        _stats(head_mae={0: 0.2, 1: 1e-4}, buckets=slow), gates
+    )
+    assert "head_mae" in d["reason"] and "latency" in d["reason"]
+
+
+def pytest_candidate_stats_nan_veto_and_accumulation():
+    s = _CandidateStats()
+    assert s.add_sample(
+        [np.ones(4)], [np.full(4, 1.1)], bucket=0,
+        live_latency_s=0.01, canary_latency_s=0.03,
+    )
+    # a non-finite canary answer is a veto, never a sample
+    assert not s.add_sample(
+        [np.ones(4)], [np.array([1.0, np.nan, 1.0, 1.0])], bucket=0,
+        live_latency_s=0.01, canary_latency_s=0.03,
+    )
+    snap = s.snapshot()
+    assert snap["samples"] == 1 and snap["nans"] == 1
+    assert snap["head_mae"][0] == pytest.approx(0.1)
+    assert snap["head_live_mag"][0] == pytest.approx(1.0)
+    assert snap["buckets"][0]["n"] == 1
+    assert snap["buckets"][0]["canary_mean_s"] == pytest.approx(0.03)
+
+
+# ---- controller harness ----------------------------------------------------
+
+
+class _StubFleet:
+    """The supervisor surface the controller needs, promotion recorded
+    instead of executed."""
+
+    def __init__(self, coord_dir, spec_path=None, promote_result=None):
+        self.coord_dir = coord_dir
+        self.spec_path = spec_path
+        self.lease_s = 2.0
+        self.events = []
+        self.promotes = []
+        self._result = promote_result or {
+            "status": "promoted", "cmd_id": 1, "versions": {0: 2, 1: 2},
+            "propagated": True, "acks": {},
+        }
+
+    def emit(self, event, **fields):
+        self.events.append((event, fields))
+
+    def promote(self, checkpoint, path=None, arch_config=None, name=None,
+                timeout=None):
+        self.promotes.append({"checkpoint": checkpoint, "path": path,
+                              "name": name})
+        return dict(self._result)
+
+
+def _write_spec(tmp_path, **extra):
+    spec = {"model_name": "m", "checkpoint": {"name": "x", "path": "y"}}
+    spec.update(extra)
+    path = str(tmp_path / "spec.json")
+    with open(path, "w") as f:
+        json.dump(spec, f)
+    return path
+
+
+# ---- shadow tap: shed-first contract ---------------------------------------
+
+
+def pytest_shadow_tap_sheds_degraded_then_queue_full(tmp_path):
+    """The tap never blocks and never queues work a degraded fleet (or a
+    full queue) cannot afford: degraded sheds FIRST, queue-full sheds
+    next, and a disarmed tap is a no-op — all counted."""
+    d = str(tmp_path / "coord")
+    os.makedirs(d)
+    stub = _StubFleet(d, _write_spec(tmp_path))
+    c = CanaryController(
+        stub, str(tmp_path / "chan"), fraction=0.5, queue_capacity=4,
+        heartbeat_s=0.0,  # degraded cache: always re-read
+    )
+    g = object()  # the tap never inspects the graph
+    c.shadow_tap(g, {"heads": [[1.0]]}, 0.01)  # disarmed: ignored
+    assert c._q.qsize() == 0
+    c._armed.set()
+    for _ in range(8):  # stride 2: ordinals 0,2,4,6 eligible -> queue 4
+        c.shadow_tap(g, {"heads": [[1.0]]}, 0.01)
+    snap = c.metrics.snapshot()
+    assert c._q.qsize() == 4 and snap["shadow_shed_total"] == 0
+    for _ in range(2):  # ordinal 8 eligible, queue full -> shed
+        c.shadow_tap(g, {"heads": [[1.0]]}, 0.01)
+    assert c.metrics.snapshot()["shadow_shed_total"] == 1
+    # a degraded fleet sheds shadow work before anything else
+    time.sleep(0.01)
+    coord.write_json(
+        os.path.join(d, "fleet.json"),
+        {"live": 1, "target": 2, "degraded": True, "ts": time.time()},
+    )
+    for _ in range(2):
+        c.shadow_tap(g, {"heads": [[1.0]]}, 0.01)
+    assert c.metrics.snapshot()["shadow_shed_total"] == 2
+    assert c._q.qsize() == 4  # nothing slipped past the shed
+
+
+# ---- crash loop / boot timeout / supersede (stub factory, no serving) ------
+
+
+def pytest_canary_crash_loop_supersede_and_boot_timeout(tmp_path):
+    src = tmp_path / "ck" / "c1"
+    src.mkdir(parents=True)
+    (src / "c1.pk").write_bytes(b"blob")
+    root = str(tmp_path / "chan")
+    ch = CandidateChannel(root)
+    ch.publish("c1", str(tmp_path / "ck"))
+    ch.publish("c1", str(tmp_path / "ck"))
+    d = str(tmp_path / "coord")
+    os.makedirs(d)
+
+    class _DeadHandle:
+        def alive(self):
+            return False
+
+        def stop(self):
+            pass
+
+    spawned = []
+
+    def factory(spec_path, canary_id, incarnation):
+        spawned.append((canary_id, incarnation))
+        return _DeadHandle()
+
+    stub = _StubFleet(d, _write_spec(tmp_path))
+    gates = CanaryGates(max_crashes=1, min_samples=4)
+    c = CanaryController(
+        stub, root, poll_s=0.01, gates=gates, replica_factory=factory,
+    )
+    with c:
+        # only the NEWEST pending candidate gets shadow budget; older
+        # unevaluated ones are already-stale training states
+        d1 = c.wait_decision(1, timeout=30)
+        assert d1["verdict"] == "rejected"
+        assert d1["reason"] == "superseded by seq 2"
+        # death -> respawn once (the budget) -> death -> crash_loop
+        d2 = c.wait_decision(2, timeout=30)
+        assert d2["verdict"] == "rejected"
+        assert d2["reason"].startswith("crash_loop: candidate died 2")
+    assert spawned == [(2, 0), (2, 1)]  # same candidate, next incarnation
+    assert stub.promotes == []  # a crash-looping candidate NEVER promotes
+    snap = c.metrics.snapshot()
+    assert snap["crashes_total"] == 2 and snap["rejects_total"] == 2
+    assert [e for e, _ in stub.events].count("canary_rejected") == 2
+    assert [e for e, _ in stub.events].count("canary_started") == 1
+
+    # a candidate alive but never serving burns the boot timeout, not
+    # the respawn budget — and is rejected as unproven, not promoted
+    class _WedgedHandle(_DeadHandle):
+        def alive(self):
+            return True
+
+    stub2 = _StubFleet(d, _write_spec(tmp_path))
+    c2 = CanaryController(
+        stub2, root, poll_s=0.01, boot_timeout_s=0.2, gates=gates,
+        replica_factory=lambda *a: _WedgedHandle(),
+    )
+    ch.publish("c1", str(tmp_path / "ck"))
+    with c2:
+        d3 = c2.wait_decision(3, timeout=30)
+    assert d3["verdict"] == "rejected"
+    assert "never reached serving" in d3["reason"]
+    assert stub2.promotes == []
+
+
+# ---- router exclusion: canary invisible to live traffic --------------------
+
+
+def _fresh_server(**kw):
+    h = _harness()
+    registry = ModelRegistry()
+    registry.register("sage", h["model"], h["state"].params,
+                      h["state"].batch_stats)
+    kw.setdefault("max_wait_s", 0.002)
+    return InferenceServer(registry, h["plan"], default_model="sage", **kw)
+
+
+def pytest_router_excludes_canary_and_shadow_sheds_before_lanes(tmp_path):
+    """A canary replica in flight is invisible to the router BY
+    CONSTRUCTION (it leases under ``canarys/``, outside the discovery
+    glob): zero live requests reach it, it never counts toward the
+    degradation ladder's capacity math, and while the fleet is degraded
+    the shadow tap sheds while the priority-0 lane is still admitted."""
+    d = str(tmp_path / "coord")
+    live = ReplicaServer(_fresh_server(), d, 0, heartbeat_s=0.05)
+    live.start()
+    canary = ReplicaServer(
+        _fresh_server(), d, 9, heartbeat_s=0.05, role=CANARY,
+    )
+    canary.start()
+    try:
+        assert os.path.exists(
+            os.path.join(d, "canarys", "canary-9.json")
+        )
+        lease = coord.read_json(
+            coord.hb_path(d, CANARY, 9, prefix=CANARY)
+        )
+        assert lease["role"] == CANARY and lease["state"] == "serving"
+        # the supervisor's capacity math says degraded: 1 live of 2 —
+        # the serving canary must not paper over the missing replica
+        coord.write_json(
+            os.path.join(d, "fleet.json"),
+            {"live": 1, "target": 2, "degraded": True, "ts": time.time()},
+        )
+        router = FleetRouter(
+            d, lanes={"interactive": 0, "batch": 1},
+            shed_priority_when_degraded=1, lease_s=2.0,
+            scan_interval_s=0.0, max_attempts=2, retry_base_delay_s=0.001,
+        )
+        assert router.degraded()
+        rng = np.random.default_rng(7)
+        replicas_seen = set()
+        for _ in range(6):
+            raw = router.route(
+                _graph(int(rng.integers(4, 30)), rng, with_targets=False),
+                lane="interactive", deadline_s=30.0, raw=True,
+            )
+            replicas_seen.add(raw["replica"])
+        assert replicas_seen == {0}  # the canary took ZERO live requests
+        with canary._lock:
+            assert canary._served == 0
+        # degraded shed order: shadow tap first, batch lane second, the
+        # interactive lane (above) still admitted
+        stub = _StubFleet(d, _write_spec(tmp_path))
+        c = CanaryController(stub, str(tmp_path / "chan"),
+                             fraction=1.0, heartbeat_s=0.0)
+        c._armed.set()
+        c.shadow_tap(object(), {"heads": [[1.0]]}, 0.01)
+        assert c.metrics.snapshot()["shadow_shed_total"] == 1
+        assert c._q.qsize() == 0
+        g = _graph(8, rng, with_targets=False)
+        with pytest.raises(ServerOverloaded):
+            router.route(g, lane="batch", deadline_s=30.0)
+    finally:
+        canary.shutdown()
+        live.shutdown()
+
+
+# ---- controller e2e: veto -> latency gate -> promote -----------------------
+
+
+def pytest_canary_controller_vetoes_gates_then_promotes(
+    tmp_path, monkeypatch
+):
+    """Three candidates through a REAL in-process canary replica: the
+    NaN-emitting one is vetoed, the latency-regressing one fails its
+    bucket gate, the healthy one promotes — recording the promotion pin
+    and emitting the full event ladder. The fleet promote itself is
+    stubbed (locked by test_fleet); this locks the decision plumbing."""
+    from hydragnn_tpu.train.checkpoint import save_model
+
+    h = _harness()
+    ckdir = str(tmp_path / "ck")
+    save_model(h["state"], "base", path=ckdir)
+    rng = np.random.default_rng(21)
+    samples = [_graph(int(n), rng) for n in rng.integers(4, 40, 24)]
+    samples_path = str(tmp_path / "samples.pkl")
+    with open(samples_path, "wb") as f:
+        pickle.dump(samples, f)
+    plan_kw = {"max_batch_graphs": 4, "num_buckets": 2}
+    arch = arch_config("SAGE")
+    spec_path = _write_spec(
+        tmp_path, checkpoint={"name": "base", "path": ckdir},
+        arch=arch, samples=samples_path, plan=plan_kw,
+    )
+    plan = plan_from_samples(samples, **plan_kw)
+    coord_dir = str(tmp_path / "coord")
+    os.makedirs(coord_dir)
+
+    reps = []
+
+    class _InProcHandle:
+        def __init__(self, rep):
+            self.rep = rep
+            self._dead = False
+
+        def alive(self):
+            return not self._dead
+
+        def stop(self):
+            self._dead = True
+            self.rep.shutdown()
+
+    def factory(cand_spec_path, canary_id, incarnation):
+        with open(cand_spec_path) as f:
+            cand_spec = json.load(f)
+        registry = ModelRegistry()
+        registry.load_checkpoint(
+            cand_spec["checkpoint"]["name"], arch_config=arch,
+            path=cand_spec["checkpoint"]["path"], name="m",
+        )
+        rep = ReplicaServer(
+            InferenceServer(registry, plan, default_model="m",
+                            max_wait_s=0.002),
+            coord_dir, canary_id, heartbeat_s=0.05,
+            incarnation=incarnation, model_name="m", arch_config=arch,
+            role=CANARY,
+        )
+        rep.start()
+        reps.append(rep)
+        return _InProcHandle(rep)
+
+    # a live-side server over the same base weights: the shadow compare
+    # target (identical params -> MAE 0 for the healthy candidate)
+    live_reg = ModelRegistry()
+    live_reg.load_checkpoint("base", arch_config=arch, path=ckdir,
+                             name="m")
+    live = InferenceServer(live_reg, plan, default_model="m",
+                           max_wait_s=0.002)
+    pairs = []
+    with live:
+        for g in samples[:4]:
+            pairs.append((g, [np.asarray(o) for o in
+                              live.predict(g, timeout=30)]))
+
+    root = str(tmp_path / "chan")
+    ch = CandidateChannel(root)
+    stub = _StubFleet(coord_dir, spec_path)
+    gates = CanaryGates(
+        min_samples=4, min_bucket_samples=1, head_mae_tol=5e-3,
+        latency_ratio_tol=2.0, latency_slack_s=0.2, max_crashes=1,
+        decide_timeout_s=120.0,
+    )
+    c = CanaryController(
+        stub, ch, spec_path, fraction=1.0, gates=gates, poll_s=0.02,
+        boot_timeout_s=120.0,
+    )
+    c._factory = factory
+
+    def feed_until_decided(seq, live_latency_s=0.05, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with c._lock:
+                if any(dec["seq"] == seq for dec in c.decisions):
+                    break
+            if c._armed.is_set():
+                for g, heads in pairs:
+                    c.shadow_tap(g, {"heads": heads}, live_latency_s)
+            time.sleep(0.05)
+        return c.wait_decision(seq, timeout=10.0)
+
+    with c:
+        # 1. NaN-emitting candidate: hard veto, loud rejection
+        monkeypatch.setenv("HYDRAGNN_FAULT_NAN_CANDIDATE", "all")
+        ch.publish("base", ckdir)
+        d1 = feed_until_decided(1)
+        assert d1["verdict"] == "rejected"
+        assert d1["reason"].startswith("nan_outputs")
+        monkeypatch.delenv("HYDRAGNN_FAULT_NAN_CANDIDATE")
+        # 2. latency regression: every shadow request slowed past the
+        #    bucket gate (live 0.05 s x 2.0 + 0.2 s slack < 0.5 s)
+        monkeypatch.setenv("HYDRAGNN_FAULT_SLOW_CANDIDATE", "0:999@0.5")
+        ch.publish("base", ckdir)
+        d2 = feed_until_decided(2)
+        assert d2["verdict"] == "rejected"
+        assert "latency: bucket" in d2["reason"]
+        assert d2["samples"] >= gates.min_samples
+        monkeypatch.delenv("HYDRAGNN_FAULT_SLOW_CANDIDATE")
+        assert stub.promotes == []  # neither bad candidate reached active
+        # 3. healthy candidate: all gates pass -> the hot-swap fires
+        ch.publish("base", ckdir)
+        d3 = feed_until_decided(3)
+        assert d3["verdict"] == "promoted"
+        assert d3["samples"] >= gates.min_samples
+        assert d3["gate_latency_s"] >= 0
+    assert [p["checkpoint"] for p in stub.promotes] == ["base"]
+    assert stub.promotes[0]["path"] == ch.read(3)["path"]  # the snapshot
+    assert ch.pinned() == {3}  # promotion recorded for retention GC
+    events = [e for e, _ in stub.events]
+    assert events.count("canary_started") == 3
+    assert events.count("canary_rejected") == 2
+    assert events.count("canary_promoted") == 1
+    rejected = [f for e, f in stub.events if e == "canary_rejected"]
+    assert {f["candidate"] for f in rejected} == {1, 2}
+    snap = c.metrics.snapshot()
+    assert snap["promotes_total"] == 1 and snap["rejects_total"] == 2
+    assert snap["nan_vetoes_total"] == 1
+    assert snap["shadow_samples_total"] >= 2 * gates.min_samples
+    # every canary replica the controller booted was torn down, and the
+    # live side never routed to any of them
+    assert all(r._state == "stopped" for r in reps)
+    assert "hydragnn_canary_promotes_total 1" in (
+        c.metrics.render_prometheus()
+    )
+
+
+def pytest_canary_promote_rollback_chains_reason(tmp_path):
+    """When the quality gates pass but the mechanical hot-swap rolls
+    back (strict load refused on a replica, ack timeout), the canary
+    verdict is still a loud rejection with the fleet's reason chained —
+    never a silent success."""
+    d = str(tmp_path / "coord")
+    os.makedirs(d)
+    stub = _StubFleet(
+        d, _write_spec(tmp_path),
+        promote_result={"status": "rolled_back", "reason": "corrupt pk"},
+    )
+    c = CanaryController(stub, str(tmp_path / "chan"), fraction=1.0)
+    manifest = {"seq": 5, "checkpoint": "cand", "path": "/x",
+                "ts": time.time()}
+    with c._lock:
+        c._cand = manifest
+    c._promote(manifest, {"samples": 30})
+    d5 = c.wait_decision(5, timeout=5)
+    assert d5["verdict"] == "rejected"
+    assert d5["reason"] == "hot_swap_rolled_back: corrupt pk"
+    assert c.metrics.snapshot()["rejects_total"] == 1
+
+
+# ---- subprocess e2e (the CI smoke, wrapped) -------------------------------
+
+
+@pytest.mark.slow  # replica + canary processes x jax import + warmup
+def pytest_canary_smoke_e2e(tmp_path):
+    import _canary_smoke
+
+    _canary_smoke.main(str(tmp_path / "smoke"))
