@@ -23,10 +23,22 @@ and a CRC-corrupt candidate whose canary crash-loops at boot and is
 rejected without the fleet ever swapping (canary_reject) — reporting
 per-phase p50/p99 latency, SLO-miss rate, and measured availability.
 
+``--fleet --diurnal`` (ISSUE 17) is the multi-tenant capacity model:
+N tenants (odd tenants pinned to a SECOND model — two checkpoints
+HBM-packed per replica) x M priority lanes x a repeating diurnal load
+curve (trough/ramp/peak/evening), with the response cache in front and
+the predictive autoscaler closing the loop via ``ServingFleet.resize``.
+Each phase row reports per-tenant p99/SLO-miss and prices
+cost-per-million-requests from integrated replica-seconds; run two
+periods and the second peak shows the forecast pre-scaling.
+
 Usage: ``python benchmarks/serve_bench.py [--num=512] [--clients=8]
 [--buckets=3] [--batch=8] [--hidden=64] [--wait-ms=5]`` or
 ``python benchmarks/serve_bench.py --fleet [--replicas=2] [--clients=4]
 [--phase-s=4] [--deadline-ms=2000] [--batch-frac=0.25] [--hidden=16]``
+or ``python benchmarks/serve_bench.py --fleet --diurnal [--tenants=2]
+[--lanes=2] [--periods=2] [--base-rps=24] [--capacity-rps=20]
+[--unique-frac=0.7] [--cost-per-replica-hour=1.0]``
 
 Output: one JSON object per configuration / fault-schedule phase (the
 BENCH_* line style, appendable).
@@ -455,7 +467,295 @@ def run_fleet(replicas, clients, phase_s, deadline_s, batch_frac,
     return rows
 
 
+# ---- multi-tenant diurnal capacity bench (ISSUE 17) ------------------------
+
+# one synthetic "day": phase name -> load multiplier on --base-rps. The
+# peak is sized to overrun the configured per-replica capacity so the
+# autoscaler has something to do; the trough is where it walks back.
+DIURNAL_CURVE = [
+    ("trough", 0.3),
+    ("ramp", 1.0),
+    ("peak", 3.0),
+    ("evening", 0.5),
+]
+
+
+def _tenantize_spec(spec_path, ckdir, arch, tenants):
+    """Rewrite the fleet spec for N tenants: odd tenants pin the bumped
+    'cand' checkpoint (two DISTINCT models HBM-packed per replica), even
+    tenants share the base model; response cache on."""
+    with open(spec_path) as f:
+        spec = json.load(f)
+    names = []
+    for i in range(tenants):
+        t = {"name": f"t{i}", "quota": 32}
+        if i % 2 == 1:
+            t["model"] = "cand"
+            t["checkpoint"] = {
+                "name": "cand", "path": ckdir, "arch": arch,
+            }
+        else:
+            t["model"] = spec["model_name"]
+        names.append(t["name"])
+        spec.setdefault("tenants", []).append(t)
+    spec["cache"] = {"enabled": True}
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    return names
+
+
+def _diurnal_row(label, recs, deadline_s, tenant_names, **extra):
+    """One BENCH row per diurnal phase: fleet-wide aggregates plus the
+    per-tenant p99/SLO-miss split the capacity model prices."""
+    ok = [l for l, o, _, _ in recs if o == "ok"]
+    n_deadline = sum(1 for _, o, _, _ in recs if o == "deadline")
+    row = {
+        "mode": "fleet_diurnal",
+        "phase": label,
+        "deadline_ms": round(deadline_s * 1e3, 1),
+        "submitted": len(recs),
+        "ok": len(ok),
+        "shed": sum(1 for _, o, _, _ in recs if o == "shed"),
+        "deadline_missed": n_deadline,
+        "failed": sum(1 for _, o, _, _ in recs if o == "failed"),
+        "availability": round(len(ok) / max(len(recs), 1), 4),
+        "slo_miss_rate": round(
+            n_deadline / max(len(ok) + n_deadline, 1), 4
+        ),
+    }
+    if ok:
+        row.update(_pcts(ok))
+    per_tenant = {}
+    for name in tenant_names:
+        t_ok = [l for l, o, _, t in recs if t == name and o == "ok"]
+        t_dl = sum(
+            1 for _, o, _, t in recs if t == name and o == "deadline"
+        )
+        sub = {
+            "ok": len(t_ok),
+            "shed": sum(
+                1 for _, o, _, t in recs if t == name and o == "shed"
+            ),
+            "slo_miss_rate": round(
+                t_dl / max(len(t_ok) + t_dl, 1), 4
+            ),
+        }
+        if t_ok:
+            sub["p99_ms"] = _pcts(t_ok)["p99_ms"]
+        per_tenant[name] = sub
+    row["per_tenant"] = per_tenant
+    row.update(extra)
+    return row
+
+
+def run_fleet_diurnal(tenants, lanes, replicas, clients, phase_s, periods,
+                      deadline_s, base_rps, capacity_rps,
+                      cost_per_replica_hour, unique_frac, hidden, batch,
+                      buckets):
+    """N tenants x M lanes x a repeating diurnal curve against an
+    autoscaled fleet — the ROADMAP capacity model. Each phase row prices
+    cost-per-million-requests from integrated replica-seconds; the
+    second period is where the forecast starts anticipating the peak."""
+    import copy
+    import shutil
+    import tempfile
+    import threading
+
+    from hydragnn_tpu.serve import (
+        AutoscalePolicy,
+        FleetAutoscaler,
+        FleetRouter,
+        ResponseCache,
+        ServerOverloaded,
+    )
+    from hydragnn_tpu.serve.fleet import ServingFleet
+    from hydragnn_tpu.serve.server import DeadlineExceeded
+
+    workdir = tempfile.mkdtemp(prefix="hydragnn-mt-bench-")
+    rows = []
+    try:
+        spec_path, ckdir, arch, samples = _fleet_artifacts(
+            workdir, hidden, batch, buckets
+        )
+        tenant_names = _tenantize_spec(spec_path, ckdir, arch, tenants)
+        fleet = ServingFleet(
+            os.path.join(workdir, "coord"),
+            replicas,
+            spec_path=spec_path,
+            heartbeat_s=0.1,
+            lease_s=0.75,
+            poll_s=0.05,
+            log_dir=os.path.join(workdir, "log"),
+        )
+        t0 = time.perf_counter()
+        fleet.start(wait_serving=True, timeout=300)
+        boot_s = time.perf_counter() - t0
+        lane_names = [f"l{p}" for p in range(lanes)]
+        router = FleetRouter(
+            fleet.coord_dir,
+            lease_s=0.75,
+            scan_interval_s=0.1,
+            max_attempts=6,
+            retry_base_delay_s=0.05,
+            lanes={name: p for p, name in enumerate(lane_names)},
+            cache=ResponseCache(capacity=2048, max_bytes=64 << 20),
+        )
+        scaler = FleetAutoscaler(
+            fleet,
+            signals=router.autoscale_signals,
+            policy=AutoscalePolicy(
+                min_replicas=replicas,
+                max_replicas=replicas + 2,
+                capacity_rps=capacity_rps,
+                slo_budget=0.05,
+                up_cooldown_s=phase_s / 2,
+                down_cooldown_s=phase_s,
+                period_s=phase_s * len(DIURNAL_CURVE),
+                n_phases=len(DIURNAL_CURVE),
+            ),
+            interval_s=max(phase_s / 8, 0.5),
+        ).start()
+
+        stop = threading.Event()
+        lock = threading.Lock()
+        phase = [f"p0.{DIURNAL_CURVE[0][0]}"]
+        mult = [DIURNAL_CURVE[0][1]]
+        recs = {}  # phase -> [(latency_s, outcome, lane, tenant)]
+
+        def client(idx):
+            rng = np.random.default_rng(4000 + idx)
+            while not stop.is_set():
+                target = base_rps * mult[0]
+                interval = clients / max(target, 1e-6)
+                g = samples[int(rng.integers(len(samples)))]
+                if rng.random() < unique_frac:
+                    # a never-seen structure: must MISS the response
+                    # cache and land on a replica (the repeat fraction
+                    # is what the cache absorbs for free)
+                    g = copy.deepcopy(g)
+                    g.pos = (
+                        g.pos
+                        + rng.normal(scale=1e-3, size=g.pos.shape)
+                    ).astype(np.float32)
+                tenant = tenant_names[int(rng.integers(tenants))]
+                lane = lane_names[int(rng.integers(lanes))]
+                t1 = time.perf_counter()
+                try:
+                    router.route(
+                        g, lane=lane, tenant=tenant, deadline_s=deadline_s
+                    )
+                    outcome = "ok"
+                except ServerOverloaded:
+                    outcome = "shed"
+                except DeadlineExceeded:
+                    outcome = "deadline"
+                except Exception:
+                    outcome = "failed"
+                elapsed = time.perf_counter() - t1
+                with lock:
+                    recs.setdefault(phase[0], []).append(
+                        (elapsed, outcome, lane, tenant)
+                    )
+                pause = interval - elapsed
+                if pause > 0:
+                    stop.wait(min(pause, 0.5))
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+
+        phase_meta = {}
+        try:
+            for period in range(periods):
+                for name, m in DIURNAL_CURVE:
+                    label = f"p{period}.{name}"
+                    with lock:
+                        phase[0] = label
+                        mult[0] = m
+                    target0 = fleet.target
+                    replica_s = 0.0
+                    t_phase = time.perf_counter()
+                    while time.perf_counter() - t_phase < phase_s:
+                        time.sleep(0.1)
+                        replica_s += 0.1 * fleet.target
+                    phase_meta[label] = {
+                        "load_multiplier": m,
+                        "target_rps": round(base_rps * m, 1),
+                        "fleet_target_start": target0,
+                        "fleet_target_end": fleet.target,
+                        "replica_s": replica_s,
+                    }
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            scaler.stop()
+            cs = router.cache.stats()
+            fleet.emit(
+                "cache_stats", hits=cs["hits"], misses=cs["misses"],
+                evictions=cs["evictions"], bytes=cs["bytes"],
+            )
+            fleet.stop()
+
+        with lock:
+            per_phase = {p: list(v) for p, v in recs.items()}
+        total_replica_s = total_ok = 0
+        for label, meta in phase_meta.items():
+            phase_recs = per_phase.get(label, [])
+            n_ok = sum(1 for _, o, _, _ in phase_recs if o == "ok")
+            cost = (
+                meta["replica_s"] / 3600.0 * cost_per_replica_hour
+            )
+            rows.append(_diurnal_row(
+                label, phase_recs, deadline_s, tenant_names,
+                **{k: v for k, v in meta.items() if k != "replica_s"},
+                cost_per_m_req=round(cost / max(n_ok, 1) * 1e6, 4),
+            ))
+            total_replica_s += meta["replica_s"]
+            total_ok += n_ok
+        everything = [r for v in per_phase.values() for r in v]
+        total_cost = total_replica_s / 3600.0 * cost_per_replica_hour
+        rows.append(_diurnal_row(
+            "overall", everything, deadline_s, tenant_names,
+            tenants=tenants, lanes=lanes, periods=periods,
+            clients=clients, boot_s=round(boot_s, 2),
+            cache_hit_ratio=cs["hit_ratio"],
+            replica_s=round(total_replica_s, 1),
+            cost_per_m_req=round(
+                total_cost / max(total_ok, 1) * 1e6, 4
+            ),
+        ))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return rows
+
+
 def main():
+    if _arg("fleet") and (_arg("tenants") or _arg("diurnal")):
+        tenants = _arg("tenants", 2)
+        for row in run_fleet_diurnal(
+            tenants=2 if tenants is True else int(tenants),
+            lanes=int(_arg("lanes", 2)),
+            replicas=int(_arg("replicas", 2)),
+            clients=int(_arg("clients", 6)),
+            phase_s=float(_arg("phase-s", 5)),
+            periods=int(_arg("periods", 2)),
+            deadline_s=float(_arg("deadline-ms", 2000)) / 1e3,
+            base_rps=float(_arg("base-rps", 24)),
+            capacity_rps=float(_arg("capacity-rps", 20)),
+            cost_per_replica_hour=float(
+                _arg("cost-per-replica-hour", 1.0)
+            ),
+            unique_frac=float(_arg("unique-frac", 0.7)),
+            hidden=int(_arg("hidden", 16)),
+            batch=int(_arg("batch", 4)),
+            buckets=int(_arg("buckets", 2)),
+        ):
+            print(json.dumps(row), flush=True)
+        return
     if _arg("fleet"):
         for row in run_fleet(
             replicas=int(_arg("replicas", 2)),

@@ -145,6 +145,17 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # {mfu, flops, steps_per_sec, peak_flops}
     "goodput": ("epoch", "wall_s", "seconds", "fractions",
                 "goodput_fraction"),
+    # multi-tenant serving (serve/tenants.py): one per spec'd tenant at
+    # fleet start — the audit record of who is HBM-packed into the fleet
+    # with which model and what admission quota
+    "tenant_admitted": ("tenant", "model", "quota"),
+    # response cache (serve/cache.py): a measured traffic window's cache
+    # counters, appended by the bench/smoke load generators
+    "cache_stats": ("hits", "misses", "evictions", "bytes"),
+    # predictive autoscaler (serve/autoscale.py) / ServingFleet.resize:
+    # the supervised replica target moved (reason names the trigger —
+    # slo_pressure, forecast, scale_down, manual)
+    "fleet_scaled": ("old_target", "new_target", "reason"),
 }
 
 _ENVELOPE = ("event", "ts", "seq")

@@ -266,6 +266,12 @@ class ServeMetrics:
         self.queue_depth = 0
         self.deadline_met_total = 0
         self.deadline_missed_total = 0
+        # response-cache series (serve/cache.py): hits answer without a
+        # bucket slot, bytes is the cache's CURRENT payload residency
+        self.cache_hits_total = 0
+        self.cache_misses_total = 0
+        self.cache_evictions_total = 0
+        self.cache_bytes = 0
         self.request_latency = LatencyHistogram()
         self.batch_latency = LatencyHistogram()
 
@@ -309,6 +315,22 @@ class ServeMetrics:
     def on_compile(self):
         with self._lock:
             self.compiles_total += 1
+
+    def on_cache_hit(self, n: int = 1):
+        with self._lock:
+            self.cache_hits_total += n
+
+    def on_cache_miss(self, n: int = 1):
+        with self._lock:
+            self.cache_misses_total += n
+
+    def on_cache_evict(self, n: int = 1):
+        with self._lock:
+            self.cache_evictions_total += n
+
+    def set_cache_bytes(self, nbytes: int):
+        with self._lock:
+            self.cache_bytes = int(nbytes)
 
     def set_queue_depth(self, depth: int):
         with self._lock:
@@ -377,6 +399,10 @@ class ServeMetrics:
                     ),
                     6,
                 ),
+                "cache_hits_total": self.cache_hits_total,
+                "cache_misses_total": self.cache_misses_total,
+                "cache_evictions_total": self.cache_evictions_total,
+                "cache_bytes": self.cache_bytes,
                 "request_latency": self.request_latency.state(),
                 "batch_latency": self.batch_latency.state(),
             }
@@ -440,4 +466,28 @@ class ServeMetrics:
             s["slo_miss_ratio"],
             "Fraction of deadline-carrying requests that missed",
         )
+        # response-cache series appended after the SLO tail for the same
+        # reason the SLO tail followed the historical block: existing
+        # consumers' byte offsets stay put, the golden grows by this tail
+        counter(
+            "cache_hits_total",
+            s["cache_hits_total"],
+            "Requests answered from the response cache",
+        )
+        counter(
+            "cache_misses_total",
+            s["cache_misses_total"],
+            "Cache lookups that fell through to dispatch",
+        )
+        counter(
+            "cache_evictions_total",
+            s["cache_evictions_total"],
+            "Entries evicted by the LRU bounds",
+        )
+        lines.append(
+            f"# HELP {prefix}_cache_bytes Resident response-cache payload "
+            "bytes"
+        )
+        lines.append(f"# TYPE {prefix}_cache_bytes gauge")
+        lines.append(f"{prefix}_cache_bytes {s['cache_bytes']}")
         return "\n".join(lines) + "\n"

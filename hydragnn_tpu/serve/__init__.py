@@ -19,12 +19,21 @@ and node-count buckets — as a pad-once/compile-once request server:
         fut = server.submit(graph, deadline_s=0.1)       # async
 """
 
+from hydragnn_tpu.serve.autoscale import (
+    AutoscalePolicy,
+    FleetAutoscaler,
+    LoadForecast,
+)
 from hydragnn_tpu.serve.buckets import (
     BucketCapacity,
     GraphTooLarge,
     ServingBucketPlan,
     plan_from_layout,
     plan_from_samples,
+)
+from hydragnn_tpu.serve.cache import (
+    ResponseCache,
+    canonical_graph_key,
 )
 from hydragnn_tpu.serve.canary import (
     CanaryController,
@@ -55,17 +64,25 @@ from hydragnn_tpu.serve.server import (
     ServeFuture,
     ServerOverloaded,
 )
+from hydragnn_tpu.serve.tenants import (
+    TenantManager,
+    TenantOverQuota,
+    TenantSpec,
+)
 
 __all__ = [
+    "AutoscalePolicy",
     "BucketCapacity",
     "CanaryController",
     "CanaryGates",
     "CanaryMetrics",
     "CandidateChannel",
     "DeadlineExceeded",
+    "FleetAutoscaler",
     "FleetMetrics",
     "FleetRouter",
     "GraphTooLarge",
+    "LoadForecast",
     "InferenceServer",
     "LatencyHistogram",
     "ModelEntry",
@@ -73,12 +90,17 @@ __all__ = [
     "NoLiveReplica",
     "ObservabilityServer",
     "ReplicaServer",
+    "ResponseCache",
     "RetryBudget",
     "ServeFuture",
     "ServeMetrics",
     "ServerOverloaded",
     "ServingBucketPlan",
     "ServingFleet",
+    "TenantManager",
+    "TenantOverQuota",
+    "TenantSpec",
+    "canonical_graph_key",
     "plan_from_layout",
     "plan_from_samples",
     "publish_candidate",

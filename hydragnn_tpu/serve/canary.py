@@ -759,7 +759,9 @@ class CanaryController:
             manifest["checkpoint"],
             path=manifest["path"],
             arch_config=self._spec.get("arch"),
-            name=self._spec.get("model_name"),
+            # a multi-tenant channel can target any packed model: the
+            # candidate manifest's model_name wins over the spec default
+            name=manifest.get("model_name") or self._spec.get("model_name"),
             timeout=self.promote_timeout_s,
         )
         if res.get("status") == "promoted":

@@ -203,10 +203,21 @@ class FleetMetrics:
         r.labeled_gauge(
             "lane_retries_total", "Cumulative retries per admission lane"
         )
+        # per-TENANT shed/retry series (multi-tenant serving): a quota
+        # shed is the offending tenant's problem, not its lane's — the
+        # lane-global gauges alone would blame every tenant in the lane
+        r.labeled_gauge(
+            "tenant_shed_total", "Cumulative quota-shed requests per tenant"
+        )
+        r.labeled_gauge(
+            "tenant_retries_total", "Cumulative retried requests per tenant"
+        )
         self.registry = r
         self._lane_lock = threading.Lock()
         self._lane_shed: Dict[str, int] = {}
         self._lane_retries: Dict[str, int] = {}
+        self._tenant_shed: Dict[str, int] = {}
+        self._tenant_retries: Dict[str, int] = {}
 
     def on_lane_shed(self, lane: str):
         with self._lane_lock:
@@ -219,6 +230,22 @@ class FleetMetrics:
             self._lane_retries[lane] = self._lane_retries.get(lane, 0) + 1
             total = self._lane_retries[lane]
         self.registry.set_labeled("lane_retries_total", total, lane=lane)
+
+    def on_tenant_shed(self, tenant: str):
+        with self._lane_lock:
+            self._tenant_shed[tenant] = self._tenant_shed.get(tenant, 0) + 1
+            total = self._tenant_shed[tenant]
+        self.registry.set_labeled("tenant_shed_total", total, tenant=tenant)
+
+    def on_tenant_retry(self, tenant: str):
+        with self._lane_lock:
+            self._tenant_retries[tenant] = (
+                self._tenant_retries.get(tenant, 0) + 1
+            )
+            total = self._tenant_retries[tenant]
+        self.registry.set_labeled(
+            "tenant_retries_total", total, tenant=tenant
+        )
 
     def render_prometheus(self) -> str:
         return self.registry.render_prometheus()
@@ -278,24 +305,37 @@ class ReplicaServer:
         self._done = False
         self._lock = threading.Lock()  # guards counters + promote state
         self._served = 0
-        # promote bookkeeping: cmd_id -> warmed version (cmd 0 is the
-        # base checkpoint the replica booted with); _warm_versions is
-        # the set of versions ACTUALLY compiled per bucket — a switch
-        # onto anything outside it must warm first or the batcher pays
-        # the compile inline under traffic
-        self._warmed: Dict[int, int] = {}
+        # promote bookkeeping: cmd_id -> (name, warmed version); with
+        # tenants a replica serves MANY names, each with its own promote
+        # stream, so activation sequence and boot-time base version are
+        # tracked per name. _warm_versions is the set of (name, version)
+        # pairs ACTUALLY compiled per bucket — a switch onto anything
+        # outside it must warm first or the batcher pays the compile
+        # inline under traffic
+        self._warmed: Dict[int, tuple] = {}
         self._warm_versions: set = set()
+        self._base_versions: Dict[str, int] = {}
         self._last_cmd_handled = 0
-        self._active_seq = 0
+        self._active_seqs: Dict[str, int] = {}
+
+    def serving_names(self) -> List[str]:
+        """Every model name this replica serves (the default plus all
+        tenant-packed models) — the set a promote command may target."""
+        return self.server.registry.names()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ReplicaServer":
-        # the version this replica BOOTED with is the cmd-0 "base" a
-        # fleet rollback() reverts to — capture it before catching up,
-        # which registers (and activates) any published candidate as a
-        # NEWER version; recording the candidate as base would make a
-        # later rollback split serving versions across the fleet
-        base_version = self.server.registry.get(self.model_name).version
+        # the version each name BOOTED with is the cmd-0 "base" a fleet
+        # rollback() reverts to — capture BEFORE catching up, which
+        # registers (and activates) any published candidate as a NEWER
+        # version; recording the candidate as base would make a later
+        # rollback split serving versions across the fleet
+        bases = {
+            name: self.server.registry.get(name).version
+            for name in self.serving_names()
+        }
+        with self._lock:
+            self._base_versions = bases
         # catch up on an already-published active version BEFORE taking
         # traffic: a replica respawned mid/after a promote must come up
         # serving what the fleet serves, not the stale base checkpoint.
@@ -304,21 +344,25 @@ class ReplicaServer:
         if not self.is_canary:
             self._catch_up_promotes()
         self.server.start()  # warms every registered model per bucket
-        # PIN the currently-active version: without an explicit promote
-        # the registry serves the LATEST registered version, so merely
-        # registering a candidate mid-hot-swap would flip traffic onto
-        # unwarmed weights before the supervisor publishes. Promoting
-        # the current version makes activation explicit from here on.
-        self.server.registry.promote(
-            self.model_name,
-            self.server.registry.active_version(self.model_name),
-        )
-        with self._lock:
-            self._warmed.setdefault(0, base_version)
-            # server.start() warmed the ACTIVE version of every name
-            self._warm_versions.add(
-                self.server.registry.active_version(self.model_name)
+        # PIN every currently-active version: without an explicit
+        # promote the registry serves the LATEST registered version, so
+        # merely registering a candidate mid-hot-swap would flip traffic
+        # onto unwarmed weights before the supervisor publishes.
+        # Promoting the current version makes activation explicit.
+        for name in self.serving_names():
+            self.server.registry.promote(
+                name, self.server.registry.active_version(name)
             )
+        # server.start() warmed the ACTIVE version of every name
+        warm_now = {
+            (name, self.server.registry.active_version(name))
+            for name in self.serving_names()
+        }
+        with self._lock:
+            self._warmed.setdefault(
+                0, (self.model_name, bases[self.model_name])
+            )
+            self._warm_versions.update(warm_now)
         httpd = _ReplicaListener(("127.0.0.1", self._port), self._handler())
         thread = threading.Thread(
             target=httpd.serve_forever,
@@ -374,6 +418,15 @@ class ReplicaServer:
                            "source": active.source}
         except KeyError:
             active_info = None
+        # per-name active versions: the legacy "active" field covers the
+        # default serving name only; named (per-tenant) promotes verify
+        # propagation against this map
+        actives = {}
+        for name in self.serving_names():
+            try:
+                actives[name] = self.server.registry.active_version(name)
+            except KeyError:
+                pass
         return {
             "replica": self.replica_id,
             "role": self.role,
@@ -382,6 +435,7 @@ class ReplicaServer:
             "port": port,
             "served": served,
             "active": active_info,
+            "actives": actives,
             "done": done,
         }
 
@@ -510,17 +564,22 @@ class ReplicaServer:
         except (KeyError, ValueError, TypeError):
             return 400, {"error": "malformed graph payload"}, {}
         deadline_s = payload.get("deadline_s")
+        tenant = payload.get("tenant")
         try:
             fut = self.server.submit(
                 graph,
                 model=payload.get("model"),
                 deadline_s=deadline_s,
+                tenant=tenant,
             )
         except ServerOverloaded as e:
+            # a TenantOverQuota carries the offender's name: the router
+            # scopes its backoff to THAT tenant instead of the whole lane
             return (
                 503,
                 {"error": "overloaded",
-                 "retry_after_s": e.retry_after_s},
+                 "retry_after_s": e.retry_after_s,
+                 "tenant": getattr(e, "tenant", None)},
                 {"Retry-After": f"{e.retry_after_s:.3f}"},
             )
         except GraphTooLarge as e:
@@ -565,6 +624,11 @@ class ReplicaServer:
             {
                 "heads": [np.asarray(h).tolist() for h in heads],
                 "version": fut.version,
+                # which packed model answered: the cross-tenant isolation
+                # proof reads this (a tenant's responses must ALL carry
+                # its own model), and the router's cache keys put() on it
+                "model": fut.model_name,
+                "tenant": tenant,
                 "batch_seq": fut.batch_seq,
                 "replica": self.replica_id,
             },
@@ -637,9 +701,28 @@ class ReplicaServer:
             with self._lock:
                 self._last_cmd_handled = next_cmd
             next_cmd += 1
-        active = coord.read_json(os.path.join(pdir, "active.json"))
-        if active is not None:
+        for active in self._published_actives():
             self._apply_active(active)
+
+    def _published_actives(self) -> List[Dict]:
+        """Every published active-version file: the legacy fleet-wide
+        ``active.json`` plus one ``active-byname/<name>.json`` per model
+        name a NAMED (per-tenant) promote has targeted. Applying both for
+        the same name is safe — the per-name seq makes it idempotent."""
+        pdir = self._promote_dir()
+        out = []
+        legacy = coord.read_json(os.path.join(pdir, "active.json"))
+        if legacy is not None:
+            out.append(legacy)
+        bydir = os.path.join(pdir, "active-byname")
+        if os.path.isdir(bydir):
+            for fn in sorted(os.listdir(bydir)):
+                if not fn.endswith(".json"):
+                    continue
+                active = coord.read_json(os.path.join(bydir, fn))
+                if active is not None:
+                    out.append(active)
+        return out
 
     def _handle_promote_cmd(self, cmd: Dict):
         """Load + warm one candidate; ack warmed/failed. The old version
@@ -658,12 +741,13 @@ class ReplicaServer:
                     f"{warm['later_pass_compiles']} (want 0)"
                 )
             with self._lock:
-                self._warmed[cmd_id] = entry.version
-                self._warm_versions.add(entry.version)
+                self._warmed[cmd_id] = (entry.name, entry.version)
+                self._warm_versions.add((entry.name, entry.version))
             coord.write_json(
                 self._ack_path(cmd_id),
                 {"cmd_id": cmd_id, "replica": self.replica_id,
                  "status": "warmed", "version": entry.version,
+                 "name": entry.name,
                  "compiles": warm["first_pass_compiles"]},
             )
         except Exception as e:
@@ -679,13 +763,15 @@ class ReplicaServer:
         fault injection reroutes the read through a byte-flipped copy so
         the real CRC path rejects it."""
         checkpoint = cmd["checkpoint"]
-        if cmd.get("name") not in (None, self.model_name):
-            # the replica hot-swaps ITS serving name; a promote labeled
-            # with a different name would mislabel the event stream and
-            # never be routable — refuse loudly (acked "failed")
+        target = cmd.get("name") or self.model_name
+        if target not in self.serving_names():
+            # the replica hot-swaps names it SERVES (the default plus
+            # every tenant-packed model); a promote labeled with any
+            # other name would mislabel the event stream and never be
+            # routable — refuse loudly (acked "failed")
             raise ValueError(
                 f"promote names {cmd['name']!r} but this replica serves "
-                f"{self.model_name!r}"
+                f"{sorted(self.serving_names())}"
             )
         path = cmd["path"]
         real = os.path.join(path, checkpoint, f"{checkpoint}.pk")
@@ -706,91 +792,112 @@ class ReplicaServer:
             checkpoint,
             arch_config=cmd.get("arch") or self.arch_config,
             path=path,
-            name=self.model_name,
+            name=target,
         )
 
     def _apply_active(self, active: Dict):
-        """Follow the supervisor's published active version. The switch
-        is a registry promote: new submits resolve the new entry, batches
-        in flight keep theirs — the micro-batch boundary IS the swap."""
+        """Follow the supervisor's published active version for ONE
+        model name (the one the active file carries; the default serving
+        name when absent). The switch is a registry promote: new submits
+        resolve the new entry, batches in flight keep theirs — the
+        micro-batch boundary IS the swap."""
         seq = int(active.get("seq", 0))
+        target = active.get("name") or self.model_name
         with self._lock:
-            if seq <= self._active_seq:
+            if seq <= self._active_seqs.get(target, 0):
                 return
             cmd_id = int(active.get("cmd_id", 0))
-            version = self._warmed.get(cmd_id)
-        if version is None:
+            if cmd_id == 0:
+                # cmd 0 = the fleet rollback target: the base version of
+                # the named model this incarnation booted with
+                version = self._base_versions.get(target)
+            else:
+                warmed = self._warmed.get(cmd_id)
+                version = (
+                    warmed[1]
+                    if warmed is not None and warmed[0] == target
+                    else None
+                )
+        if version is None and int(active.get("cmd_id", 0)) != 0:
             # the published active references a candidate this replica
             # never warmed (respawned after the promote resolved, or the
             # startup active.json read raced the publish): adopt it now
             # — load, warm through the live batcher, then switch
+            cmd_id = int(active.get("cmd_id", 0))
             cmd = coord.read_json(self._cmd_path(cmd_id))
             if cmd is None:
                 return
             entry = self._load_candidate(cmd)
             self.server.warm_version(entry.name, entry.version)
             with self._lock:
-                self._warmed[cmd_id] = entry.version
-                self._warm_versions.add(entry.version)
+                self._warmed[cmd_id] = (entry.name, entry.version)
+                self._warm_versions.add((entry.name, entry.version))
             version = entry.version
+        if version is None:
+            return
         with self._lock:
-            warm_needed = version not in self._warm_versions
+            warm_needed = (target, version) not in self._warm_versions
         if warm_needed:
             # switching onto a registered-but-never-warmed version (a
             # respawned replica's booted base on a fleet rollback):
             # warm it through the live batcher FIRST, or every bucket's
             # first post-switch request pays a compile inline
-            self.server.warm_version(self.model_name, version)
+            self.server.warm_version(target, version)
             with self._lock:
-                self._warm_versions.add(version)
-        self.server.registry.promote(self.model_name, version)
+                self._warm_versions.add((target, version))
+        self.server.registry.promote(target, version)
         with self._lock:
-            self._active_seq = seq
+            self._active_seqs[target] = seq
 
     def _existing_cmds(self) -> int:
         return highest_cmd(self._promote_dir())
 
     def _catch_up_promotes(self):
-        """Startup: adopt the published active version before serving.
-        Loads ONLY the active candidate — commands already on disk are
-        NEVER replayed (their promotes resolved, or are resolving,
-        against quorums that predate this incarnation; re-warming a
-        rejected candidate on every respawn would burn compiles and
-        overwrite historical acks). Warmup of the adopted version happens
-        in ``server.start()``, which warms the active version of every
-        name."""
+        """Startup: adopt every published active version (fleet-wide AND
+        per-name) before serving. Loads ONLY the active candidates —
+        commands already on disk are NEVER replayed (their promotes
+        resolved, or are resolving, against quorums that predate this
+        incarnation; re-warming a rejected candidate on every respawn
+        would burn compiles and overwrite historical acks). Warmup of
+        the adopted versions happens in ``server.start()``, which warms
+        the active version of every name."""
         existing = self._existing_cmds()
-        active = coord.read_json(
-            os.path.join(self._promote_dir(), "active.json")
-        )
-        if active is None:
-            with self._lock:
-                self._last_cmd_handled = existing
-            return
+        with self._lock:
+            self._last_cmd_handled = existing
+        for active in self._published_actives():
+            self._catch_up_one(active, existing)
+
+    def _catch_up_one(self, active: Dict, existing: int):
+        target = active.get("name") or self.model_name
         cmd_id = int(active.get("cmd_id", 0))
+        seq = int(active.get("seq", 0))
         if cmd_id == 0:
             with self._lock:
-                self._active_seq = int(active.get("seq", 0))
+                self._active_seqs[target] = max(
+                    self._active_seqs.get(target, 0), seq
+                )
                 self._last_cmd_handled = max(
-                    existing, int(active.get("latest_cmd", 0))
+                    self._last_cmd_handled,
+                    int(active.get("latest_cmd", 0)),
                 )
             return
         cmd = coord.read_json(self._cmd_path(cmd_id))
         if cmd is None:
             # active references a torn/missing command: skip history and
             # let _apply_active's adopt path pick the version up live
-            with self._lock:
-                self._last_cmd_handled = existing
             return
         entry = self._load_candidate(cmd)
-        self.server.registry.promote(self.model_name, entry.version)
+        self.server.registry.promote(target, entry.version)
         with self._lock:
-            self._warmed[cmd_id] = entry.version
-            self._active_seq = int(active.get("seq", 0))
+            self._warmed[cmd_id] = (target, entry.version)
+            self._active_seqs[target] = max(
+                self._active_seqs.get(target, 0), seq
+            )
             # commands at or before the active one are history; later
             # ones (a promote racing our respawn) are handled live
             self._last_cmd_handled = max(
-                existing, cmd_id, int(active.get("latest_cmd", cmd_id))
+                self._last_cmd_handled, cmd_id,
+                int(active.get("latest_cmd", cmd_id)),
             )
 
 
@@ -860,6 +967,9 @@ class ServingFleet:
         self._replicas: Dict[int, _ReplicaHandle] = {
             rid: _ReplicaHandle(rid) for rid in range(self.target)
         }
+        # slots removed by a scale-down: their processes drain (SIGTERM)
+        # off the monitored set, but stop() still owns their teardown
+        self._retired: List[_ReplicaHandle] = []
         self._lock = threading.Lock()  # guards _replicas + counters
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -875,20 +985,32 @@ class ServingFleet:
     # -- lifecycle -----------------------------------------------------------
     def start(self, wait_serving: bool = True,
               timeout: Optional[float] = None) -> "ServingFleet":
-        for sub in (f"{REPLICA}s", "dead", "promote"):
+        for sub in (f"{REPLICA}s", "dead", "promote",
+                    os.path.join("promote", "active-byname")):
             os.makedirs(os.path.join(self.coord_dir, sub), exist_ok=True)
+        self._emit_tenant_admissions()
         # a supervisor RESTARTED on an existing coordination dir must
         # continue the promote sequence, not restart it: reusing cmd id
         # 1 would overwrite history and let stale ack files satisfy the
         # new promote without any replica having warmed it
         pdir = os.path.join(self.coord_dir, "promote")
+        seqs = [0]
         active = coord.read_json(os.path.join(pdir, "active.json"))
+        if active is not None:
+            seqs.append(int(active.get("seq", 0)))
+        bydir = os.path.join(pdir, "active-byname")
+        if os.path.isdir(bydir):
+            # named promotes publish per-name actives: the seq counter
+            # must clear THOSE too, or a restarted supervisor's next
+            # promote would be ignored as stale by every replica
+            for fn in os.listdir(bydir):
+                if fn.endswith(".json"):
+                    a = coord.read_json(os.path.join(bydir, fn))
+                    if a is not None:
+                        seqs.append(int(a.get("seq", 0)))
         with self._lock:
             self._next_cmd = max(self._next_cmd, highest_cmd(pdir))
-            self._active_seq = max(
-                self._active_seq,
-                0 if active is None else int(active.get("seq", 0)),
-            )
+            self._active_seq = max(self._active_seq, *seqs)
         for rid in range(self.target):
             self._spawn(self._replicas[rid])
         monitor = threading.Thread(
@@ -912,16 +1034,18 @@ class ServingFleet:
         self._stop.set()
         with self._lock:
             monitor, self._monitor = self._monitor, None
+            # snapshot: resize() mutates _replicas from other threads
+            handles = list(self._replicas.values()) + list(self._retired)
         if monitor is not None and monitor.is_alive():
             monitor.join(timeout=max(self.poll_s * 8, 5.0))
-        for handle in self._replicas.values():
+        for handle in handles:
             proc = handle.proc
             if proc is None or proc.poll() is not None:
                 continue
             if graceful:
                 proc.terminate()  # replicas drain on SIGTERM
         deadline = time.monotonic() + timeout
-        for handle in self._replicas.values():
+        for handle in handles:
             proc = handle.proc
             if proc is None:
                 continue
@@ -945,6 +1069,78 @@ class ServingFleet:
         """Append one schema-gated event to the fleet stream (public:
         load generators append their ``fleet_report`` here)."""
         self.events.emit(event, **fields)
+
+    def _emit_tenant_admissions(self):
+        """One ``tenant_admitted`` per spec'd tenant at fleet start: the
+        audit record of who is packed into this fleet with what quota."""
+        if self.spec_path is None:
+            return
+        try:
+            with open(self.spec_path) as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            return
+        from hydragnn_tpu.serve.tenants import DEFAULT_QUOTA
+
+        from hydragnn_tpu.utils.envparse import env_int
+
+        default_quota = env_int(
+            "HYDRAGNN_TENANT_DEFAULT_QUOTA", DEFAULT_QUOTA, minimum=1
+        )
+        for t in spec.get("tenants") or ():
+            self.emit(
+                "tenant_admitted",
+                tenant=t.get("name"),
+                model=t.get("model") or t.get("name"),
+                quota=int(t.get("quota") or default_quota),
+            )
+
+    # -- autoscaling ---------------------------------------------------------
+    def resize(self, n_replicas: int, reason: str = "manual") -> int:
+        """Grow/shrink the supervised replica set to ``n_replicas``.
+
+        Grow spawns fresh slots at the next rids; shrink SIGTERMs the
+        highest rids, which drain (every in-flight future resolves) and
+        release their leases marked done — removed from the monitored
+        set first, so the monitor never "heals" an intentional retire.
+        Emits ``fleet_scaled``; the autoscaler is the main caller."""
+        n = int(n_replicas)
+        if n < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n}")
+        grown: List[_ReplicaHandle] = []
+        shrunk: List[_ReplicaHandle] = []
+        with self._lock:
+            old = self.target
+            if n == old:
+                return old
+            if n > old:
+                for rid in range(old, n):
+                    handle = self._replicas.get(rid) or _ReplicaHandle(rid)
+                    self._replicas[rid] = handle
+                    grown.append(handle)
+            else:
+                for rid in range(n, old):
+                    handle = self._replicas.pop(rid, None)
+                    if handle is not None:
+                        shrunk.append(handle)
+                        self._retired.append(handle)
+            self.target = n
+            if grown:
+                # new slots boot live < target for a while: that is
+                # GROWTH, not lost capacity — suppress fleet_degraded
+                # exactly like the initial boot window does
+                self._degraded = True
+        self.metrics.registry.set("target_replicas", float(n))
+        for handle in grown:
+            self._spawn(handle)
+        for handle in shrunk:
+            proc = handle.proc
+            if proc is not None and proc.poll() is None:
+                proc.terminate()  # drain, answer stragglers, lease done
+        self.emit(
+            "fleet_scaled", old_target=old, new_target=n, reason=reason
+        )
+        return n
 
     # -- spawning ------------------------------------------------------------
     def _worker_env(self, handle: _ReplicaHandle) -> Dict[str, str]:
@@ -1000,7 +1196,9 @@ class ServingFleet:
     def _tick(self, now: Optional[float] = None):
         now = time.time() if now is None else now
         live = 0
-        for handle in self._replicas.values():
+        with self._lock:  # resize() mutates the dict concurrently
+            handles = list(self._replicas.values())
+        for handle in handles:
             if handle.respawn_at is not None:
                 # respawn backoff window: the slot is down by decision,
                 # not death — spawn once the window closes
@@ -1092,15 +1290,20 @@ class ServingFleet:
             handle.respawn_at = now + min(0.5 * (2.0 ** (streak - 1)), 15.0)
 
     def _publish_status(self, live: int):
-        degraded = live < self.target
+        with self._lock:
+            # resize() flips _degraded under the same lock (the grow
+            # boot-window suppression); the read-modify-write here must
+            # not race it into a spurious fleet_degraded
+            degraded = live < self.target
+            was_degraded = self._degraded
+            self._degraded = degraded
         self.metrics.registry.set("live_replicas", float(live))
         self.metrics.registry.set(
             "availability", live / max(self.target, 1)
         )
         self.metrics.registry.set("degraded", float(degraded))
-        if degraded and not self._degraded:
+        if degraded and not was_degraded:
             self.emit("fleet_degraded", live=live, target=self.target)
-        self._degraded = degraded
         coord.write_json(
             os.path.join(self.coord_dir, "fleet.json"),
             {"live": live, "target": self.target, "degraded": degraded,
@@ -1162,7 +1365,9 @@ class ServingFleet:
         # from active.json only if the promote resolves without it.
         now = time.time()
         quorum_inc: Dict[int, int] = {}
-        for h in self._replicas.values():
+        with self._lock:
+            handles = list(self._replicas.values())
+        for h in handles:
             if lease_serving(self._lease(h), self.lease_s, now):
                 quorum_inc[h.rid] = h.incarnation
         if not quorum_inc:
@@ -1195,7 +1400,9 @@ class ServingFleet:
             for rid in quorum:
                 if rid in acks:
                     continue
-                if self._replicas[rid].incarnation != quorum_inc[rid]:
+                with self._lock:
+                    handle = self._replicas.get(rid)
+                if handle is None or handle.incarnation != quorum_inc[rid]:
                     acks[rid] = {
                         "status": "failed",
                         "error": "replica lost and respawned mid-promote",
@@ -1248,11 +1455,27 @@ class ServingFleet:
             seq = self._active_seq
         versions = {rid: int(ack["version"]) for rid, ack in acks.items()}
         t_publish = time.time()
-        coord.write_json(
-            os.path.join(pdir, "active.json"),
-            {"seq": seq, "cmd_id": cmd_id, "checkpoint": checkpoint,
-             "name": name, "latest_cmd": cmd_id, "ts": t_publish},
-        )
+        active_payload = {
+            "seq": seq, "cmd_id": cmd_id, "checkpoint": checkpoint,
+            "name": name, "latest_cmd": cmd_id, "ts": t_publish,
+        }
+        if name is None:
+            coord.write_json(
+                os.path.join(pdir, "active.json"), active_payload
+            )
+        else:
+            # NAMED promotes (per-tenant hot-swap) publish under
+            # active-byname/<name>.json and leave active.json alone:
+            # each model name gets its own active pointer, so promotes
+            # of different names never overwrite each other's catch-up
+            # state for respawning replicas
+            os.makedirs(
+                os.path.join(pdir, "active-byname"), exist_ok=True
+            )
+            coord.write_json(
+                os.path.join(pdir, "active-byname", f"{name}.json"),
+                active_payload,
+            )
         # wait (bounded) for every acked replica's lease to REPORT the
         # new active version: when this returns "propagated", the whole
         # fleet answers new submits from the candidate — the swap is
@@ -1260,17 +1483,26 @@ class ServingFleet:
         prop_deadline = time.monotonic() + max(
             min(timeout, 30.0), self.poll_s * 4
         )
+
+        def _lease_reports(rid: int) -> bool:
+            with self._lock:
+                handle = self._replicas.get(rid)
+            if handle is None:
+                return True  # retired by a scale-down mid-propagation
+            lease = self._lease(handle)
+            if lease is None:
+                return False
+            if name is not None:
+                # named promote: verify against the per-name actives map
+                # (the legacy "active" field tracks the DEFAULT name)
+                reported = (lease.get("actives") or {}).get(name)
+            else:
+                reported = (lease.get("active") or {}).get("version")
+            return reported == versions[rid]
+
         propagated = False
         while time.monotonic() < prop_deadline and not propagated:
-            propagated = all(
-                (
-                    (lease := self._lease(self._replicas[rid]))
-                    is not None
-                    and (lease.get("active") or {}).get("version")
-                    == versions[rid]
-                )
-                for rid in versions
-            )
+            propagated = all(_lease_reports(rid) for rid in versions)
             if not propagated:
                 time.sleep(self.poll_s)
         result = {
@@ -1294,22 +1526,37 @@ class ServingFleet:
         )
         return result
 
-    def rollback(self, reason: str = "operator") -> Dict:
+    def rollback(self, reason: str = "operator",
+                 name: Optional[str] = None) -> Dict:
         """Revert the published active version to the base checkpoint
-        (cmd 0). Replicas re-promote their original entry at the next
-        watcher tick — already warm, so the revert is also downtime-free.
-        """
+        (cmd 0) — fleet-wide default name, or ONE tenant model when
+        ``name`` is given. Replicas re-promote their original entry at
+        the next watcher tick — already warm, so the revert is also
+        downtime-free."""
         with self._lock:
             self._active_seq += 1
             seq = self._active_seq
             latest = self._next_cmd
-        coord.write_json(
-            os.path.join(self.coord_dir, "promote", "active.json"),
-            {"seq": seq, "cmd_id": 0, "latest_cmd": latest,
-             "ts": time.time()},
-        )
+        payload = {"seq": seq, "cmd_id": 0, "latest_cmd": latest,
+                   "name": name, "ts": time.time()}
+        if name is None:
+            coord.write_json(
+                os.path.join(self.coord_dir, "promote", "active.json"),
+                payload,
+            )
+        else:
+            bydir = os.path.join(
+                self.coord_dir, "promote", "active-byname"
+            )
+            os.makedirs(bydir, exist_ok=True)
+            coord.write_json(
+                os.path.join(bydir, f"{name}.json"), payload
+            )
         self.metrics.registry.inc("rollbacks_total")
-        self.emit("model_rollback", name="<base>", reason=reason, cmd_id=0)
+        self.emit(
+            "model_rollback", name=name or "<base>", reason=reason,
+            cmd_id=0,
+        )
         return {"status": "rolled_back", "cmd_id": 0, "reason": reason}
 
     # -- provider protocol ---------------------------------------------------
@@ -1318,6 +1565,8 @@ class ServingFleet:
             os.path.join(self.coord_dir, "fleet.json")
         ) or {}
         live = int(status.get("live", 0))
+        with self._lock:
+            handles = dict(self._replicas)
         return {
             "status": "ok" if live >= self.target else (
                 "degraded" if live else "down"
@@ -1330,7 +1579,7 @@ class ServingFleet:
                     "pid": None if h.proc is None else h.proc.pid,
                     "port": self.replica_port(rid),
                 }
-                for rid, h in self._replicas.items()
+                for rid, h in handles.items()
             },
         }
 
@@ -1348,7 +1597,13 @@ def build_server_from_spec(spec: Dict):
           "model_name": "model",          # registry/serving name
           "samples": "samples.pkl",       # list[GraphData] for the plan
           "plan": {"max_batch_graphs": 8, "num_buckets": 3},
-          "server": {"max_wait_s": 0.005, "queue_capacity": 256}
+          "server": {"max_wait_s": 0.005, "queue_capacity": 256},
+          "tenants": [                    # optional: multi-tenant packing
+            {"name": "acme", "model": "model", "quota": 32, "weight": 2},
+            {"name": "beta", "model": "aux",
+             "checkpoint": {"name": "aux_ck", "path": "logs/"}}
+          ],
+          "cache": {"enabled": true}      # optional: response cache
         }
     """
     from hydragnn_tpu.serve.buckets import plan_from_samples
@@ -1367,9 +1622,21 @@ def build_server_from_spec(spec: Dict):
         path=spec["checkpoint"]["path"],
         name=name,
     )
+    tenants = None
+    if spec.get("tenants"):
+        from hydragnn_tpu.serve.tenants import TenantManager
+
+        # tenant models HBM-pack into the same registry at server
+        # construction (InferenceServer calls tenants.load_models);
+        # tenants whose model IS the default name share its entry
+        tenants = TenantManager.from_specs(spec["tenants"])
+    from hydragnn_tpu.serve.cache import ResponseCache
+
+    cache = ResponseCache.from_env(spec.get("cache"))
     server_kw = dict(spec.get("server", {}))
     server = InferenceServer(
-        registry, plan, default_model=name, **server_kw
+        registry, plan, default_model=name, tenants=tenants,
+        cache=cache, **server_kw
     )
     return server, spec.get("arch"), name
 

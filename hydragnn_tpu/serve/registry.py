@@ -79,6 +79,25 @@ class ModelRegistry:
         # activation history per name: [..., previous, ACTIVE]. Empty =
         # never explicitly promoted -> latest registered version serves.
         self._active: Dict[str, List[int]] = {}
+        # fired as fn(name, new_active_version) after every EFFECTIVE
+        # activation change (promote that moved, rollback) — the
+        # response cache hangs its invalidation here. Mutated only at
+        # server construction; called outside self._lock.
+        self._listeners: List = []
+
+    def add_activation_listener(self, fn):
+        """Register ``fn(name, version)`` to run after each effective
+        promote/rollback. Listeners run OUTSIDE the registry lock (a
+        listener may call back into the registry) and exceptions are
+        swallowed — observers must never fail an activation."""
+        self._listeners.append(fn)
+
+    def _notify_activation(self, name: str, version: int):
+        for fn in list(self._listeners):
+            try:
+                fn(name, version)
+            except Exception:
+                pass
 
     def register(
         self,
@@ -172,6 +191,7 @@ class ModelRegistry:
         versions within one batch. Promoting the already-active version
         is an idempotent no-op (no activation-history entry). Raises
         ``KeyError`` (registry unchanged) for unknown names/versions."""
+        changed = False
         with self._lock:
             history = self._entries.get(name)
             if not history:
@@ -186,14 +206,19 @@ class ModelRegistry:
             stack = self._active.setdefault(name, [])
             current = stack[-1] if stack else history[-1].version
             if current == version and stack:
-                return entry  # double-promote: idempotent
+                return entry  # double-promote: idempotent, no notify
             if not stack:
                 # seed with the implicit active so the first rollback
                 # has a "before" to return to
                 stack.append(current)
             if stack[-1] != version:
                 stack.append(version)
-            return entry
+                changed = True
+        if changed:
+            # outside the lock: a listener may call back into the
+            # registry (and must not block promotes, threadlint-wise)
+            self._notify_activation(name, version)
+        return entry
 
     def rollback(self, name: str) -> ModelEntry:
         """Re-activate the version that served before the last effective
@@ -214,7 +239,8 @@ class ModelRegistry:
             )
             if entry is None:  # unreachable: entries are never removed
                 raise KeyError(f"model {name!r} has no version {version}")
-            return entry
+        self._notify_activation(name, version)
+        return entry
 
     def promote_checkpoint(
         self,

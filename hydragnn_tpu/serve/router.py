@@ -127,6 +127,7 @@ class FleetRouter:
         max_attempts: int = 4,
         default_deadline_s: Optional[float] = None,
         connect_timeout_s: float = 5.0,
+        cache=None,
     ):
         self.coord_dir = coord_dir
         self._target = target_replicas
@@ -151,6 +152,23 @@ class FleetRouter:
         # response so a canary controller can mirror live traffic —
         # MUST be non-blocking and may never raise into the live path
         self._shadow = None
+        # router-side response cache (serve/cache.py, optional): keyed
+        # on the fleet's CONSENSUS active version per model name (read
+        # off the same lease scan discovery uses) — mid-swap, when live
+        # replicas disagree, lookups and fills are skipped entirely so a
+        # cached answer is always the version the whole fleet serves
+        self.cache = cache
+        if cache is not None and cache.metrics is None:
+            cache.metrics = self.metrics
+        self._consensus: Dict[str, Optional[int]] = {}
+        # tenant -> model name, learned from response bodies: lets a
+        # tenant-routed request build its cache key without the router
+        # holding a copy of the fleet's tenant spec
+        self._tenant_models: Dict[str, str] = {}
+        # tenant -> monotonic time until which that tenant is shed
+        # locally (a replica answered its quota-503): the offender backs
+        # off at the router while every other tenant routes normally
+        self._tenant_backoff: Dict[str, float] = {}
 
     # ---- shadow routing ------------------------------------------------
     def set_shadow(self, tap) -> None:
@@ -163,10 +181,13 @@ class FleetRouter:
         self._shadow = tap
 
     # ---- discovery -----------------------------------------------------
-    def _scan(self, now: Optional[float] = None) -> List[Tuple[int, int]]:
-        """Fresh (replica, port) list from the lease files."""
+    def _scan(self, now: Optional[float] = None):
+        """Fresh (replica, port) list from the lease files, plus the
+        fleet's per-model consensus active version (None for any name
+        the live replicas DISAGREE on — a hot-swap in flight)."""
         now = time.time() if now is None else now
         live = []
+        versions: Dict[str, set] = {}
         pattern = os.path.join(
             self.coord_dir, f"{REPLICA}s", f"{REPLICA}-*.json"
         )
@@ -180,7 +201,18 @@ class FleetRouter:
             if not lease.get("port"):
                 continue
             live.append((int(m.group(1)), int(lease["port"])))
-        return live
+            actives = lease.get("actives")
+            if not actives:
+                legacy = lease.get("active") or {}
+                if legacy.get("name") is not None:
+                    actives = {legacy["name"]: legacy.get("version")}
+            for name, version in (actives or {}).items():
+                versions.setdefault(name, set()).add(version)
+        consensus = {
+            name: (vs.pop() if len(vs) == 1 else None)
+            for name, vs in versions.items()
+        }
+        return live, consensus
 
     def live_replicas(self) -> List[Tuple[int, int]]:
         """Live (replica, port) pairs, cached for one scan interval."""
@@ -188,11 +220,19 @@ class FleetRouter:
         with self._lock:
             if now - self._scan_ts <= self.scan_interval_s:
                 return list(self._cached)
-        live = self._scan(now)
+        live, consensus = self._scan(now)
         with self._lock:
             self._cached = live
+            self._consensus = consensus
             self._scan_ts = now
             return list(self._cached)
+
+    def consensus_version(self, model: str) -> Optional[int]:
+        """The version EVERY live replica reports active for ``model``
+        (from the cached lease scan) — None while replicas disagree."""
+        self.live_replicas()  # refresh the scan cache if stale
+        with self._lock:
+            return self._consensus.get(model)
 
     def _invalidate(self, replica: int):
         """Drop a replica we just watched fail from the cache — the next
@@ -227,11 +267,28 @@ class FleetRouter:
         return len(self.live_replicas()) < target
 
     # ---- admission -----------------------------------------------------
-    def _admit(self, lane: str):
+    def _admit(self, lane: str, tenant: Optional[str] = None):
         if lane not in self.lanes:
             raise ValueError(
                 f"unknown lane {lane!r}; configured: {sorted(self.lanes)}"
             )
+        if tenant is not None:
+            # tenant-scoped backoff: a replica answered this tenant's
+            # quota-503 recently, so ITS traffic sheds locally until the
+            # window expires — other tenants in the SAME lane route
+            # normally (the regression the lane-global retry-after had)
+            now = time.monotonic()
+            with self._lock:
+                until = self._tenant_backoff.get(tenant, 0.0)
+                if until <= now:
+                    self._tenant_backoff.pop(tenant, None)
+                    until = 0.0
+            if until > now:
+                self.metrics.on_shed()
+                self.fleet_metrics.on_tenant_shed(tenant)
+                raise ServerOverloaded(
+                    retry_after_s=max(until - now, 0.001)
+                )
         live = self.live_replicas()
         if not live:
             # nothing to route to: shed EVERYTHING with a hint scaled to
@@ -267,21 +324,59 @@ class FleetRouter:
         lane: str = "default",
         deadline_s: Optional[float] = None,
         raw: bool = False,
+        tenant: Optional[str] = None,
     ):
         """Route one graph; returns the per-head numpy outputs (or the
         full response dict with ``raw=True`` — version/batch_seq/replica
         included, the hot-swap tests' view). Raises
-        :class:`ServerOverloaded` (shed — admission gate, zero live
-        replicas, or every live replica shedding),
+        :class:`ServerOverloaded` (shed — admission gate, tenant
+        backoff, zero live replicas, or every live replica shedding),
         :class:`DeadlineExceeded`, or :class:`NoLiveReplica` (attempts
         exhausted on non-shed failures)."""
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         t0 = time.monotonic()
         deadline = None if deadline_s is None else t0 + deadline_s
-        live = self._admit(lane)  # ServerOverloaded propagates
+        live = self._admit(lane, tenant)  # ServerOverloaded propagates
         self.metrics.on_submit()
         self.fleet_metrics.registry.inc("requests_routed_total")
+        cache_name = cache_key = None
+        if self.cache is not None:
+            from hydragnn_tpu.serve.cache import (
+                ResponseCache,
+                canonical_graph_key,
+            )
+
+            # the cache key needs a model NAME: the explicit one, or the
+            # tenant's (learned from this tenant's first response body)
+            cache_name = model or (
+                tenant and self._tenant_models.get(tenant)
+            )
+            version = (
+                self.consensus_version(cache_name) if cache_name else None
+            )
+            if cache_name and version is not None:
+                cache_key = ResponseCache.key(
+                    canonical_graph_key(graph), cache_name, version,
+                    tenant,
+                )
+                cached = self.cache.get(cache_key)
+                if cached is not None:
+                    now = time.monotonic()
+                    self.metrics.on_response()
+                    self.metrics.on_response_latency(now - t0)
+                    if deadline is not None:
+                        self.metrics.on_deadline(now <= deadline)
+                    if raw:
+                        return {
+                            "heads": [np.asarray(h).tolist()
+                                      for h in cached],
+                            "version": version,
+                            "model": cache_name,
+                            "tenant": tenant,
+                            "cached": True,
+                        }
+                    return cached
         tried: set = set()
         shed_hint: Optional[float] = None
         last_error: Optional[BaseException] = None
@@ -300,7 +395,7 @@ class FleetRouter:
                 if not self.budget.try_acquire():
                     break
                 time.sleep(delay)
-                self.metrics_on_retry(lane)
+                self.metrics_on_retry(lane, tenant)
                 live = self.live_replicas()
                 if not live:
                     last_error = NoLiveReplica("no live replica to retry")
@@ -323,7 +418,7 @@ class FleetRouter:
                 )
             try:
                 status, body = self._post(rid, port, graph, model,
-                                          remaining)
+                                          remaining, tenant)
             except (urllib.error.URLError, http.client.HTTPException,
                     ConnectionError, OSError, TimeoutError) as e:
                 # transport failure: the replica just died or is being
@@ -340,6 +435,33 @@ class FleetRouter:
                 self.metrics.on_response_latency(now - t0)
                 if deadline is not None:
                     self.metrics.on_deadline(now <= deadline)
+                if tenant is not None and body.get("model"):
+                    with self._lock:
+                        self._tenant_models[tenant] = body["model"]
+                if self.cache is not None and body.get("model"):
+                    # fill ONLY when the answering version IS the fleet
+                    # consensus: mid-swap answers (consensus None, or a
+                    # straggler replica) are never cached
+                    consensus = self.consensus_version(body["model"])
+                    if (
+                        consensus is not None
+                        and body.get("version") == consensus
+                    ):
+                        from hydragnn_tpu.serve.cache import (
+                            ResponseCache,
+                            canonical_graph_key,
+                        )
+
+                        # store exactly what the uncached path returns
+                        # (the JSON-decoded arrays): a hit is bitwise-
+                        # equal to a fresh route of the same graph
+                        self.cache.put(
+                            ResponseCache.key(
+                                canonical_graph_key(graph),
+                                body["model"], consensus, tenant,
+                            ),
+                            [np.asarray(h) for h in body["heads"]],
+                        )
                 shadow = self._shadow
                 if shadow is not None:
                     try:
@@ -356,6 +478,21 @@ class FleetRouter:
                 # the replica shed (queue full / draining): retryable,
                 # and its hint rides along if we end up giving up
                 shed_hint = float(body.get("retry_after_s", 0.05))
+                shed_tenant = body.get("tenant")
+                if shed_tenant is not None and shed_tenant == tenant:
+                    # the 503 was a TENANT quota shed, not replica
+                    # pressure: back off THIS tenant locally (admission
+                    # sheds it until the window passes) and stop
+                    # retrying — another replica enforces the same
+                    # quota, so a retry only doubles the offender's load
+                    with self._lock:
+                        self._tenant_backoff[tenant] = max(
+                            self._tenant_backoff.get(tenant, 0.0),
+                            time.monotonic() + max(shed_hint, 0.001),
+                        )
+                    self.fleet_metrics.on_tenant_shed(tenant)
+                    self.metrics.on_error()
+                    raise ServerOverloaded(retry_after_s=shed_hint)
                 self.fleet_metrics.registry.inc("replica_errors_total")
                 last_error = ServerOverloaded(retry_after_s=shed_hint)
                 continue
@@ -401,17 +538,39 @@ class FleetRouter:
             + (f": {last_error}" if last_error else "")
         )
 
-    def metrics_on_retry(self, lane: str):
+    def metrics_on_retry(self, lane: str, tenant: Optional[str] = None):
         self.fleet_metrics.registry.inc("retries_total")
         self.fleet_metrics.on_lane_retry(lane)
+        if tenant is not None:
+            self.fleet_metrics.on_tenant_retry(tenant)
+
+    def autoscale_signals(self) -> Dict:
+        """Counter snapshot for :class:`FleetAutoscaler`: ``ServeMetrics``
+        plus per-tenant quota sheds folded into ``shed_total``. A
+        replica's quota-503 lands in ``errors_total`` by the admission
+        accounting convention (the request was accepted and routed), but
+        for capacity decisions a quota shed IS shed pressure — more
+        replicas means more aggregate quota. Locally backed-off tenants
+        appear in both series; the autoscaler only thresholds
+        ``shed > 0``, so the overlap is harmless."""
+        snap = dict(self.metrics.snapshot())
+        labeled = self.fleet_metrics.snapshot().get("tenant_shed_total")
+        if labeled:
+            snap["shed_total"] = (
+                snap.get("shed_total", 0) + sum(labeled.values())
+            )
+        return snap
 
     def _post(self, rid: int, port: int, graph, model: Optional[str],
-              deadline_s: Optional[float]) -> Tuple[int, Dict]:
+              deadline_s: Optional[float],
+              tenant: Optional[str] = None) -> Tuple[int, Dict]:
         payload = {"graph": encode_graph(graph)}
         if model is not None:
             payload["model"] = model
         if deadline_s is not None:
             payload["deadline_s"] = deadline_s
+        if tenant is not None:
+            payload["tenant"] = tenant
         data = json.dumps(payload).encode()
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/predict",

@@ -62,7 +62,14 @@ class ServeFuture:
     just before the result lands: which model version computed the
     answer and which micro-batch carried it — the hot-swap tests assert
     every response in one batch_seq shares one version (the registry's
-    batch-boundary swap contract made observable)."""
+    batch-boundary swap contract made observable). ``model_name`` rides
+    along for multi-tenant responses (which packed model answered).
+
+    ``_on_done`` (internal) fires exactly once, on the WINNING
+    resolution, outside the future's lock — the tenant quota release
+    hook: every admitted request frees its quota slot at its terminal
+    outcome, whichever code path resolved it (dispatch, expiry, error,
+    shutdown sweep)."""
 
     def __init__(self):
         self._event = threading.Event()
@@ -70,10 +77,22 @@ class ServeFuture:
         self._result = None
         self._error: Optional[BaseException] = None
         self.version: Optional[int] = None
+        self.model_name: Optional[str] = None
         self.batch_seq: Optional[int] = None
+        self._on_done = None
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def _fire_done(self):
+        # only the winning resolver reaches here, so the unlocked
+        # read-and-clear cannot race another firer
+        cb, self._on_done = self._on_done, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass  # a bookkeeping hook can never fail a resolution
 
     def set_result(self, result) -> bool:
         # first resolution wins (atomically): a shutdown sweep racing a
@@ -83,7 +102,8 @@ class ServeFuture:
                 return False
             self._result = result
             self._event.set()
-            return True
+        self._fire_done()
+        return True
 
     def set_exception(self, error: BaseException) -> bool:
         with self._lock:
@@ -91,7 +111,8 @@ class ServeFuture:
                 return False
             self._error = error
             self._event.set()
-            return True
+        self._fire_done()
+        return True
 
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):
@@ -104,10 +125,11 @@ class ServeFuture:
 class _Request:
     __slots__ = (
         "graph", "entry", "bucket", "sizes", "future", "enqueued_at",
-        "deadline", "fallback",
+        "deadline", "fallback", "tenant", "cache_key",
     )
 
-    def __init__(self, graph, entry, bucket, sizes, deadline, fallback):
+    def __init__(self, graph, entry, bucket, sizes, deadline, fallback,
+                 tenant=None, cache_key=None):
         self.graph = graph
         self.entry = entry
         self.bucket = bucket
@@ -116,6 +138,8 @@ class _Request:
         self.enqueued_at = time.monotonic()
         self.deadline = deadline  # absolute monotonic time or None
         self.fallback = fallback  # served above its node-natural bucket
+        self.tenant = tenant  # admission/packing identity (None = untenanted)
+        self.cache_key = cache_key  # fill the response cache on dispatch
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -142,6 +166,8 @@ class InferenceServer:
         default_deadline_s: Optional[float] = None,
         observability_port: Optional[int] = None,
         metrics: Optional[ServeMetrics] = None,
+        tenants=None,
+        cache=None,
     ):
         self.registry = registry
         self.plan = plan
@@ -150,6 +176,21 @@ class InferenceServer:
         self.queue_capacity = int(queue_capacity)
         self.default_deadline_s = default_deadline_s
         self.metrics = metrics or ServeMetrics()
+        # multi-tenant serving (serve/tenants.py): quota admission +
+        # DWRR flush ordering; None = the historical single-tenant path
+        self.tenants = tenants
+        if tenants is not None:
+            tenants.load_models(registry)  # HBM-pack every tenant model
+        # response cache (serve/cache.py): consulted at submit (pre-
+        # collation key), filled at dispatch, invalidated on promote/
+        # rollback through the registry's activation listeners
+        self.cache = cache
+        if cache is not None:
+            if cache.metrics is None:
+                cache.metrics = self.metrics
+            registry.add_activation_listener(
+                lambda name, version: cache.invalidate(model=name)
+            )
         self._queue: "queue.Queue[_Request]" = queue.Queue(
             maxsize=self.queue_capacity
         )
@@ -376,13 +417,27 @@ class InferenceServer:
         graph: GraphData,
         model: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> ServeFuture:
         """Enqueue one graph; returns a future resolving to a list of
         per-head numpy outputs (graph head: ``[dim]``, node head:
         ``[num_nodes, dim]``). Raises :class:`ServerOverloaded` when the
-        queue is full and :class:`GraphTooLarge` when no bucket admits
-        the graph (both BEFORE queueing — shed work fails fast)."""
+        queue is full (or, as its :class:`~hydragnn_tpu.serve.tenants.
+        TenantOverQuota` subclass, when ``tenant``'s quota is) and
+        :class:`GraphTooLarge` when no bucket admits the graph (all
+        BEFORE queueing — shed work fails fast). With a tenant manager
+        configured, ``tenant`` resolves the model name and counts
+        against that tenant's quota; a cache hit answers before the
+        quota check (a cached answer consumes no device time)."""
         name = model or self.default_model
+        if tenant is not None:
+            if self.tenants is None:
+                raise ValueError(
+                    f"tenant {tenant!r} given but the server has no "
+                    "TenantManager"
+                )
+            if model is None:
+                name = self.tenants.model_for(tenant)  # KeyError: unknown
         if name is None:
             names = self.registry.names()
             if len(names) != 1:
@@ -398,6 +453,43 @@ class InferenceServer:
         deadline = (
             None if deadline_s is None else time.monotonic() + deadline_s
         )
+        cache_key = None
+        if self.cache is not None:
+            from hydragnn_tpu.serve.cache import (
+                ResponseCache,
+                canonical_graph_key,
+            )
+
+            # keyed PRE-collation on the raw request graph; the entry's
+            # ACTIVE version in the key is what makes a stale hit after
+            # promote/rollback impossible by construction
+            cache_key = ResponseCache.key(
+                canonical_graph_key(graph), entry.name, entry.version,
+                tenant,
+            )
+            cached = self.cache.get(cache_key)
+            if cached is not None:
+                fut = ServeFuture()
+                fut.version = entry.version
+                fut.model_name = entry.name
+                fut.set_result(cached)
+                self.metrics.on_submit()
+                self.metrics.on_response()
+                self.metrics.on_response_latency(0.0)
+                if deadline is not None:
+                    self.metrics.on_deadline(True)
+                return fut
+        if tenant is not None:
+            # quota admission AFTER the cache (hits are free) and BEFORE
+            # the shared queue: a flooding tenant sheds here, tenant-
+            # tagged, while the queue stays clear for everyone else
+            try:
+                self.tenants.admit(
+                    tenant, retry_after_s=max(self.max_wait_s, 0.001)
+                )
+            except ServerOverloaded:
+                self.metrics.on_shed()
+                raise
         req = _Request(
             graph,
             entry,
@@ -405,16 +497,25 @@ class InferenceServer:
             sizes,
             deadline,
             fallback=bucket > self.plan.natural_bucket(graph.num_nodes),
+            tenant=tenant,
+            cache_key=cache_key,
         )
+        if tenant is not None:
+            tenants = self.tenants
+            req.future._on_done = lambda t=tenant: tenants.release(t)
         # check-and-enqueue atomically vs stop(): once stop() takes this
         # lock to set _stopped, no request can slip into the dead queue
         # after its sweep
         with self._submit_lock:
             if self._stopped:
+                if tenant is not None:
+                    self.tenants.release(tenant)
                 raise RuntimeError("server stopped; submits are refused")
             try:
                 self._queue.put_nowait(req)
             except queue.Full:
+                if tenant is not None:
+                    self.tenants.release(tenant)
                 self.metrics.on_shed()
                 # the queue drains one max-wait window per flush round; a
                 # full queue clears in about capacity/batch flushes of it
@@ -430,11 +531,12 @@ class InferenceServer:
         graph: GraphData,
         model: Optional[str] = None,
         timeout: Optional[float] = None,
+        tenant: Optional[str] = None,
     ):
         """Synchronous convenience: submit + wait."""
-        return self.submit(graph, model=model, deadline_s=timeout).result(
-            timeout
-        )
+        return self.submit(
+            graph, model=model, deadline_s=timeout, tenant=tenant
+        ).result(timeout)
 
     def _depth(self) -> int:
         with self._pending_lock:
@@ -470,7 +572,10 @@ class InferenceServer:
             self._flush_group(key)
 
     def _admit_pending(self, req: _Request):
-        key = (req.entry.name, req.entry.version, req.bucket)
+        # per-(tenant, model-version, bucket) groups: one micro-batch
+        # never mixes tenants, so a response reaching the wrong tenant
+        # is impossible by construction, not by filtering
+        key = (req.tenant, req.entry.name, req.entry.version, req.bucket)
         with self._pending_lock:
             self._pending.setdefault(key, []).append(req)
 
@@ -478,6 +583,21 @@ class InferenceServer:
         now = time.monotonic()
         with self._pending_lock:
             keys = list(self._pending)
+            backlog: Dict[Optional[str], int] = {}
+            for key in keys:
+                backlog[key[0]] = backlog.get(key[0], 0) + len(
+                    self._pending.get(key) or ()
+                )
+        if self.tenants is not None and len(backlog) > 1:
+            # deficit-weighted round robin across tenants: when several
+            # tenants have groups due, dispatch order follows earned
+            # credit — a flooding tenant cannot buy more than its weight
+            # share of consecutive device slots
+            rank = {
+                t: i
+                for i, t in enumerate(self.tenants.flush_order(backlog))
+            }
+            keys.sort(key=lambda k: (rank.get(k[0], len(rank)), k[3]))
         for key in keys:
             group = self._pending.get(key)
             if not group:
@@ -487,14 +607,16 @@ class InferenceServer:
             if self._group_full(key, group) or (
                 now - group[0].enqueued_at >= self.max_wait_s
             ):
-                self._flush_group(key)
+                served = self._flush_group(key)
+                if self.tenants is not None and served:
+                    self.tenants.on_served(key[0], served)
 
     def _group_full(self, key, group) -> bool:
         """Full = the bucket budget cannot take one more request of the
         group's smallest plausible size — approximated by: adding the
         LAST request's sizes again would overflow (cheap, and exact for
         same-size streams; worst case we flush one request early)."""
-        bucket = key[2]
+        bucket = key[3]
         n = sum(r.sizes[0] for r in group)
         e = sum(r.sizes[1] for r in group)
         t = sum(r.sizes[2] for r in group)
@@ -502,11 +624,13 @@ class InferenceServer:
             bucket, n, e, t, len(group), group[-1].sizes
         )
 
-    def _flush_group(self, key):
+    def _flush_group(self, key) -> int:
+        """Dispatch one pending group; returns how many requests went to
+        the device (the DWRR debit — expiries consumed no device time)."""
         with self._pending_lock:
             group = self._pending.pop(key, None)
         if not group:
-            return
+            return 0
         now = time.monotonic()
         live: List[_Request] = []
         expired = 0
@@ -523,7 +647,8 @@ class InferenceServer:
                 live.append(req)
         if expired:
             self.metrics.on_timeout(expired)
-        bucket = key[2]
+        bucket = key[3]
+        served = len(live)
         # budget-greedy split: a group can exceed one batch's budgets
         # (e.g. a burst larger than g_pad-1) — emit as many full batches
         # as needed, every one inside the bucket's static shapes
@@ -541,6 +666,7 @@ class InferenceServer:
                 t += req.sizes[2]
             live = live[len(take):]
             self._dispatch_batch(take, bucket, real_nodes=n)
+        return served
 
     def _dispatch_batch(self, requests: List[_Request], bucket: int,
                         real_nodes: int):
@@ -577,7 +703,12 @@ class InferenceServer:
             # stamped before resolution: a waiter that wakes on
             # set_result reads a consistent (version, batch) pair
             req.future.version = entry.version
+            req.future.model_name = entry.name
             req.future.batch_seq = batch_seq
+            if self.cache is not None and req.cache_key is not None:
+                # fill BEFORE resolving: a waiter that re-submits the
+                # same graph right after result() must see the hit
+                self.cache.put(req.cache_key, per_head)
             req.future.set_result(per_head)
             self.metrics.on_response_latency(now - req.enqueued_at)
             # SLO accounting: a deadline-carrying request that still got
@@ -652,10 +783,21 @@ class InferenceServer:
             entry.params, entry.batch_stats, dev_batch
         )
 
+    # ---- multi-tenant conveniences -------------------------------------
+    def warm_tenant(self, tenant: str, timeout: float = 120.0,
+                    passes: int = 2) -> Dict[str, int]:
+        """Warm one tenant's model through the live batcher (same
+        compile-counter verification as :meth:`warm_version`)."""
+        if self.tenants is None:
+            raise ValueError("server has no TenantManager")
+        return self.warm_version(
+            self.tenants.model_for(tenant), timeout=timeout, passes=passes
+        )
+
     # ---- health --------------------------------------------------------
     def health(self) -> Dict:
         """``/healthz`` payload: liveness + registry + warmup state."""
-        return {
+        out = {
             "status": "ok" if self._running.is_set() else "stopped",
             "warm": self._warm,
             "models": self.registry.describe(),
@@ -673,3 +815,8 @@ class InferenceServer:
             "queue_capacity": self.queue_capacity,
             "max_wait_s": self.max_wait_s,
         }
+        if self.tenants is not None:
+            out["tenants"] = self.tenants.describe()
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out

@@ -33,6 +33,30 @@ def env_int(
     return value
 
 
+def env_float(
+    name: str, default: float, minimum: Optional[float] = 0.0
+) -> float:
+    """Float env knob: unset/empty -> ``default``; non-numeric or
+    below-``minimum`` values raise a ``ValueError`` that names the
+    variable. ``minimum=None`` skips the range check."""
+    raw = os.getenv(name)
+    if raw is None or raw.strip() == "":
+        return float(default)
+    try:
+        value = float(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number, got {raw!r}"
+        ) from None
+    if value != value:  # NaN: comparisons below would silently pass
+        raise ValueError(f"{name} must be a number, got {raw!r}")
+    if minimum is not None and value < minimum:
+        raise ValueError(
+            f"{name} must be >= {minimum}, got {value}"
+        )
+    return value
+
+
 def env_mesh(
     name: str = "HYDRAGNN_MESH",
 ) -> Optional[Tuple[Optional[int], int]]:

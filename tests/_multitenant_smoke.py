@@ -1,0 +1,305 @@
+"""CI multi-tenant serving smoke (standalone, NOT a pytest module).
+
+The ISSUE 17 e2e gate: a 2-replica spec-driven fleet HBM-packing two
+tenants onto two models, behind a response-caching router and a
+predictive autoscaler —
+
+1. steady state: both tenants served, every response computed by the
+   TENANT'S model (zero cross-tenant responses, weight-verified),
+2. response cache: resubmitting the same structures drives the router
+   hit-ratio up, with hits bitwise-equal to the fresh answers,
+3. tenant flood: 'acme' hammers far past its quota from 8 concurrent
+   clients while 'beta' runs its baseline loop — only the offender is
+   shed, beta finishes 100% ok,
+4. autoscale spike: shed pressure grows the fleet 2 -> 3 via
+   ``ServingFleet.resize``; the quiet tail shrinks it 3 -> 2,
+5. the whole event stream validates against the documented schema
+   (``tenant_admitted`` + ``cache_stats`` + ``fleet_scaled`` included).
+
+Usage: python tests/_multitenant_smoke.py <workdir>
+"""
+
+import copy
+import json
+import os
+import pickle
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _fleet_smoke import ARCH, make_graphs  # noqa: E402
+
+REQUEST_DEADLINE_S = 30.0
+ACME_QUOTA = 2
+FLOOD_CLIENTS = 8
+
+
+def build_artifacts(workdir):
+    """Two checkpoints (base + weight-bumped aux), plan samples, and a
+    TENANTED fleet spec with the response cache enabled."""
+    import jax
+
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.serve.buckets import plan_from_samples
+    from hydragnn_tpu.train.checkpoint import save_model
+    from hydragnn_tpu.train.trainer import Trainer
+
+    samples = make_graphs(32, seed=17)
+    plan = plan_from_samples(samples, max_batch_graphs=4, num_buckets=2)
+    model = create_model_config(dict(ARCH))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    init_batch, _ = plan.pack([samples[0]], 0)
+    state = trainer.init_state(init_batch, seed=0)
+    ckdir = os.path.join(workdir, "ck")
+    save_model(state, "base", path=ckdir)
+    bumped = state.replace(
+        params=jax.tree_util.tree_map(lambda x: x + 0.05, state.params)
+    )
+    save_model(bumped, "aux", path=ckdir)
+    samples_path = os.path.join(workdir, "samples.pkl")
+    with open(samples_path, "wb") as f:
+        pickle.dump(samples, f)
+    spec = {
+        "checkpoint": {"name": "base", "path": ckdir},
+        "arch": ARCH,
+        "model_name": "m",
+        "samples": samples_path,
+        "plan": {"max_batch_graphs": 4, "num_buckets": 2},
+        "server": {"max_wait_s": 0.003, "queue_capacity": 256},
+        "tenants": [
+            {"name": "acme", "model": "m", "quota": ACME_QUOTA},
+            {"name": "beta", "model": "aux", "quota": 32,
+             "checkpoint": {"name": "aux", "path": ckdir,
+                            "arch": ARCH}},
+        ],
+        "cache": {"enabled": True},
+    }
+    spec_path = os.path.join(workdir, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    return spec_path, samples
+
+
+def main(workdir):
+    os.makedirs(workdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.serve import (
+        AutoscalePolicy,
+        FleetAutoscaler,
+        FleetRouter,
+        ResponseCache,
+        ServerOverloaded,
+        ServingFleet,
+    )
+
+    spec_path, samples = build_artifacts(workdir)
+    coord_dir = os.path.join(workdir, "coord")
+    log_dir = os.path.join(workdir, "log")
+    fleet = ServingFleet(
+        coord_dir, 2, spec_path=spec_path, heartbeat_s=0.1,
+        lease_s=0.75, poll_s=0.05, log_dir=log_dir,
+    )
+    t_boot = time.monotonic()
+    fleet.start(wait_serving=True, timeout=300)
+    boot_s = time.monotonic() - t_boot
+    assert fleet.health()["live"] == 2, fleet.health()
+
+    router = FleetRouter(
+        coord_dir, lease_s=0.75, scan_interval_s=0.1, max_attempts=6,
+        retry_base_delay_s=0.05,
+        cache=ResponseCache(capacity=256, max_bytes=16 << 20),
+    )
+
+    # ---- phase 1: steady state + zero cross-tenant responses ----------
+    per_tenant_model = {"acme": "m", "beta": "aux"}
+    fresh = {}
+    for tenant in ("acme", "beta"):
+        raw = router.route(
+            samples[0], tenant=tenant, deadline_s=REQUEST_DEADLINE_S,
+            raw=True,
+        )
+        assert raw["model"] == per_tenant_model[tenant], raw
+        fresh[tenant] = [np.asarray(h) for h in raw["heads"]]
+    # different weights -> different numbers: a cross-tenant mixup would
+    # be numerically visible, not just label-visible
+    assert not np.allclose(fresh["acme"][0], fresh["beta"][0])
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        tenant = ("acme", "beta")[int(rng.integers(2))]
+        g = samples[int(rng.integers(len(samples)))]
+        raw = router.route(
+            g, tenant=tenant, deadline_s=REQUEST_DEADLINE_S, raw=True
+        )
+        assert raw["model"] == per_tenant_model[tenant], (
+            f"CROSS-TENANT response: {tenant} got {raw['model']}"
+        )
+
+    # ---- phase 2: response-cache hit ratio climbs ---------------------
+    snap0 = router.metrics.snapshot()
+    repeats = 12
+    for _ in range(repeats):
+        heads = router.route(
+            samples[0], tenant="beta", deadline_s=REQUEST_DEADLINE_S
+        )
+        for a, b in zip(heads, fresh["beta"]):
+            assert np.array_equal(np.asarray(a), b), (
+                "cache hit is not bitwise-equal to the fresh response"
+            )
+    snap1 = router.metrics.snapshot()
+    new_hits = snap1["cache_hits_total"] - snap0["cache_hits_total"]
+    assert new_hits >= repeats - 1, (snap0, snap1)
+    ratio0 = snap0["cache_hits_total"] / max(
+        snap0["cache_hits_total"] + snap0["cache_misses_total"], 1
+    )
+    ratio1 = snap1["cache_hits_total"] / max(
+        snap1["cache_hits_total"] + snap1["cache_misses_total"], 1
+    )
+    assert ratio1 > ratio0, (ratio0, ratio1)
+
+    # ---- phase 3: flood sheds ONLY the offender -----------------------
+    stop = threading.Event()
+    acme = {"ok": 0, "shed": 0, "failed": 0}
+    lock = threading.Lock()
+
+    def flood(seed):
+        # every flooded graph gets a unique position jitter: a flood of
+        # REPEATED graphs is absorbed by the response cache without ever
+        # touching a replica (nice for the cache, useless for proving
+        # quota isolation)
+        frng = np.random.default_rng(seed)
+        while not stop.is_set():
+            g = copy.deepcopy(samples[int(frng.integers(len(samples)))])
+            g.pos = (
+                g.pos + frng.normal(scale=1e-3, size=g.pos.shape)
+            ).astype(np.float32)
+            try:
+                router.route(g, tenant="acme",
+                             deadline_s=REQUEST_DEADLINE_S)
+                out = "ok"
+            except ServerOverloaded:
+                out = "shed"
+            except Exception:
+                out = "failed"
+            with lock:
+                acme[out] += 1
+
+    floods = [
+        threading.Thread(target=flood, args=(50 + i,), daemon=True)
+        for i in range(FLOOD_CLIENTS)
+    ]
+    for t in floods:
+        t.start()
+    time.sleep(0.5)  # flood established
+    beta_ok = beta_total = 0
+    for _ in range(20):
+        g = samples[int(rng.integers(len(samples)))]
+        beta_total += 1
+        raw = router.route(
+            g, tenant="beta", deadline_s=REQUEST_DEADLINE_S, raw=True
+        )
+        assert raw["model"] == "aux", raw
+        beta_ok += 1
+    flood_window_shed = dict(acme)
+
+    # ---- phase 4: shed pressure scales 2 -> 3, quiet shrinks 3 -> 2 ---
+    scaler = FleetAutoscaler(
+        fleet,
+        signals=router.autoscale_signals,
+        policy=AutoscalePolicy(
+            min_replicas=2, max_replicas=3, capacity_rps=1e9,
+            slo_budget=0.05, up_cooldown_s=0.0, down_cooldown_s=0.0,
+            period_s=60.0, n_phases=6,
+        ),
+        interval_s=0.5,
+    )
+    scaler.tick()  # prime the counter baseline
+    time.sleep(0.5)  # flood keeps shedding acme into the delta window
+    decision = scaler.tick()
+    assert decision is not None and decision["reason"] == "slo_pressure", (
+        decision
+    )
+    assert fleet.target == 3, (decision, fleet.target)
+    stop.set()
+    for t in floods:
+        t.join(timeout=60)
+    assert acme["failed"] == 0, f"{acme['failed']} acme requests FAILED"
+    assert flood_window_shed["shed"] > 0, flood_window_shed
+    assert beta_ok == beta_total, (beta_ok, beta_total)
+    tenant_shed = router.fleet_metrics.snapshot()["tenant_shed_total"]
+    assert tenant_shed.get("tenant=acme", 0) > 0, tenant_shed
+    assert "tenant=beta" not in tenant_shed, tenant_shed
+
+    fleet.wait_serving(timeout=300)  # replica 2 boots + warms
+    assert fleet.health()["live"] == 3, fleet.health()
+    raw = router.route(
+        samples[1], tenant="beta", deadline_s=REQUEST_DEADLINE_S, raw=True
+    )
+    assert raw["model"] == "aux", raw
+
+    # quiet tail: zero-delta ticks decay the forecast to nothing and the
+    # healthy fleet walks back down to min_replicas
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and fleet.target != 2:
+        scaler.tick()
+        time.sleep(0.3)
+    assert fleet.target == 2, fleet.target
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and fleet.health()["live"] != 2:
+        time.sleep(0.2)
+    assert fleet.health()["live"] == 2, fleet.health()
+    # the survivors still serve both tenants
+    for tenant in ("acme", "beta"):
+        raw = router.route(
+            samples[2], tenant=tenant, deadline_s=REQUEST_DEADLINE_S,
+            raw=True,
+        )
+        assert raw["model"] == per_tenant_model[tenant], raw
+
+    # the load generator appends its cache ledger to the fleet stream
+    # (the fleet_report pattern) so ops can replay hit-ratio history
+    cs = router.cache.stats()
+    fleet.emit(
+        "cache_stats", hits=cs["hits"], misses=cs["misses"],
+        evictions=cs["evictions"], bytes=cs["bytes"],
+    )
+    fleet.stop()
+
+    # ---- phase 5: the event stream is schema-valid --------------------
+    recs = validate_events(
+        os.path.join(log_dir, "events.jsonl"),
+        require=["tenant_admitted", "cache_stats", "fleet_scaled"],
+    )
+    admitted = {
+        r["tenant"]: r for r in recs if r["event"] == "tenant_admitted"
+    }
+    assert set(admitted) == {"acme", "beta"}, admitted
+    assert admitted["acme"]["quota"] == ACME_QUOTA, admitted
+    assert admitted["beta"]["model"] == "aux", admitted
+    scaled = [r for r in recs if r["event"] == "fleet_scaled"]
+    transitions = [(r["old_target"], r["new_target"]) for r in scaled]
+    assert (2, 3) in transitions and (3, 2) in transitions, transitions
+
+    cache = router.metrics.snapshot()
+    print(
+        "multitenant smoke OK: boot {:.1f}s, {} acme flood attempts "
+        "({} shed, 0 cross-tenant), beta {}/{} ok under flood, cache "
+        "hit-ratio {:.2f}, scaled 2->3->2".format(
+            boot_s, sum(acme.values()), acme["shed"], beta_ok,
+            beta_total,
+            cache["cache_hits_total"]
+            / max(cache["cache_hits_total"] + cache["cache_misses_total"],
+                  1),
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

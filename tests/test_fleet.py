@@ -246,7 +246,7 @@ def pytest_respawn_skips_history_and_rolls_back_to_booted_base(tmp_path):
     rep2.start()
     try:
         assert registry2.get("m").version == 2  # serving the candidate
-        assert rep2._warmed[0] == 1  # base = the BOOTED version
+        assert rep2._warmed[0] == ("m", 1)  # base = the BOOTED version
         coord.write_json(
             os.path.join(d2, "promote", "active.json"),
             {"seq": 2, "cmd_id": 0, "latest_cmd": 1},

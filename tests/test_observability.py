@@ -39,6 +39,8 @@ from _resilience_worker import make_samples  # noqa: E402
 # extension (goodput/SLO PR): the deadline-outcome + SLO-miss series are
 # appended AFTER the historical lines, so every pre-existing consumer's
 # byte offsets are untouched and the golden grew by exactly that tail.
+# DELIBERATE extension (multi-tenant PR): the response-cache series are
+# appended after the SLO tail under the same rule.
 _GOLDEN_SERVE = """\
 # HELP hydragnn_serve_requests_total Accepted requests
 # TYPE hydragnn_serve_requests_total counter
@@ -90,6 +92,18 @@ hydragnn_serve_deadline_outcomes_total{outcome="missed"} 2
 # HELP hydragnn_serve_slo_miss_ratio Fraction of deadline-carrying requests that missed
 # TYPE hydragnn_serve_slo_miss_ratio gauge
 hydragnn_serve_slo_miss_ratio 0.5
+# HELP hydragnn_serve_cache_hits_total Requests answered from the response cache
+# TYPE hydragnn_serve_cache_hits_total counter
+hydragnn_serve_cache_hits_total 2
+# HELP hydragnn_serve_cache_misses_total Cache lookups that fell through to dispatch
+# TYPE hydragnn_serve_cache_misses_total counter
+hydragnn_serve_cache_misses_total 3
+# HELP hydragnn_serve_cache_evictions_total Entries evicted by the LRU bounds
+# TYPE hydragnn_serve_cache_evictions_total counter
+hydragnn_serve_cache_evictions_total 1
+# HELP hydragnn_serve_cache_bytes Resident response-cache payload bytes
+# TYPE hydragnn_serve_cache_bytes gauge
+hydragnn_serve_cache_bytes 4096
 """
 
 
@@ -112,6 +126,12 @@ def _drive_serve_traffic(m):
     m.on_deadline(True)
     m.on_deadline(True)
     m.on_deadline(False)
+    # response-cache traffic (multi-tenant PR): 2 hits, 3 misses, one
+    # LRU eviction, 4 KiB resident
+    m.on_cache_hit(2)
+    m.on_cache_miss(3)
+    m.on_cache_evict()
+    m.set_cache_bytes(4096)
     return m
 
 

@@ -1,0 +1,275 @@
+"""Canonical-graph response cache (hydragnn_tpu/serve/cache.py).
+
+Acceptance (ISSUE 17): the canonical key must be PERMUTATION-STABLE
+(property-tested: relabeling nodes and shuffling edge columns never
+changes it) yet collision-distinct for physically perturbed inputs (one
+ULP on one coordinate, one species flip, one rewired edge). Cached
+responses must be bitwise-equal to fresh dispatches for the same
+(tenant, model, version), and a promote/rollback must make every stale
+hit impossible by construction — the version lives in the key.
+"""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.dataobj import GraphData
+from hydragnn_tpu.serve import (
+    InferenceServer,
+    ResponseCache,
+    canonical_graph_key,
+)
+
+from test_serve import _graph, _harness
+
+
+def _permuted(g, perm):
+    """The same physical graph under node relabeling ``perm`` (new node
+    j is old node perm[j]) plus a random shuffle of edge columns."""
+    inv = np.argsort(perm)
+    out = GraphData(
+        x=np.asarray(g.x)[perm].copy(),
+        pos=None if g.pos is None else np.asarray(g.pos)[perm].copy(),
+    )
+    ei = inv[np.asarray(g.edge_index)]
+    shuffle = np.random.default_rng(int(perm[0])).permutation(ei.shape[1])
+    out.edge_index = np.ascontiguousarray(ei[:, shuffle])
+    if getattr(g, "edge_attr", None) is not None:
+        out.edge_attr = np.asarray(g.edge_attr)[shuffle].copy()
+    return out
+
+
+# -- the permutation-invariance property --------------------------------------
+
+def pytest_cache_key_is_permutation_invariant():
+    """Property test: 25 random graphs x 4 random relabelings each —
+    every relabeling (plus an edge-column shuffle) hashes identically."""
+    rng = np.random.default_rng(11)
+    for trial in range(25):
+        n = int(rng.integers(3, 30))
+        g = _graph(n, rng, with_targets=False)
+        key = canonical_graph_key(g)
+        for _ in range(4):
+            perm = rng.permutation(n)
+            assert canonical_graph_key(_permuted(g, perm)) == key
+
+
+def pytest_cache_key_permutation_invariant_with_edge_attr():
+    rng = np.random.default_rng(12)
+    for _ in range(10):
+        n = int(rng.integers(4, 20))
+        g = _graph(n, rng, with_targets=False)
+        g.edge_attr = rng.random(
+            (g.edge_index.shape[1], 3)
+        ).astype(np.float32)
+        key = canonical_graph_key(g)
+        perm = rng.permutation(n)
+        assert canonical_graph_key(_permuted(g, perm)) == key
+
+
+# -- collision distinctness ---------------------------------------------------
+
+def pytest_cache_key_distinct_for_perturbed_inputs():
+    """One ULP on one coordinate, one species value flip, one rewired
+    edge, one edge_attr tweak: each must produce a fresh key."""
+    rng = np.random.default_rng(13)
+    g = _graph(12, rng, with_targets=False)
+    g.edge_attr = rng.random((g.edge_index.shape[1], 2)).astype(np.float32)
+    key = canonical_graph_key(g)
+    seen = {key}
+
+    bumped = _permuted(g, np.arange(12))  # deep copy via identity perm
+    bumped.pos = bumped.pos.copy()
+    bumped.pos[3, 1] = np.nextafter(
+        bumped.pos[3, 1], np.float32(np.inf), dtype=np.float32
+    )
+    k = canonical_graph_key(bumped)
+    assert k not in seen
+    seen.add(k)
+
+    flipped = _permuted(g, np.arange(12))
+    flipped.x = flipped.x.copy()
+    flipped.x[5, 0] += 1.0  # a different species/feature value
+    k = canonical_graph_key(flipped)
+    assert k not in seen
+    seen.add(k)
+
+    rewired = _permuted(g, np.arange(12))
+    ei = rewired.edge_index.copy()
+    ei[1, 0] = (ei[1, 0] + 1) % 12  # move one edge's destination
+    if ei[1, 0] == ei[0, 0]:
+        ei[1, 0] = (ei[1, 0] + 1) % 12
+    rewired.edge_index = ei
+    k = canonical_graph_key(rewired)
+    assert k not in seen
+    seen.add(k)
+
+    attr = _permuted(g, np.arange(12))
+    attr.edge_attr = attr.edge_attr.copy()
+    attr.edge_attr[0, 0] += np.float32(1e-3)
+    assert canonical_graph_key(attr) not in seen
+
+
+def pytest_cache_key_separates_identical_atoms_different_wiring():
+    """Four identical nodes as a path vs a star: pure content hashing
+    would collide; the WL refinement round must not."""
+    def mk(edges):
+        g = GraphData(
+            x=np.ones((4, 1), np.float32),
+            pos=np.zeros((4, 3), np.float32),
+        )
+        e = np.asarray(edges, np.int64).T
+        g.edge_index = np.concatenate([e, e[::-1]], axis=1)
+        return g
+
+    path = mk([(0, 1), (1, 2), (2, 3)])
+    star = mk([(0, 1), (0, 2), (0, 3)])
+    assert canonical_graph_key(path) != canonical_graph_key(star)
+
+
+def pytest_cache_key_is_direction_sensitive():
+    g = GraphData(
+        x=np.arange(6, dtype=np.float32).reshape(3, 2),
+        pos=np.zeros((3, 3), np.float32),
+    )
+    g.edge_index = np.asarray([[0, 1], [1, 2]], np.int64)
+    fwd = canonical_graph_key(g)
+    g.edge_index = np.asarray([[1, 2], [0, 1]], np.int64)
+    assert canonical_graph_key(g) != fwd
+
+
+# -- LRU mechanics ------------------------------------------------------------
+
+def _heads(rng, rows=4):
+    return [rng.random((1,)).astype(np.float64),
+            rng.random((rows, 1)).astype(np.float64)]
+
+
+def pytest_response_cache_lru_eviction_and_bounds():
+    rng = np.random.default_rng(21)
+    cache = ResponseCache(capacity=3, max_bytes=1 << 20)
+    keys = [ResponseCache.key(f"g{i}", "m", 1) for i in range(4)]
+    payloads = [_heads(rng) for _ in range(4)]
+    for k, p in zip(keys[:3], payloads[:3]):
+        cache.put(k, p)
+    # touch keys[0] so keys[1] is the LRU tail
+    assert cache.get(keys[0]) is not None
+    cache.put(keys[3], payloads[3])
+    assert len(cache) == 3
+    assert cache.get(keys[1]) is None  # evicted
+    assert cache.evictions == 1
+    hit = cache.get(keys[0])
+    np.testing.assert_array_equal(hit[1], payloads[0][1])
+    # returned arrays are copies: mutating a hit cannot poison the cache
+    hit[1][:] = -1.0
+    np.testing.assert_array_equal(cache.get(keys[0])[1], payloads[0][1])
+
+
+def pytest_response_cache_byte_bound_and_oversize_skip():
+    rng = np.random.default_rng(22)
+    small = _heads(rng, rows=4)
+    per_entry = sum(h.nbytes for h in small)
+    cache = ResponseCache(capacity=100, max_bytes=per_entry * 2)
+    for i in range(3):
+        cache.put(ResponseCache.key(f"g{i}", "m", 1), small)
+    assert len(cache) == 2  # byte bound bit before capacity did
+    assert cache.bytes <= per_entry * 2
+    # one oversized answer is skipped, not allowed to wipe the cache
+    cache.put(
+        ResponseCache.key("huge", "m", 1),
+        [rng.random((10_000, 8))],
+    )
+    assert len(cache) == 2
+    assert cache.get(ResponseCache.key("huge", "m", 1)) is None
+
+
+def pytest_response_cache_invalidate_filters():
+    rng = np.random.default_rng(23)
+    cache = ResponseCache(capacity=16, max_bytes=1 << 20)
+    for tenant in ("a", "b"):
+        for version in (1, 2):
+            cache.put(
+                ResponseCache.key("g", "m", version, tenant=tenant),
+                _heads(rng),
+            )
+    assert cache.invalidate(tenant="a") == 2
+    assert len(cache) == 2
+    assert cache.invalidate(model="m", version=1) == 1
+    assert cache.get(ResponseCache.key("g", "m", 2, tenant="b")) is not None
+    assert cache.invalidate() == 1
+    assert len(cache) == 0 and cache.bytes == 0
+
+
+def pytest_response_cache_from_env_knobs(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_CACHE", "0")
+    assert ResponseCache.from_env({"enabled": True}) is None
+    monkeypatch.setenv("HYDRAGNN_CACHE", "1")
+    monkeypatch.setenv("HYDRAGNN_CACHE_CAPACITY", "7")
+    monkeypatch.setenv("HYDRAGNN_CACHE_MAX_BYTES", "4096")
+    cache = ResponseCache.from_env()
+    assert cache.capacity == 7 and cache.max_bytes == 4096
+    monkeypatch.setenv("HYDRAGNN_CACHE_CAPACITY", "0")
+    with pytest.raises(ValueError):
+        ResponseCache.from_env()
+    monkeypatch.delenv("HYDRAGNN_CACHE")
+    monkeypatch.delenv("HYDRAGNN_CACHE_CAPACITY")
+    assert ResponseCache.from_env() is None  # no spec, no env: disabled
+
+
+# -- server integration: bitwise equality + promote fencing -------------------
+
+def pytest_server_cache_hit_is_bitwise_equal_and_promote_invalidates():
+    h = _harness()
+    registry, plan = h["registry"], h["plan"]
+    cache = ResponseCache(capacity=64, max_bytes=8 << 20)
+    rng = np.random.default_rng(31)
+    g = _graph(10, rng, with_targets=False)
+    with InferenceServer(
+        registry, plan, max_wait_s=0.002, cache=cache
+    ) as server:
+        v1 = registry.get("sage").version
+        fresh = server.predict(g, timeout=30)
+        assert cache.misses >= 1 and len(cache) == 1
+        hit = server.predict(g, timeout=30)
+        assert cache.hits == 1
+        for a, b in zip(fresh, hit):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b)  # bitwise, not allclose
+        # a permuted resubmission of the same structure also hits
+        perm = rng.permutation(10)
+        server.predict(_permuted(g, perm), timeout=30)
+        assert cache.hits == 2
+
+        # register a NEW version: it becomes implicitly active with no
+        # activation event at all — the case where invalidation never
+        # runs. The fence must still hold: lookups key on the new active
+        # version, so the v1 entry is unreachable, not stale-served.
+        registry.register(
+            "sage", h["model"], h["state"].params,
+            h["state"].batch_stats,
+        )
+        v2 = registry.get("sage").version
+        assert v2 != v1
+        assert len(cache) == 1  # v1 entry still resident...
+        hits_before = cache.hits
+        server.predict(g, timeout=30)
+        assert cache.hits == hits_before  # ...but a miss by construction
+        assert len(cache) == 2
+        assert {k[2] for k in cache._entries} == {v1, v2}
+
+        # an EFFECTIVE promote (activating the non-latest version) fires
+        # the activation listener, which reclaims the model's entries
+        registry.promote("sage", v1)
+        assert len(cache) == 0
+        server.predict(g, timeout=30)
+        ((_, _, cached_version, _),) = list(cache._entries.keys())
+        assert cached_version == v1
+
+        # rollback fences the same way, back to the v2 channel
+        registry.rollback("sage")
+        assert registry.get("sage").version == v2
+        assert len(cache) == 0
+        server.predict(g, timeout=30)
+        ((_, _, cached_version, _),) = list(cache._entries.keys())
+        assert cached_version == v2
+    stats = cache.stats()
+    assert stats["hits"] == 2 and stats["hit_ratio"] > 0
