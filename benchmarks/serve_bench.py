@@ -504,7 +504,30 @@ def _tenantize_spec(spec_path, ckdir, arch, tenants):
     return names
 
 
-def _diurnal_row(label, recs, deadline_s, tenant_names, **extra):
+def _fleet_bills(router):
+    """Merged per-replica cost bills scraped off the live ``/healthz``
+    endpoints (serve/costs.py: every replica's ledger rides its health
+    body) — the fleet-global statement per-phase pricing diffs."""
+    import urllib.request
+
+    from hydragnn_tpu.serve import costs as costs_mod
+
+    bills = []
+    for _rid, port in router.live_replicas():
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as resp:
+                body = json.loads(resp.read())
+        except Exception:
+            continue
+        if body.get("costs"):
+            bills.append(body["costs"])
+    return costs_mod.merge_bills(bills)
+
+
+def _diurnal_row(label, recs, deadline_s, tenant_names,
+                 tenant_costs=None, **extra):
     """One BENCH row per diurnal phase: fleet-wide aggregates plus the
     per-tenant p99/SLO-miss split the capacity model prices."""
     ok = [l for l, o, _, _ in recs if o == "ok"]
@@ -542,6 +565,8 @@ def _diurnal_row(label, recs, deadline_s, tenant_names, **extra):
         }
         if t_ok:
             sub["p99_ms"] = _pcts(t_ok)["p99_ms"]
+        if tenant_costs and name in tenant_costs:
+            sub.update(tenant_costs[name])
         per_tenant[name] = sub
     row["per_tenant"] = per_tenant
     row.update(extra)
@@ -591,6 +616,8 @@ def run_fleet_diurnal(tenants, lanes, replicas, clients, phase_s, periods,
         fleet.start(wait_serving=True, timeout=300)
         boot_s = time.perf_counter() - t0
         lane_names = [f"l{p}" for p in range(lanes)]
+        from hydragnn_tpu.obs.trace import Tracer
+
         router = FleetRouter(
             fleet.coord_dir,
             lease_s=0.75,
@@ -599,6 +626,9 @@ def run_fleet_diurnal(tenants, lanes, replicas, clients, phase_s, periods,
             retry_base_delay_s=0.05,
             lanes={name: p for p, name in enumerate(lane_names)},
             cache=ResponseCache(capacity=2048, max_bytes=64 << 20),
+            # off unless HYDRAGNN_TRACE_SAMPLE is set: spans land in the
+            # fleet's event stream for the obs trace CLI
+            tracer=Tracer.from_env(fleet.emit),
         )
         scaler = FleetAutoscaler(
             fleet,
@@ -687,12 +717,23 @@ def run_fleet_diurnal(tenants, lanes, replicas, clients, phase_s, periods,
                         "fleet_target_start": target0,
                         "fleet_target_end": fleet.target,
                         "replica_s": replica_s,
+                        # cumulative fleet ledger at phase end: per-phase
+                        # tenant attribution diffs consecutive snapshots
+                        "bill": _fleet_bills(router),
                     }
         finally:
             stop.set()
             for t in threads:
                 t.join(timeout=60)
             scaler.stop()
+            final_bill = _fleet_bills(router)
+            for name, trow in sorted(final_bill.get("tenants", {}).items()):
+                fleet.emit(
+                    "tenant_cost", tenant=name,
+                    device_s=trow["device_s"], flops=trow["flops"],
+                    requests=trow["requests"],
+                    replica_s=final_bill["replica_s"],
+                )
             cs = router.cache.stats()
             fleet.emit(
                 "cache_stats", hits=cs["hits"], misses=cs["misses"],
@@ -703,23 +744,64 @@ def run_fleet_diurnal(tenants, lanes, replicas, clients, phase_s, periods,
         with lock:
             per_phase = {p: list(v) for p, v in recs.items()}
         total_replica_s = total_ok = 0
+        prev_device: dict = {}
         for label, meta in phase_meta.items():
             phase_recs = per_phase.get(label, [])
             n_ok = sum(1 for _, o, _, _ in phase_recs if o == "ok")
             cost = (
                 meta["replica_s"] / 3600.0 * cost_per_replica_hour
             )
+            # per-tenant cost attribution: this phase's device-second
+            # deltas apportion the phase's replica cost (CostLedger
+            # bills per dispatched batch, so the shares price real
+            # device time, not request counts)
+            bill = meta.get("bill") or {}
+            deltas = {}
+            for name, trow in (bill.get("tenants") or {}).items():
+                d = trow["device_s"] - prev_device.get(name, 0.0)
+                deltas[name] = max(d, 0.0)
+            if bill.get("tenants"):
+                prev_device = {
+                    n: r["device_s"] for n, r in bill["tenants"].items()
+                }
+            busy_delta = sum(deltas.values())
+            tenant_costs = {
+                name: {
+                    "device_s": round(d, 6),
+                    "cost_share": round(
+                        d / busy_delta if busy_delta > 0 else 0.0, 4
+                    ),
+                    "cost": round(
+                        cost * (d / busy_delta) if busy_delta > 0
+                        else 0.0, 6
+                    ),
+                }
+                for name, d in deltas.items()
+            }
             rows.append(_diurnal_row(
                 label, phase_recs, deadline_s, tenant_names,
-                **{k: v for k, v in meta.items() if k != "replica_s"},
+                tenant_costs=tenant_costs,
+                **{k: v for k, v in meta.items()
+                   if k not in ("replica_s", "bill")},
                 cost_per_m_req=round(cost / max(n_ok, 1) * 1e6, 4),
             ))
             total_replica_s += meta["replica_s"]
             total_ok += n_ok
         everything = [r for v in per_phase.values() for r in v]
         total_cost = total_replica_s / 3600.0 * cost_per_replica_hour
+        from hydragnn_tpu.serve import costs as costs_mod
+
+        os.environ["HYDRAGNN_COST_PER_REPLICA_HOUR"] = str(
+            cost_per_replica_hour
+        )
+        cum_costs = {
+            name: {"device_s": trow["device_s"],
+                   "cost_share": trow.get("cost_share", 0.0)}
+            for name, trow in final_bill.get("tenants", {}).items()
+        }
         rows.append(_diurnal_row(
             "overall", everything, deadline_s, tenant_names,
+            tenant_costs=cum_costs,
             tenants=tenants, lanes=lanes, periods=periods,
             clients=clients, boot_s=round(boot_s, 2),
             cache_hit_ratio=cs["hit_ratio"],
@@ -727,6 +809,7 @@ def run_fleet_diurnal(tenants, lanes, replicas, clients, phase_s, periods,
             cost_per_m_req=round(
                 total_cost / max(total_ok, 1) * 1e6, 4
             ),
+            ledger=costs_mod.price_per_million(final_bill, total_ok),
         ))
     finally:
         shutil.rmtree(workdir, ignore_errors=True)

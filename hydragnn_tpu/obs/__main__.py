@@ -11,6 +11,9 @@ Subcommands::
         [--format text|markdown|json]
         [--straggler-factor F] [--min-steps N]
 
+    trace <run-or-coordination dir | events.jsonl>
+        [--format text|json] [--slow N]
+
 ``fleet`` merges every per-host event stream (rank 0's ``events.jsonl``
 plus the elastic hosts' ``events-host<k>.jsonl``) and the elastic
 heartbeat leases' step-time digests found under the directory into one
@@ -33,6 +36,7 @@ import sys
 
 from hydragnn_tpu.obs import ledger as ledger_mod
 from hydragnn_tpu.obs import report as report_mod
+from hydragnn_tpu.obs import trace as trace_mod
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -105,6 +109,29 @@ def build_parser() -> argparse.ArgumentParser:
         default=3,
         help="hosts with fewer recorded steps neither flag nor count "
         "toward the median (default: 3)",
+    )
+    tr = sub.add_parser(
+        "trace",
+        help="reconstruct request span trees from the merged event "
+        "streams and break down where the latency went",
+    )
+    tr.add_argument(
+        "dir",
+        help="run or coordination directory (searched recursively for "
+        "events*.jsonl) or one stream file",
+    )
+    tr.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    tr.add_argument(
+        "--slow",
+        type=int,
+        default=10,
+        help="slowest traces to list with their dominant segment "
+        "(default: 10)",
     )
     return p
 
@@ -242,12 +269,67 @@ def _run_fleet(args) -> int:
     return 0
 
 
+def _run_trace(args) -> int:
+    spans = trace_mod.load_span_events(args.dir)
+    if not spans:
+        print(
+            f"obs trace: no span events under {args.dir} "
+            "(was HYDRAGNN_TRACE_SAMPLE set for the run?)",
+            file=sys.stderr,
+        )
+        return 2
+    traces = trace_mod.build_traces(spans)
+    rollup = trace_mod.anatomy(traces)
+    if args.format == "json":
+        import json
+
+        rollup["slowest"] = rollup["slowest"][:max(args.slow, 0)]
+        print(json.dumps(rollup, indent=2, sort_keys=True))
+        return 0
+    print(f"request latency anatomy — {rollup['traces']} trace(s), "
+          f"{len(spans)} span(s)")
+    print()
+    print(f"  {'segment':<14} {'count':>6} {'p50 s':>10} {'p99 s':>10} "
+          f"{'total s':>10}")
+    for name, seg in rollup["segments"].items():
+        print(f"  {name:<14} {seg['count']:>6} {seg['p50_s']:>10.6f} "
+              f"{seg['p99_s']:>10.6f} {seg['total_s']:>10.6f}")
+    if rollup["groups"]:
+        print()
+        print("per tenant/lane (total seconds per segment):")
+        for group, segs in rollup["groups"].items():
+            parts = ", ".join(
+                f"{k}={v:.4f}" for k, v in segs.items() if k != "other"
+            )
+            print(f"  {group:<20} {parts}")
+    slow = rollup["slowest"][:max(args.slow, 0)]
+    if slow:
+        print()
+        print(f"slowest {len(slow)} trace(s):")
+        for row in slow:
+            flags = []
+            if row["slo_missed"]:
+                flags.append("SLO-MISSED")
+            if row["status"] not in (None, "ok"):
+                flags.append(str(row["status"]))
+            suffix = f"  [{' '.join(flags)}]" if flags else ""
+            print(
+                f"  {row['trace']}  {row['dur_s']:.6f}s  "
+                f"tenant={row['tenant'] or '-'} lane={row['lane'] or '-'} "
+                f"spans={row['spans']} "
+                f"dominant={row['dominant'] or '-'}{suffix}"
+            )
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "report":
         return _run_report(args)
     if args.command == "fleet":
         return _run_fleet(args)
+    if args.command == "trace":
+        return _run_trace(args)
     build_parser().print_help(sys.stderr)
     return 2
 

@@ -156,6 +156,28 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # the supervised replica target moved (reason names the trigger —
     # slo_pressure, forecast, scale_down, manual)
     "fleet_scaled": ("old_target", "new_target", "reason"),
+    # request tracing (obs/trace.py): one span of one request's causal
+    # tree — trace/span/parent are the tree ids (parent "" on the root),
+    # name is the segment (route/admit/cache_lookup/backoff/attempt on
+    # the router; queue_wait/batch_form/dispatch/readback on the
+    # replica), start is wall-clock unix seconds, dur_s the span's
+    # duration, attrs the per-span labels (tenant, lane, bucket, replica
+    # rid, cache hit/miss, retry ordinal, shed reason, ...). Flushed
+    # tail-based at the request's terminal outcome
+    "span": ("trace", "span", "parent", "name", "start", "dur_s", "attrs"),
+    # cost->quota feedback (serve/costs.py, HYDRAGNN_TENANT_COST_QUOTAS):
+    # a tenant's admission quota was shaved (reason over_cost) or its
+    # base quota restored (reason restored); cost_share is the tenant's
+    # share of the window's device time, fair_share its weight-
+    # proportional entitlement
+    "quota_adjusted": ("tenant", "old_quota", "new_quota", "reason",
+                       "cost_share", "fair_share"),
+    # tenant cost ledger (serve/costs.py): one per-tenant bill row for a
+    # measured window, appended by the bench/smoke load generators —
+    # device_s is attributed device wall-time, replica_s the window's
+    # fleet integrated replica-seconds the rows (plus idle) sum to
+    "tenant_cost": ("tenant", "device_s", "flops", "requests",
+                    "replica_s"),
 }
 
 _ENVELOPE = ("event", "ts", "seq")

@@ -181,12 +181,37 @@ def build_report(records: List[Dict]) -> Dict:
             }
         )
 
+    # request tracing (obs/trace.py): when the stream carries flushed
+    # span events, fold them into the latency-anatomy rollup the obs
+    # trace CLI prints — a serving run's report answers "where did the
+    # slow requests spend their time" inline
+    trace_anatomy = None
+    spans = [r for r in records if r["event"] == "span" and r.get("trace")]
+    if spans:
+        from hydragnn_tpu.obs import trace as trace_mod
+
+        trace_anatomy = trace_mod.anatomy(trace_mod.build_traces(spans))
+
+    # tenant bill (serve/costs.py): tenant_cost events carry CUMULATIVE
+    # per-tenant attribution, so the LAST record per tenant wins
+    tenant_bill: Dict[str, Dict] = {}
+    for r in records:
+        if r["event"] != "tenant_cost":
+            continue
+        tenant_bill[str(r.get("tenant") or "-")] = {
+            "device_s": _num(r.get("device_s")),
+            "flops": _num(r.get("flops")),
+            "requests": _num(r.get("requests")),
+            "replica_s": _num(r.get("replica_s")),
+        }
+
     counts = {
         key: sum(1 for r in records if r["event"] == key)
         for key in (
             "compile", "stall", "checkpoint_saved", "checkpoint_restored",
             "guard_skip", "guard_restore", "resume", "staged", "fit_chunk",
             "candidate_published", "canary_promoted", "canary_rejected",
+            "span", "quota_adjusted",
         )
     }
     counts["profile_done"] = sum(
@@ -268,6 +293,8 @@ def build_report(records: List[Dict]) -> Dict:
         "programs": programs,
         "collectives": collectives,
         "goodput": goodput,
+        "trace_anatomy": trace_anatomy,
+        "tenant_bill": tenant_bill,
         "counts": counts,
         "timeline": timeline,
     }
@@ -457,6 +484,37 @@ def _goodput_cols(report):
     return headers, rows
 
 
+_ANATOMY_HEADERS = ("segment", "count", "p50_s", "p99_s", "total_s")
+_BILL_HEADERS = ("tenant", "device_s", "flops", "requests", "replica_s")
+
+
+def _anatomy_rows(report) -> List[List[str]]:
+    anatomy = report.get("trace_anatomy") or {}
+    return [
+        [
+            name,
+            str(seg.get("count", 0)),
+            _fmt(seg.get("p50_s"), 5),
+            _fmt(seg.get("p99_s"), 5),
+            _fmt(seg.get("total_s"), 5),
+        ]
+        for name, seg in (anatomy.get("segments") or {}).items()
+    ]
+
+
+def _bill_rows(report) -> List[List[str]]:
+    return [
+        [
+            tenant,
+            _fmt(row.get("device_s"), 5),
+            _fmt_num(row.get("flops")),
+            _fmt(row.get("requests"), 6),
+            _fmt(row.get("replica_s"), 5),
+        ]
+        for tenant, row in sorted(report.get("tenant_bill", {}).items())
+    ]
+
+
 def render_text(report: Dict) -> str:
     lines = ["== run report =="]
     lines += _summary_lines(report)
@@ -484,6 +542,14 @@ def render_text(report: Dict) -> str:
             lines.append(
                 f"{axis}: {_fmt_bytes(report['collectives'][axis])}"
             )
+    if report.get("trace_anatomy"):
+        n = report["trace_anatomy"].get("traces", 0)
+        lines += ["", f"-- request latency anatomy ({n} traced "
+                  "request(s)) --"]
+        lines += _text_table(list(_ANATOMY_HEADERS), _anatomy_rows(report))
+    if report.get("tenant_bill"):
+        lines += ["", "-- tenant bill (device-time attribution) --"]
+        lines += _text_table(list(_BILL_HEADERS), _bill_rows(report))
     if report["timeline"]:
         lines += ["", "-- timeline (s after first event) --"]
         for item in report["timeline"]:
@@ -520,6 +586,14 @@ def render_markdown(report: Dict) -> str:
                 for axis in sorted(report["collectives"])
             ],
         )
+    if report.get("trace_anatomy"):
+        n = report["trace_anatomy"].get("traces", 0)
+        lines += ["", f"## Request latency anatomy ({n} traced "
+                  "request(s))", ""]
+        lines += _md_table(list(_ANATOMY_HEADERS), _anatomy_rows(report))
+    if report.get("tenant_bill"):
+        lines += ["", "## Tenant bill (device-time attribution)", ""]
+        lines += _md_table(list(_BILL_HEADERS), _bill_rows(report))
     if report["timeline"]:
         lines += ["", "## Timeline", ""]
         lines += _md_table(

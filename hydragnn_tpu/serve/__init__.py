@@ -35,6 +35,11 @@ from hydragnn_tpu.serve.cache import (
     ResponseCache,
     canonical_graph_key,
 )
+from hydragnn_tpu.serve.costs import (
+    CostLedger,
+    merge_bills,
+    price_per_million,
+)
 from hydragnn_tpu.serve.canary import (
     CanaryController,
     CanaryGates,
@@ -77,6 +82,7 @@ __all__ = [
     "CanaryGates",
     "CanaryMetrics",
     "CandidateChannel",
+    "CostLedger",
     "DeadlineExceeded",
     "FleetAutoscaler",
     "FleetMetrics",
@@ -101,7 +107,9 @@ __all__ = [
     "TenantOverQuota",
     "TenantSpec",
     "canonical_graph_key",
+    "merge_bills",
     "plan_from_layout",
     "plan_from_samples",
+    "price_per_million",
     "publish_candidate",
 ]

@@ -79,6 +79,7 @@ import numpy as np
 from hydragnn_tpu import coord
 from hydragnn_tpu.obs.events import RunEventLog
 from hydragnn_tpu.obs.metrics import MetricsRegistry
+from hydragnn_tpu.obs.trace import TRACE_HEADER, TraceContext
 from hydragnn_tpu.utils import faults
 
 REPLICA = "replica"  # coord kind AND member prefix for fleet leases
@@ -497,6 +498,11 @@ class ReplicaServer:
                     self._reply(200, body, "application/json")
                 elif self.path == "/metrics":
                     text = replica.server.metrics.render_prometheus()
+                    costs = getattr(replica.server, "costs", None)
+                    if costs is not None:
+                        # cost families append AFTER the server's stable
+                        # series so existing scrape offsets never shift
+                        text += costs.render_prometheus()
                     self._reply(200, text.encode(), "text/plain")
                 else:
                     self._reply(404, b"not found\n", "text/plain")
@@ -511,7 +517,9 @@ class ReplicaServer:
                 except (ValueError, OSError):
                     self._json(400, {"error": "unparseable request body"})
                     return
-                code, body, headers = replica.handle_predict(payload)
+                code, body, headers = replica.handle_predict(
+                    payload, trace_header=self.headers.get(TRACE_HEADER)
+                )
                 self._json(code, body, headers)
 
             def _json(self, code, obj, headers=None):
@@ -537,15 +545,34 @@ class ReplicaServer:
 
         return Handler
 
-    def handle_predict(self, payload: Dict):
+    def handle_predict(self, payload: Dict,
+                       trace_header: Optional[str] = None):
         """One request end to end; returns ``(status, body, headers)``.
         Factored out of the HTTP handler so tests can drive the exact
-        request path (fault hooks included) without a socket."""
+        request path (fault hooks included) without a socket.
+
+        A well-formed ``X-Hydragnn-Trace`` header arms span capture for
+        THIS request: replica-side spans (queue-wait, batch-form,
+        dispatch, readback) ride back to the router in the response
+        body's ``spans`` field, and EVERY body — success or error —
+        echoes the request's ``trace`` id, so a failed attempt is still
+        attributable to its end-to-end trace."""
         from hydragnn_tpu.serve.server import (
             DeadlineExceeded,
             ServerOverloaded,
         )
         from hydragnn_tpu.serve.buckets import GraphTooLarge
+
+        ctx = TraceContext.from_header(trace_header)
+
+        def _out(code, body, headers):
+            # the router (the trace's single event-stream writer) merges
+            # these spans under the attempt span it sent in the header
+            if ctx is not None:
+                body = dict(body)
+                body["trace"] = ctx.trace_id
+                body["spans"] = ctx.export()
+            return code, body, headers
 
         # fault hooks fire on ACCEPTED requests, before any work — the
         # SIGKILL-mid-request and slow-replica injections
@@ -562,7 +589,7 @@ class ReplicaServer:
         try:
             graph = decode_graph(payload["graph"])
         except (KeyError, ValueError, TypeError):
-            return 400, {"error": "malformed graph payload"}, {}
+            return _out(400, {"error": "malformed graph payload"}, {})
         deadline_s = payload.get("deadline_s")
         tenant = payload.get("tenant")
         try:
@@ -571,11 +598,12 @@ class ReplicaServer:
                 model=payload.get("model"),
                 deadline_s=deadline_s,
                 tenant=tenant,
+                trace=ctx,
             )
         except ServerOverloaded as e:
             # a TenantOverQuota carries the offender's name: the router
             # scopes its backoff to THAT tenant instead of the whole lane
-            return (
+            return _out(
                 503,
                 {"error": "overloaded",
                  "retry_after_s": e.retry_after_s,
@@ -583,14 +611,14 @@ class ReplicaServer:
                 {"Retry-After": f"{e.retry_after_s:.3f}"},
             )
         except GraphTooLarge as e:
-            return 413, {"error": str(e)}, {}
+            return _out(413, {"error": str(e)}, {})
         except (KeyError, ValueError) as e:
             # unknown model name / bad request fields: the request is
             # wrong, not the replica — 400 so the router does NOT retry
-            return 400, {"error": str(e)}, {}
+            return _out(400, {"error": str(e)}, {})
         except RuntimeError as e:  # server stopped (draining replica)
             retry = max(self.server.max_wait_s, 0.05)
-            return (
+            return _out(
                 503,
                 {"error": str(e), "retry_after_s": retry},
                 {"Retry-After": f"{retry:.3f}"},
@@ -600,26 +628,26 @@ class ReplicaServer:
                 deadline_s if deadline_s is not None else 60.0
             )
         except DeadlineExceeded as e:
-            return 504, {"error": str(e)}, {}
+            return _out(504, {"error": str(e)}, {})
         except TimeoutError:
-            return 504, {"error": "prediction timed out"}, {}
+            return _out(504, {"error": "prediction timed out"}, {})
         except RuntimeError as e:
             # stop-under-load: an accepted future failed at shutdown —
             # terminal, explicit, retryable elsewhere
             retry = max(self.server.max_wait_s, 0.05)
-            return (
+            return _out(
                 503,
                 {"error": str(e), "retry_after_s": retry},
                 {"Retry-After": f"{retry:.3f}"},
             )
         except Exception as e:  # dispatch error: failed, not dropped
-            return 500, {"error": str(e)}, {}
+            return _out(500, {"error": str(e)}, {})
         if self.is_canary and faults.nan_candidate(ordinal + 1):
             heads = [
                 np.full(np.shape(np.asarray(h)), np.nan, np.float32)
                 for h in heads
             ]
-        return (
+        return _out(
             200,
             {
                 "heads": [np.asarray(h).tolist() for h in heads],
@@ -1649,6 +1677,15 @@ def replica_main(spec_path: str) -> int:
     coord_dir = os.environ["HYDRAGNN_FLEET_DIR"]
     rid = int(os.environ["HYDRAGNN_FLEET_REPLICA"])
     server, arch, name = build_server_from_spec(spec)
+    # each replica gets its OWN event stream (RunEventLog's per-file seq
+    # forbids multi-process writers on one file); the obs CLI and the
+    # bench merge events*.jsonl from the coord dir
+    from hydragnn_tpu.serve.costs import CostLedger
+
+    cost_events = RunEventLog(
+        os.path.join(coord_dir, f"events-replica{rid}.jsonl")
+    )
+    server.costs = CostLedger(emit=cost_events.emit)
     replica = ReplicaServer(
         server,
         coord_dir,

@@ -68,9 +68,19 @@ from hydragnn_tpu.serve.fleet import (
     encode_graph,
     lease_serving,
 )
+from hydragnn_tpu.obs.trace import TRACE_HEADER, new_id as _new_span_id
 from hydragnn_tpu.serve.metrics import ServeMetrics
 from hydragnn_tpu.serve.server import DeadlineExceeded, ServerOverloaded
 from hydragnn_tpu.utils.retry import backoff_delay
+
+
+def _span(tr, name: str, since_mono: float, span_id=None, **attrs):
+    """Record one router-side span ending NOW (no-op with tracing off —
+    the disabled path pays one ``is None`` check)."""
+    if tr is None:
+        return
+    dur = time.monotonic() - since_mono
+    tr.record(name, time.time() - dur, dur, span_id=span_id, **attrs)
 
 
 class NoLiveReplica(ConnectionError):
@@ -128,6 +138,7 @@ class FleetRouter:
         default_deadline_s: Optional[float] = None,
         connect_timeout_s: float = 5.0,
         cache=None,
+        tracer=None,
     ):
         self.coord_dir = coord_dir
         self._target = target_replicas
@@ -160,6 +171,12 @@ class FleetRouter:
         self.cache = cache
         if cache is not None and cache.metrics is None:
             cache.metrics = self.metrics
+        # request tracing (obs/trace.py): when armed, every route()
+        # generates a trace id, propagates it to the replica attempts
+        # as an X-Hydragnn-Trace header, buffers the spans per request
+        # and tail-flushes at the terminal outcome. None = off (the
+        # default): the hot path pays one None check
+        self.tracer = tracer
         self._consensus: Dict[str, Optional[int]] = {}
         # tenant -> model name, learned from response bodies: lets a
         # tenant-routed request build its cache key without the router
@@ -335,13 +352,55 @@ class FleetRouter:
         exhausted on non-shed failures)."""
         if deadline_s is None:
             deadline_s = self.default_deadline_s
+        tracer = self.tracer
+        tr = (
+            tracer.start(lane=lane, tenant=tenant, model=model)
+            if tracer is not None
+            else None
+        )
+        if tr is None:
+            return self._route(
+                graph, model, lane, deadline_s, raw, tenant, None
+            )
+        t0 = time.monotonic()
+        try:
+            out = self._route(
+                graph, model, lane, deadline_s, raw, tenant, tr
+            )
+        except DeadlineExceeded:
+            # a deadline-carrying request's expiry IS an SLO miss: the
+            # tail rules keep 100% of these traces at any non-zero rate
+            tr.finish("deadline_exceeded",
+                      slo_missed=deadline_s is not None, error=True)
+            raise
+        except ServerOverloaded as e:
+            tr.finish("shed", error=True,
+                      retry_after_s=round(e.retry_after_s, 6))
+            raise
+        except BaseException as e:
+            tr.finish("error", error=True, error_type=type(e).__name__)
+            raise
+        elapsed = time.monotonic() - t0
+        slo_missed = deadline_s is not None and elapsed > deadline_s
+        tr.finish("ok", slo_missed=slo_missed)
+        return out
+
+    def _route(self, graph, model, lane, deadline_s, raw, tenant, tr):
         t0 = time.monotonic()
         deadline = None if deadline_s is None else t0 + deadline_s
-        live = self._admit(lane, tenant)  # ServerOverloaded propagates
+        t_admit = time.monotonic()
+        try:
+            live = self._admit(lane, tenant)  # ServerOverloaded raises
+        except ServerOverloaded as e:
+            _span(tr, "admit", t_admit, lane=lane, shed="admission",
+                  retry_after_s=round(e.retry_after_s, 6))
+            raise
+        _span(tr, "admit", t_admit, lane=lane)
         self.metrics.on_submit()
         self.fleet_metrics.registry.inc("requests_routed_total")
         cache_name = cache_key = None
         if self.cache is not None:
+            t_cache = time.monotonic()
             from hydragnn_tpu.serve.cache import (
                 ResponseCache,
                 canonical_graph_key,
@@ -362,6 +421,9 @@ class FleetRouter:
                 )
                 cached = self.cache.get(cache_key)
                 if cached is not None:
+                    _span(tr, "cache_lookup", t_cache, hit=True)
+                    if tr is not None:
+                        tr.attrs["cached"] = True
                     now = time.monotonic()
                     self.metrics.on_response()
                     self.metrics.on_response_latency(now - t0)
@@ -377,6 +439,10 @@ class FleetRouter:
                             "cached": True,
                         }
                     return cached
+            # miss (or skipped: no consensus/model name yet) — fall
+            # through to dispatch with the lookup time on record
+            _span(tr, "cache_lookup", t_cache, hit=False,
+                  skipped=cache_key is None)
         tried: set = set()
         shed_hint: Optional[float] = None
         last_error: Optional[BaseException] = None
@@ -394,7 +460,9 @@ class FleetRouter:
                     break
                 if not self.budget.try_acquire():
                     break
+                t_back = time.monotonic()
                 time.sleep(delay)
+                _span(tr, "backoff", t_back, ordinal=attempt)
                 self.metrics_on_retry(lane, tenant)
                 live = self.live_replicas()
                 if not live:
@@ -416,18 +484,36 @@ class FleetRouter:
                     f"deadline expired after {time.monotonic() - t0:.3f}s "
                     f"({attempt} attempt(s))"
                 )
+            attempt_span = None if tr is None else _new_span_id()
+            t_att = time.monotonic()
             try:
-                status, body = self._post(rid, port, graph, model,
-                                          remaining, tenant)
+                status, body = self._post(
+                    rid, port, graph, model, remaining, tenant,
+                    trace_header=(
+                        None if tr is None else tr.header(attempt_span)
+                    ),
+                )
             except (urllib.error.URLError, http.client.HTTPException,
                     ConnectionError, OSError, TimeoutError) as e:
                 # transport failure: the replica just died or is being
                 # respawned — retryable (HTTPException covers a kill
                 # landing mid-response: IncompleteRead/BadStatusLine)
+                _span(tr, "attempt", t_att, span_id=attempt_span,
+                      replica=rid, ordinal=attempt,
+                      error=type(e).__name__)
                 self._invalidate(rid)
                 self.fleet_metrics.registry.inc("replica_errors_total")
                 last_error = e
                 continue
+            if tr is not None:
+                # the replica's spans (queue_wait/batch_form/dispatch/
+                # readback) ride every response body once the header
+                # armed them — success AND failure bodies; retried
+                # attempts join the SAME trace under their attempt span
+                tr.merge(body.get("spans"))
+                tr.attrs["attempts"] = attempt + 1
+                _span(tr, "attempt", t_att, span_id=attempt_span,
+                      replica=rid, ordinal=attempt, status=status)
             if status == 200:
                 now = time.monotonic()
                 self.budget.on_success()
@@ -492,6 +578,8 @@ class FleetRouter:
                         )
                     self.fleet_metrics.on_tenant_shed(tenant)
                     self.metrics.on_error()
+                    if tr is not None:
+                        tr.attrs["shed"] = "tenant_quota"
                     raise ServerOverloaded(retry_after_s=shed_hint)
                 self.fleet_metrics.registry.inc("replica_errors_total")
                 last_error = ServerOverloaded(retry_after_s=shed_hint)
@@ -531,6 +619,8 @@ class FleetRouter:
             # classifies it as a shed
             self.metrics.on_error()
             self.fleet_metrics.on_lane_shed(lane)
+            if tr is not None:
+                tr.attrs["shed"] = "all_replicas_shed"
             raise ServerOverloaded(retry_after_s=shed_hint)
         self.metrics.on_error()
         raise NoLiveReplica(
@@ -563,7 +653,8 @@ class FleetRouter:
 
     def _post(self, rid: int, port: int, graph, model: Optional[str],
               deadline_s: Optional[float],
-              tenant: Optional[str] = None) -> Tuple[int, Dict]:
+              tenant: Optional[str] = None,
+              trace_header: Optional[str] = None) -> Tuple[int, Dict]:
         payload = {"graph": encode_graph(graph)}
         if model is not None:
             payload["model"] = model
@@ -572,10 +663,13 @@ class FleetRouter:
         if tenant is not None:
             payload["tenant"] = tenant
         data = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if trace_header is not None:
+            headers[TRACE_HEADER] = trace_header
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/predict",
             data=data,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         # urllib's timeout bounds the WHOLE request, not just the

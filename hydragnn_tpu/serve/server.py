@@ -125,11 +125,11 @@ class ServeFuture:
 class _Request:
     __slots__ = (
         "graph", "entry", "bucket", "sizes", "future", "enqueued_at",
-        "deadline", "fallback", "tenant", "cache_key",
+        "deadline", "fallback", "tenant", "cache_key", "trace",
     )
 
     def __init__(self, graph, entry, bucket, sizes, deadline, fallback,
-                 tenant=None, cache_key=None):
+                 tenant=None, cache_key=None, trace=None):
         self.graph = graph
         self.entry = entry
         self.bucket = bucket
@@ -140,6 +140,7 @@ class _Request:
         self.fallback = fallback  # served above its node-natural bucket
         self.tenant = tenant  # admission/packing identity (None = untenanted)
         self.cache_key = cache_key  # fill the response cache on dispatch
+        self.trace = trace  # armed TraceContext (obs/trace.py) or None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -168,6 +169,7 @@ class InferenceServer:
         metrics: Optional[ServeMetrics] = None,
         tenants=None,
         cache=None,
+        costs=None,
     ):
         self.registry = registry
         self.plan = plan
@@ -191,6 +193,12 @@ class InferenceServer:
             registry.add_activation_listener(
                 lambda name, version: cache.invalidate(model=name)
             )
+        # tenant cost ledger (serve/costs.py): every dispatched batch's
+        # device time + compiled FLOPs attributed to its tenant, with
+        # the cost->quota feedback tick riding the batcher loop
+        self.costs = costs
+        self._shape_flops: Dict[Tuple, float] = {}
+        self._last_flops = 0.0  # batcher-thread-only scratch
         self._queue: "queue.Queue[_Request]" = queue.Queue(
             maxsize=self.queue_capacity
         )
@@ -418,6 +426,7 @@ class InferenceServer:
         model: Optional[str] = None,
         deadline_s: Optional[float] = None,
         tenant: Optional[str] = None,
+        trace=None,
     ) -> ServeFuture:
         """Enqueue one graph; returns a future resolving to a list of
         per-head numpy outputs (graph head: ``[dim]``, node head:
@@ -469,6 +478,11 @@ class InferenceServer:
             )
             cached = self.cache.get(cache_key)
             if cached is not None:
+                if trace is not None:
+                    trace.record(
+                        "cache_lookup", time.time(), 0.0, hit=True,
+                        side="replica", tenant=tenant,
+                    )
                 fut = ServeFuture()
                 fut.version = entry.version
                 fut.model_name = entry.name
@@ -499,6 +513,7 @@ class InferenceServer:
             fallback=bucket > self.plan.natural_bucket(graph.num_nodes),
             tenant=tenant,
             cache_key=cache_key,
+            trace=trace,
         )
         if tenant is not None:
             tenants = self.tenants
@@ -563,6 +578,10 @@ class InferenceServer:
                         break
                     self._admit_pending(more)
             self._flush_due()
+            if self.costs is not None:
+                # cost->quota feedback tick: a clock read between
+                # windows, the share comparison once per window
+                self.costs.maybe_adjust_quotas(self.tenants)
             self.metrics.set_queue_depth(self._depth())
         # shutdown flush: serve whatever is pending so stop(drain=True)
         # never strands accepted work
@@ -636,6 +655,12 @@ class InferenceServer:
         expired = 0
         for req in group:
             if req.expired(now):
+                if req.trace is not None:
+                    dur = now - req.enqueued_at
+                    req.trace.record(
+                        "queue_wait", time.time() - dur, dur,
+                        bucket=key[3], tenant=req.tenant, expired=True,
+                    )
                 req.future.set_exception(
                     DeadlineExceeded(
                         "deadline expired after "
@@ -672,11 +697,15 @@ class InferenceServer:
                         real_nodes: int):
         entry = requests[0].entry
         t0 = time.monotonic()
+        traced = [r for r in requests if r.trace is not None]
+        w0 = time.time() if traced else 0.0
         try:
             batch, coords = self.plan.pack(
                 [r.graph for r in requests], bucket
             )
+            t_pack = time.monotonic()
             outputs = self._dispatch_compiled(entry, bucket, batch)
+            t_disp = time.monotonic()
             # ONE explicit bulk fetch for the whole batch's heads — the
             # per-head np.asarray() it replaces was an implicit transfer
             # per head, which the transfer-guard test now hard-errors
@@ -693,6 +722,33 @@ class InferenceServer:
         now = time.monotonic()
         self._batch_seq += 1
         batch_seq = self._batch_seq
+        for req in traced:
+            # the batch's phase boundaries, one span set per traced
+            # rider: queue_wait ends where packing starts; wall starts
+            # derive from w0 (the monotonic t0's wall reading) so spans
+            # from this process and the router share one timeline
+            queue_s = max(t0 - req.enqueued_at, 0.0)
+            req.trace.record(
+                "queue_wait", w0 - queue_s, queue_s,
+                bucket=bucket, tenant=req.tenant,
+            )
+            req.trace.record(
+                "batch_form", w0, t_pack - t0,
+                bucket=bucket, batch_graphs=len(requests),
+            )
+            req.trace.record(
+                "dispatch", w0 + (t_pack - t0), t_disp - t_pack,
+                bucket=bucket, batch_seq=batch_seq,
+            )
+            req.trace.record(
+                "readback", w0 + (t_disp - t0), now - t_disp,
+                bucket=bucket,
+            )
+        if self.costs is not None:
+            self.costs.note_batch(
+                requests[0].tenant, bucket, len(requests),
+                batch_seconds=now - t0, flops=self._last_flops,
+            )
         for req, (g, off, n) in zip(requests, coords):
             per_head = []
             for ihead, kind in enumerate(entry.output_type):
@@ -775,13 +831,47 @@ class InferenceServer:
                 for a in jax.tree_util.tree_leaves(batch)
             ),
         )
-        if shape_key not in self._seen_shapes:
+        novel = shape_key not in self._seen_shapes
+        if novel:
             self._seen_shapes.add(shape_key)
             self.metrics.on_compile()
         dev_batch = jax.tree_util.tree_map(np.asarray, batch)
-        return self._predict_fn(entry)(
+        out = self._predict_fn(entry)(
             entry.params, entry.batch_stats, dev_batch
         )
+        if self.costs is not None:
+            if novel:
+                # first sight of this (version, shape): introspection
+                # (when live) just captured the executable's
+                # cost_analysis — resolve its per-dispatch FLOPs once
+                self._shape_flops[shape_key] = self._captured_flops(
+                    entry, dev_batch
+                )
+            self._last_flops = self._shape_flops.get(shape_key, 0.0)
+        return out
+
+    def _captured_flops(self, entry: ModelEntry, dev_batch) -> float:
+        """This bucket's compiled per-dispatch FLOPs from introspect's
+        capture record (0 when introspection is off or the backend has
+        no cost model) — the CostLedger's FLOP attribution source."""
+        try:
+            from hydragnn_tpu.obs import introspect
+
+            name = f"serve_predict:{entry.name}:v{entry.version}"
+            label = introspect.bucket_label(
+                name,
+                introspect.signature_key(
+                    (entry.params, entry.batch_stats, dev_batch), {}
+                ),
+            )
+            for rec in introspect.captured(name):
+                if rec.get("bucket") == label:
+                    return float(
+                        (rec.get("cost") or {}).get("flops", 0.0)
+                    )
+        except Exception:
+            pass
+        return 0.0
 
     # ---- multi-tenant conveniences -------------------------------------
     def warm_tenant(self, tenant: str, timeout: float = 120.0,
@@ -819,4 +909,6 @@ class InferenceServer:
             out["tenants"] = self.tenants.describe()
         if self.cache is not None:
             out["cache"] = self.cache.stats()
+        if self.costs is not None:
+            out["costs"] = self.costs.bill()
         return out

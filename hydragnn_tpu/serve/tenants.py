@@ -136,6 +136,10 @@ class TenantManager:
         self._specs: Dict[str, TenantSpec] = {}
         self._in_flight: Dict[str, int] = {}
         self._deficit: Dict[str, float] = {}
+        # cost-feedback admission overrides (serve/costs.py): a shaved
+        # quota lives HERE, never on the spec — clearing the override
+        # restores the spec'd base exactly
+        self._quota_override: Dict[str, int] = {}
         self.admitted_total: Dict[str, int] = {}
         self.shed_total: Dict[str, int] = {}
         for spec in specs or ():
@@ -170,9 +174,36 @@ class TenantManager:
     def model_for(self, tenant: str) -> str:
         return self.spec(tenant).model
 
-    def quota_for(self, tenant: str) -> int:
+    def base_quota_for(self, tenant: str) -> int:
+        """The spec'd (or default) quota, ignoring any cost-feedback
+        override — what :meth:`set_quota_override` restores to."""
         spec = self.spec(tenant)
         return self.default_quota if spec.quota is None else spec.quota
+
+    def quota_for(self, tenant: str) -> int:
+        base = self.base_quota_for(tenant)  # KeyError on unknown tenant
+        with self._lock:
+            override = self._quota_override.get(tenant)
+        return base if override is None else min(override, base)
+
+    def set_quota_override(self, tenant: str, quota: Optional[int]):
+        """Install (or with ``None`` clear) a cost-feedback admission
+        override for ``tenant``. Overrides only ever SHAVE — an override
+        above the base quota is clamped at read time."""
+        self.spec(tenant)  # KeyError on unknown tenant
+        if quota is not None and int(quota) < 1:
+            raise ValueError(
+                f"tenant {tenant!r} quota override must be >= 1"
+            )
+        with self._lock:
+            if quota is None:
+                self._quota_override.pop(tenant, None)
+            else:
+                self._quota_override[tenant] = int(quota)
+
+    def quota_override(self, tenant: str) -> Optional[int]:
+        with self._lock:
+            return self._quota_override.get(tenant)
 
     def load_models(self, registry) -> Dict[str, int]:
         """HBM-pack every tenant's model into ``registry`` (idempotent
@@ -288,11 +319,13 @@ class TenantManager:
             return {
                 name: {
                     "model": spec.model,
-                    "quota": (
+                    "quota": min(
+                        self._quota_override.get(name, 1 << 30),
                         self.default_quota
                         if spec.quota is None
-                        else spec.quota
+                        else spec.quota,
                     ),
+                    "quota_override": self._quota_override.get(name),
                     "weight": spec.weight,
                     "in_flight": self._in_flight.get(name, 0),
                     "admitted": self.admitted_total.get(name, 0),

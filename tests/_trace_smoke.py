@@ -1,0 +1,543 @@
+"""CI request-tracing + tenant-cost smoke (standalone, NOT a pytest module).
+
+The ISSUE 18 e2e gate: 2 tenants on a 2-replica spec-driven fleet (one
+replica slowed by fault injection) behind a tracing FleetRouter —
+
+1. steady state at ``HYDRAGNN_TRACE_SAMPLE=1.0``: every request flushes
+   ONE schema-valid span tree (route -> admit -> cache_lookup ->
+   attempt -> queue_wait/batch_form/dispatch/readback) whose segment
+   durations sum to the end-to-end latency,
+2. SIGKILL failover mid-load: a retried request across TWO replicas
+   lands in ONE trace — two attempt spans with distinct replica ids,
+   the final one 200 with the replica's queue/dispatch spans merged,
+3. tail capture at ``HYDRAGNN_TRACE_SAMPLE=0.01``: 100% of SLO-missed
+   requests flush a complete trace (the head sample would keep ~1%),
+4. ``python -m hydragnn_tpu.obs trace`` reconstructs the trees and
+   names queue_wait the dominant segment (the spec's wait cap IS the
+   dominant cost under sporadic load),
+5. per-tenant device-time bills scraped live from ``/healthz`` merge
+   into a fleet bill whose tenant + idle seconds sum to the integrated
+   replica-seconds within 1%,
+6. cost->quota feedback: the SAME flood run twice — feedback off, then
+   ``HYDRAGNN_TENANT_COST_QUOTAS=1`` — shaves the flooding tenant's
+   quota (schema-valid ``quota_adjusted`` in the replica streams, down
+   to the floor) and the quiet tenant's SLO-miss ratio does not get
+   worse (strictly improves whenever the baseline had misses),
+7. every event stream validates against the documented schema.
+
+Usage: python tests/_trace_smoke.py <workdir>
+"""
+
+import contextlib
+import copy
+import io
+import json
+import os
+import pickle
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _fleet_smoke import ARCH, make_graphs  # noqa: E402
+
+REQUEST_DEADLINE_S = 30.0
+# fleet A (tracing): a sizeable wait cap makes queue_wait the dominant
+# segment of every sporadic request — exactly what the anatomy table
+# must surface. The SLO phase runs FIRST: replica 1's first 10 requests
+# are slowed PAST the deadline but still answer 200, so an SLO-missed
+# request flushes a COMPLETE tree (replica queue/dispatch spans on
+# board) rather than a router-side timeout stub
+TRACE_MAX_WAIT_S = 0.3
+SLO_DEADLINE_S = 0.6
+SLOW_REPLICA_FAULT = "1:0:10@0.4"  # replica 1: +0.4s, first 10 requests
+STEADY_REQUESTS = 12
+FAILOVER_REQUESTS = 16
+SLO_REQUESTS = 24
+
+# fleet B (feedback): tiny wait cap, one flooding tenant, shave fast
+FEEDBACK_MAX_WAIT_S = 0.01
+FLOOD_CLIENTS = 32
+FEEDBACK_ENV = {
+    "HYDRAGNN_TENANT_COST_QUOTAS": "1",
+    "HYDRAGNN_TENANT_COST_WINDOW_S": "0.4",
+    "HYDRAGNN_TENANT_COST_PATIENCE": "2",
+    "HYDRAGNN_TENANT_COST_SHAVE": "0.25",
+    "HYDRAGNN_TENANT_COST_FLOOR": "0.0625",
+}
+TENANT_QUOTA = 64
+QUOTA_FLOOR = 4  # ceil(64 * 0.0625)
+FLOOD_WARMUP_S = 3.0
+BETA_PROBES = 14
+
+
+def build_artifacts(workdir):
+    """One checkpoint, plan samples, and two fleet specs sharing them:
+    a tracing spec (large wait cap) and a feedback spec (small cap)."""
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.serve.buckets import plan_from_samples
+    from hydragnn_tpu.train.checkpoint import save_model
+    from hydragnn_tpu.train.trainer import Trainer
+
+    samples = make_graphs(32, seed=23)
+    plan = plan_from_samples(samples, max_batch_graphs=4, num_buckets=2)
+    model = create_model_config(dict(ARCH))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    init_batch, _ = plan.pack([samples[0]], 0)
+    state = trainer.init_state(init_batch, seed=0)
+    ckdir = os.path.join(workdir, "ck")
+    save_model(state, "base", path=ckdir)
+    samples_path = os.path.join(workdir, "samples.pkl")
+    with open(samples_path, "wb") as f:
+        pickle.dump(samples, f)
+
+    def write_spec(path, max_wait_s):
+        spec = {
+            "checkpoint": {"name": "base", "path": ckdir},
+            "arch": ARCH,
+            "model_name": "m",
+            "samples": samples_path,
+            "plan": {"max_batch_graphs": 4, "num_buckets": 2},
+            "server": {"max_wait_s": max_wait_s, "queue_capacity": 256},
+            "tenants": [
+                {"name": "acme", "model": "m", "quota": TENANT_QUOTA},
+                {"name": "beta", "model": "m", "quota": TENANT_QUOTA},
+            ],
+            "cache": {"enabled": True},
+        }
+        with open(path, "w") as f:
+            json.dump(spec, f)
+        return path
+
+    trace_spec = write_spec(
+        os.path.join(workdir, "spec-trace.json"), TRACE_MAX_WAIT_S
+    )
+    feedback_spec = write_spec(
+        os.path.join(workdir, "spec-feedback.json"), FEEDBACK_MAX_WAIT_S
+    )
+    return samples, trace_spec, feedback_spec
+
+
+def _jitter(rng, samples):
+    """A unique graph per request: repeated structures are absorbed by
+    the response cache without ever reaching a replica."""
+    import numpy as np
+
+    g = copy.deepcopy(samples[int(rng.integers(len(samples)))])
+    g.pos = (
+        g.pos + rng.normal(scale=1e-3, size=g.pos.shape)
+    ).astype(np.float32)
+    return g
+
+
+def _scrape_fleet_bill(router):
+    """Live per-replica cost bills from ``/healthz``, fleet-merged."""
+    import urllib.request
+
+    from hydragnn_tpu.serve.costs import merge_bills
+
+    bills = []
+    for _rid, port in router.live_replicas():
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as resp:
+                body = json.loads(resp.read().decode())
+        except Exception:
+            continue
+        if isinstance(body.get("costs"), dict):
+            bills.append(body["costs"])
+    return merge_bills(bills)
+
+
+def _assert_linked_tree(trace):
+    """Every span's parent resolves inside the trace (or is the explicit
+    root marker) and the root route span exists."""
+    assert trace["root"] is not None, trace["spans"]
+    ids = {s["span"] for s in trace["spans"]}
+    for s in trace["spans"]:
+        assert s["parent"] == "" or s["parent"] in ids, (
+            "orphan span",
+            s,
+        )
+
+
+def tracing_fleet(workdir, samples, spec_path):
+    """Fleet A: the tracing phases. Returns the measured facts the
+    final assertions consume."""
+    import numpy as np
+
+    from hydragnn_tpu.obs import trace as trace_mod
+    from hydragnn_tpu.obs.__main__ import main as obs_main
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.obs.trace import Tracer
+    from hydragnn_tpu.serve import (
+        DeadlineExceeded,
+        FleetRouter,
+        ResponseCache,
+        ServingFleet,
+    )
+
+    coord_dir = os.path.join(workdir, "trace-coord")
+    log_dir = os.path.join(workdir, "trace-log")
+    os.environ["HYDRAGNN_FAULT_SLOW_REPLICA"] = SLOW_REPLICA_FAULT
+    fleet = ServingFleet(
+        coord_dir, 2, spec_path=spec_path, heartbeat_s=0.1,
+        lease_s=0.75, poll_s=0.05, log_dir=log_dir,
+    )
+    t_boot = time.monotonic()
+    fleet.start(wait_serving=True, timeout=300)
+    boot_s = time.monotonic() - t_boot
+    assert fleet.health()["live"] == 2, fleet.health()
+    router = FleetRouter(
+        coord_dir, lease_s=0.75, scan_interval_s=0.1, max_attempts=6,
+        retry_base_delay_s=0.05,
+        cache=ResponseCache(capacity=256, max_bytes=16 << 20),
+    )
+    rng = np.random.default_rng(7)
+    try:
+        # ---- phase 1: tail capture at a 1% head rate -------------------
+        # round-robin sends every other request to the slowed replica:
+        # those SUCCEED past their deadline (slo_missed on a 200), so
+        # the tail rule must flush them — queue/dispatch spans included
+        # — while the ~50% fast successes stay at the 1% head rate
+        os.environ["HYDRAGNN_TRACE_SAMPLE"] = "0.01"
+        tail_tracer = Tracer.from_env(fleet.emit)
+        router.tracer = tail_tracer
+        client_misses = 0
+        for _ in range(SLO_REQUESTS):
+            t0 = time.monotonic()
+            try:
+                router.route(
+                    _jitter(rng, samples), tenant="beta",
+                    deadline_s=SLO_DEADLINE_S,
+                )
+            except DeadlineExceeded:
+                client_misses += 1
+                continue
+            if time.monotonic() - t0 > SLO_DEADLINE_S:
+                client_misses += 1
+        tail_snap = tail_tracer.metrics.snapshot()
+
+        # ---- phase 2: steady state, every trace flushed ----------------
+        os.environ["HYDRAGNN_TRACE_SAMPLE"] = "1.0"
+        router.tracer = Tracer.from_env(fleet.emit)
+        for i in range(STEADY_REQUESTS):
+            tenant = ("acme", "beta")[i % 2]
+            raw = router.route(
+                _jitter(rng, samples), tenant=tenant,
+                deadline_s=REQUEST_DEADLINE_S, raw=True,
+            )
+            assert raw["trace"], "response body must echo the trace id"
+
+        # ---- phase 3: SIGKILL replica 0 -> failover in ONE trace -------
+        os.kill(fleet.replica_pid(0), signal.SIGKILL)
+        for i in range(FAILOVER_REQUESTS):
+            tenant = ("acme", "beta")[i % 2]
+            router.route(
+                _jitter(rng, samples), tenant=tenant,
+                deadline_s=REQUEST_DEADLINE_S,
+            )
+        fleet.wait_serving(timeout=300)  # the supervisor heals 1 -> 2
+        assert fleet.health()["live"] == 2, fleet.health()
+
+        # ---- per-tenant device-time bills sum to replica-seconds ------
+        bill = _scrape_fleet_bill(router)
+        assert bill, "no cost bills scraped from /healthz"
+        busy = sum(t["device_s"] for t in bill["tenants"].values())
+        assert abs(busy + bill["idle_s"] - bill["replica_s"]) <= (
+            0.01 * bill["replica_s"] + 1e-6
+        ), bill
+        for tenant in ("acme", "beta"):
+            row = bill["tenants"][tenant]
+            assert row["requests"] > 0 and row["device_s"] > 0, bill
+        # the load generator appends the fleet bill to the event stream
+        # (the serve_bench pattern) so `obs report` can print the bill
+        for name, row in bill["tenants"].items():
+            fleet.emit(
+                "tenant_cost", tenant=name,
+                device_s=round(row["device_s"], 6),
+                flops=row.get("flops", 0.0),
+                requests=row.get("requests", 0),
+                replica_s=round(bill["replica_s"], 6),
+            )
+    finally:
+        fleet.stop()
+        os.environ.pop("HYDRAGNN_FAULT_SLOW_REPLICA", None)
+        os.environ.pop("HYDRAGNN_TRACE_SAMPLE", None)
+
+    # ---- the flushed stream is schema-valid and reconstructs ----------
+    recs = validate_events(
+        os.path.join(log_dir, "events.jsonl"),
+        require=["span", "tenant_cost"],
+    )
+    spans = [r for r in recs if r["event"] == "span"]
+    traces = trace_mod.build_traces(spans)
+    for t in traces.values():
+        _assert_linked_tree(t)
+
+    slo_traces = [
+        t for t in traces.values()
+        if (t["root"]["attrs"] or {}).get("slo_missed")
+    ]
+    ok_traces = [
+        t for t in traces.values()
+        if (t["root"]["attrs"] or {}).get("status") == "ok"
+        and not (t["root"]["attrs"] or {}).get("slo_missed")
+    ]
+    # phases 2+3 ran at sample=1.0: every ok request flushed. Phase 1's
+    # fast successes ran at the 1% head rate — at most a couple extra
+    n_full = STEADY_REQUESTS + FAILOVER_REQUESTS
+    assert n_full <= len(ok_traces) <= n_full + 4, len(ok_traces)
+    # 100% tail capture: one flushed SLO-missed trace per client miss
+    assert client_misses >= 6, client_misses
+    assert len(slo_traces) == client_misses, (
+        len(slo_traces), client_misses,
+    )
+    assert tail_snap["trace_tail_total"] >= client_misses, tail_snap
+
+    dominant_ok = 0
+    for t in ok_traces:
+        names = {s["name"] for s in t["spans"]}
+        # the full anatomy: router spans + the replica spans that rode
+        # the response body back
+        for required in (
+            "route", "admit", "cache_lookup", "attempt",
+            "queue_wait", "batch_form", "dispatch", "readback",
+        ):
+            assert required in names, (required, sorted(names))
+        segs = trace_mod.segment_durations(t)
+        total = sum(segs.values())
+        root_dur = float(t["root"]["dur_s"])
+        assert abs(total - root_dur) <= max(0.1 * root_dur, 0.05), (
+            "segments must sum to the end-to-end latency",
+            segs, root_dur,
+        )
+        if trace_mod.dominant_segment(t) == "queue_wait":
+            dominant_ok += 1
+    assert dominant_ok >= 0.8 * len(ok_traces), (
+        dominant_ok, len(ok_traces),
+    )
+    # an SLO-missed trace is complete too: the replica-side expiry 504
+    # carries its queue_wait span home before the router gives up
+    for t in slo_traces:
+        names = {s["name"] for s in t["spans"]}
+        assert {"route", "admit", "attempt"} <= names, sorted(names)
+    with_queue = sum(
+        1 for t in slo_traces
+        if any(s["name"] == "queue_wait" for s in t["spans"])
+    )
+    assert with_queue >= 0.8 * len(slo_traces), (
+        with_queue, len(slo_traces),
+    )
+
+    # the failover proof: ONE trace, two attempts, two replicas, final
+    # 200 with the winning replica's spans merged under its attempt
+    failover = None
+    for t in traces.values():
+        attempts = [s for s in t["spans"] if s["name"] == "attempt"]
+        replicas = {s["attrs"].get("replica") for s in attempts}
+        statuses = {s["attrs"].get("status") for s in attempts}
+        if len(attempts) >= 2 and len(replicas) >= 2 and 200 in statuses:
+            failover = t
+            break
+    assert failover is not None, "no failover trace crossed two replicas"
+    names = {s["name"] for s in failover["spans"]}
+    assert {"queue_wait", "dispatch"} <= names, sorted(names)
+
+    # the CLI reconstructs the same anatomy and flags the dominant
+    # segment per slow trace
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = obs_main(["trace", log_dir, "--slow", "40"])
+    text = out.getvalue()
+    assert rc == 0, text
+    assert "queue_wait" in text, text
+    # the wait cap dominates healthy requests; the slowed replica's
+    # SLO-missed traces are flagged transport-dominant — both anatomies
+    # must be named in the slow-trace listing
+    assert "dominant=queue_wait" in text, text
+    assert "dominant=transport" in text, text
+    assert "SLO-MISSED" in text, text
+    anat = trace_mod.anatomy(traces)
+    totals = {
+        name: seg["total_s"]
+        for name, seg in anat["segments"].items()
+        if name != "other"
+    }
+    assert max(totals, key=totals.get) == "queue_wait", totals
+
+    return {
+        "boot_s": boot_s,
+        "traces": len(traces),
+        "ok_traces": len(ok_traces),
+        "slo_traces": len(slo_traces),
+        "client_misses": client_misses,
+        "bill": bill,
+    }
+
+
+def feedback_fleet(workdir, samples, spec_path, feedback_on):
+    """Fleet B, booted twice with identical load: acme floods from
+    FLOOD_CLIENTS threads while beta probes sequentially. Returns
+    (solo_p50, beta latencies, quota_adjusted records)."""
+    import numpy as np
+
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.serve import (
+        FleetRouter,
+        ServerOverloaded,
+        ServingFleet,
+    )
+
+    tag = "on" if feedback_on else "off"
+    coord_dir = os.path.join(workdir, f"feedback-{tag}-coord")
+    log_dir = os.path.join(workdir, f"feedback-{tag}-log")
+    for key in FEEDBACK_ENV:
+        os.environ.pop(key, None)
+    if feedback_on:
+        os.environ.update(FEEDBACK_ENV)
+    fleet = ServingFleet(
+        coord_dir, 2, spec_path=spec_path, heartbeat_s=0.1,
+        lease_s=0.75, poll_s=0.05, log_dir=log_dir,
+    )
+    fleet.start(wait_serving=True, timeout=300)
+    router = FleetRouter(
+        coord_dir, lease_s=0.75, scan_interval_s=0.1, max_attempts=6,
+        retry_base_delay_s=0.05,
+    )
+    rng = np.random.default_rng(11)
+    try:
+        # quiet-tenant calibration: unloaded p50 anchors the SLO
+        solo = []
+        for _ in range(8):
+            t0 = time.monotonic()
+            router.route(
+                _jitter(rng, samples), tenant="beta",
+                deadline_s=REQUEST_DEADLINE_S,
+            )
+            solo.append(time.monotonic() - t0)
+        solo_p50 = sorted(solo)[len(solo) // 2]
+
+        stop = threading.Event()
+        acme = {"ok": 0, "shed": 0, "failed": 0}
+        lock = threading.Lock()
+
+        def flood(seed):
+            frng = np.random.default_rng(seed)
+            while not stop.is_set():
+                g = _jitter(frng, samples)
+                try:
+                    router.route(
+                        g, tenant="acme", deadline_s=REQUEST_DEADLINE_S
+                    )
+                    out = "ok"
+                except ServerOverloaded:
+                    out = "shed"
+                except Exception:
+                    out = "failed"
+                with lock:
+                    acme[out] += 1
+
+        floods = [
+            threading.Thread(target=flood, args=(100 + i,), daemon=True)
+            for i in range(FLOOD_CLIENTS)
+        ]
+        for t in floods:
+            t.start()
+        # feedback-on: the shave cascade (64 -> 16 -> 4) completes well
+        # inside the warmup at WINDOW_S=0.4 / PATIENCE=2 / SHAVE=0.25
+        time.sleep(FLOOD_WARMUP_S)
+        beta_lat = []
+        for _ in range(BETA_PROBES):
+            t0 = time.monotonic()
+            router.route(
+                _jitter(rng, samples), tenant="beta",
+                deadline_s=REQUEST_DEADLINE_S,
+            )
+            beta_lat.append(time.monotonic() - t0)
+        stop.set()
+        for t in floods:
+            t.join(timeout=60)
+        assert acme["failed"] == 0, acme
+    finally:
+        fleet.stop()
+        for key in FEEDBACK_ENV:
+            os.environ.pop(key, None)
+
+    # replica cost streams: schema-valid, quota_adjusted only when the
+    # feedback loop is armed
+    adjustments = []
+    for fn in sorted(os.listdir(coord_dir)):
+        if not (fn.startswith("events-replica") and fn.endswith(".jsonl")):
+            continue
+        recs = validate_events(os.path.join(coord_dir, fn))
+        adjustments.extend(
+            r for r in recs if r["event"] == "quota_adjusted"
+        )
+    return solo_p50, beta_lat, adjustments, dict(acme)
+
+
+def main(workdir):
+    os.makedirs(workdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # replicas are separate processes: a shared compilation cache keeps
+    # the later boots from re-compiling the same bucket programs
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(workdir, "jaxcache")
+    )
+
+    samples, trace_spec, feedback_spec = build_artifacts(workdir)
+
+    trace_facts = tracing_fleet(workdir, samples, trace_spec)
+
+    solo_p50, lat_off, adj_off, acme_off = feedback_fleet(
+        workdir, samples, feedback_spec, feedback_on=False
+    )
+    slo_s = max(3.0 * solo_p50, 0.08)
+    _solo_on, lat_on, adj_on, acme_on = feedback_fleet(
+        workdir, samples, feedback_spec, feedback_on=True
+    )
+    assert adj_off == [], adj_off  # feedback is OFF by default
+    assert adj_on, "no quota_adjusted event with feedback armed"
+    shaves = [a for a in adj_on if a["reason"] == "over_cost"]
+    assert shaves and all(a["tenant"] == "acme" for a in shaves), adj_on
+    assert all(a["new_quota"] < a["old_quota"] for a in shaves), shaves
+    assert min(a["new_quota"] for a in shaves) == QUOTA_FLOOR, shaves
+
+    miss_off = sum(1 for v in lat_off if v > slo_s) / len(lat_off)
+    miss_on = sum(1 for v in lat_on if v > slo_s) / len(lat_on)
+    # shaving the flooder must not hurt the quiet tenant — and must
+    # strictly help whenever the baseline actually missed
+    assert miss_on < miss_off or miss_on == 0.0, (
+        miss_off, miss_on, slo_s,
+    )
+
+    print(
+        "trace smoke OK: boot {:.1f}s, {} traces flushed ({} ok, {} "
+        "SLO-missed = {} client misses, queue_wait dominant), fleet "
+        "bill {:.2f}s device / {:.2f}s replica; feedback: acme quota "
+        "64 -> {} over {} shave(s), beta SLO-miss {:.0%} -> {:.0%} "
+        "(SLO {:.0f}ms, flood ok/shed {}/{} -> {}/{})".format(
+            trace_facts["boot_s"], trace_facts["traces"],
+            trace_facts["ok_traces"], trace_facts["slo_traces"],
+            trace_facts["client_misses"],
+            sum(
+                t["device_s"]
+                for t in trace_facts["bill"]["tenants"].values()
+            ),
+            trace_facts["bill"]["replica_s"],
+            min(a["new_quota"] for a in shaves), len(shaves),
+            miss_off, miss_on, slo_s * 1000,
+            acme_off["ok"], acme_off["shed"],
+            acme_on["ok"], acme_on["shed"],
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,225 @@
+"""Tenant cost ledger (serve/costs.py): attribution, billing, feedback.
+
+Deterministic unit coverage with an injected clock: per-batch
+attribution sums, the bill's exact-sum invariant (tenant device-seconds
++ idle == replica-seconds), fleet bill merging and per-million pricing,
+and the cost->quota feedback loop against a REAL TenantManager — shave
+under persistent over-cost, the starvation floor, restore on sustained
+under-cost, and the schema shape of every ``quota_adjusted`` event.
+"""
+
+import pytest
+
+from hydragnn_tpu.obs.events import EVENT_FIELDS
+from hydragnn_tpu.serve.costs import (
+    UNTENANTED,
+    CostLedger,
+    merge_bills,
+    price_per_million,
+)
+from hydragnn_tpu.serve.tenants import TenantManager, TenantSpec
+
+
+class _Clock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, event, **fields):
+        self.events.append((event, fields))
+
+
+def _manager(**quotas):
+    specs = [
+        TenantSpec(name=name, model="m", quota=q, weight=1.0)
+        for name, q in quotas.items()
+    ]
+    return TenantManager(specs, default_quota=64)
+
+
+# ---- attribution + billing -------------------------------------------------
+
+
+def pytest_note_batch_accumulates():
+    clock = _Clock()
+    ledger = CostLedger(clock=clock)
+    ledger.note_batch("acme", 0, 4, 0.2, flops=100.0)
+    ledger.note_batch("acme", 1, 2, 0.3, flops=50.0)
+    ledger.note_batch("beta", 0, 1, 0.5)
+    ledger.note_batch(None, 0, 1, 0.1)
+    clock.advance(2.0)
+    bill = ledger.bill()
+    acme = bill["tenants"]["acme"]
+    assert acme["device_s"] == pytest.approx(0.5)
+    assert acme["flops"] == pytest.approx(150.0)
+    assert acme["requests"] == 6
+    assert acme["batches"] == 2
+    assert bill["tenants"][UNTENANTED]["device_s"] == pytest.approx(0.1)
+    assert acme["cost_share"] == pytest.approx(0.5 / 1.1, abs=1e-5)
+
+
+def pytest_bill_sums_exactly_to_replica_seconds():
+    clock = _Clock()
+    ledger = CostLedger(clock=clock)
+    ledger.note_batch("acme", 0, 3, 0.7)
+    ledger.note_batch("beta", 0, 3, 0.4)
+    clock.advance(10.0)
+    bill = ledger.bill()
+    assert bill["replica_s"] == pytest.approx(10.0)
+    total = (
+        sum(t["device_s"] for t in bill["tenants"].values())
+        + bill["idle_s"]
+    )
+    assert total == pytest.approx(bill["replica_s"], rel=1e-9)
+    # skew clamp: busy beyond the lifetime never goes negative-idle
+    ledger2 = CostLedger(clock=_Clock())
+    ledger2.note_batch("acme", 0, 1, 5.0)
+    assert ledger2.bill()["idle_s"] == 0.0
+
+
+def pytest_merge_bills_and_price_per_million(monkeypatch):
+    clock_a, clock_b = _Clock(), _Clock()
+    a, b = CostLedger(clock=clock_a), CostLedger(clock=clock_b)
+    a.note_batch("acme", 0, 10, 1.0, flops=10.0)
+    b.note_batch("acme", 0, 10, 3.0, flops=30.0)
+    b.note_batch("beta", 0, 5, 1.0)
+    clock_a.advance(5.0)
+    clock_b.advance(7.0)
+    merged = merge_bills([a.bill(), b.bill(), {}])
+    assert merged["replica_s"] == pytest.approx(12.0)
+    assert merged["tenants"]["acme"]["device_s"] == pytest.approx(4.0)
+    assert merged["tenants"]["acme"]["requests"] == 20
+    assert merged["tenants"]["acme"]["cost_share"] == pytest.approx(0.8)
+    monkeypatch.setenv("HYDRAGNN_COST_PER_REPLICA_HOUR", "3.6")
+    price = price_per_million(merged, succeeded=24)
+    assert price["replica_s_per_million"] == pytest.approx(5e5)
+    assert price["cost_per_million"] == pytest.approx(5e5 / 3600 * 3.6)
+    assert price_per_million(merged, 0)["cost_per_million"] == float("inf")
+
+
+def pytest_prometheus_families_render():
+    ledger = CostLedger(clock=_Clock())
+    ledger.note_batch("acme", 0, 1, 0.5)
+    text = ledger.render_prometheus()
+    assert 'hydragnn_tenant_cost_device_seconds{tenant="acme"}' in text
+    assert "hydragnn_tenant_cost_replica_seconds" in text
+    assert "hydragnn_tenant_cost_idle_seconds" in text
+
+
+# ---- quota feedback --------------------------------------------------------
+
+
+def _feedback_ledger(monkeypatch, sink, clock, **env):
+    monkeypatch.setenv("HYDRAGNN_TENANT_COST_QUOTAS", "1")
+    monkeypatch.setenv("HYDRAGNN_TENANT_COST_WINDOW_S", "1.0")
+    monkeypatch.setenv("HYDRAGNN_TENANT_COST_PATIENCE", "2")
+    monkeypatch.setenv("HYDRAGNN_TENANT_COST_SHAVE", "0.5")
+    monkeypatch.setenv("HYDRAGNN_TENANT_COST_FLOOR", "0.125")
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    return CostLedger(emit=sink, clock=clock)
+
+
+def _window(ledger, clock, tenants, loads):
+    """One cost window: attribute `loads` (tenant -> seconds), advance
+    past the window, tick the feedback."""
+    for name, secs in loads.items():
+        ledger.note_batch(name, 0, 1, secs)
+    clock.advance(ledger.window_s + 0.01)
+    return ledger.maybe_adjust_quotas(tenants)
+
+
+def pytest_feedback_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_TENANT_COST_QUOTAS", raising=False)
+    clock = _Clock()
+    ledger = CostLedger(clock=clock)
+    tenants = _manager(acme=32, beta=32)
+    assert _window(ledger, clock, tenants, {"acme": 1.0}) == []
+    assert tenants.quota_for("acme") == 32
+
+
+def pytest_feedback_shaves_after_patience(monkeypatch):
+    sink = _Sink()
+    clock = _Clock()
+    ledger = _feedback_ledger(monkeypatch, sink, clock)
+    tenants = _manager(acme=32, beta=32)
+    # window 1: over tolerance but patience=2 -> no action yet
+    assert _window(
+        ledger, clock, tenants, {"acme": 0.9, "beta": 0.1}
+    ) == []
+    assert tenants.quota_for("acme") == 32
+    # window 2: still over -> shave to half
+    adj = _window(ledger, clock, tenants, {"acme": 0.9, "beta": 0.1})
+    assert len(adj) == 1
+    assert adj[0]["tenant"] == "acme"
+    assert adj[0]["reason"] == "over_cost"
+    assert tenants.quota_for("acme") == 16
+    assert tenants.quota_override("acme") == 16
+    # the quiet tenant is untouched
+    assert tenants.quota_for("beta") == 32
+    # emitted record carries exactly the schema's required fields
+    event, fields = sink.events[0]
+    assert event == "quota_adjusted"
+    assert set(EVENT_FIELDS["quota_adjusted"]) <= set(fields)
+    assert fields["old_quota"] == 32 and fields["new_quota"] == 16
+
+
+def pytest_feedback_floor_prevents_starvation(monkeypatch):
+    clock = _Clock()
+    ledger = _feedback_ledger(monkeypatch, _Sink(), clock)
+    tenants = _manager(acme=32, beta=32)
+    for _ in range(20):  # keep flooding: repeated shaves bottom out
+        _window(ledger, clock, tenants, {"acme": 1.0, "beta": 0.01})
+    # floor = ceil(32 * 0.125) = 4, never lower, never zero
+    assert tenants.quota_for("acme") == 4
+
+
+def pytest_feedback_restores_after_sustained_under(monkeypatch):
+    sink = _Sink()
+    clock = _Clock()
+    ledger = _feedback_ledger(monkeypatch, sink, clock)
+    tenants = _manager(acme=32, beta=32)
+    _window(ledger, clock, tenants, {"acme": 0.9, "beta": 0.1})
+    _window(ledger, clock, tenants, {"acme": 0.9, "beta": 0.1})
+    assert tenants.quota_for("acme") == 16
+    # balanced load for `patience` windows -> override clears
+    _window(ledger, clock, tenants, {"acme": 0.5, "beta": 0.5})
+    adj = _window(ledger, clock, tenants, {"acme": 0.5, "beta": 0.5})
+    assert any(a["reason"] == "restored" for a in adj)
+    assert tenants.quota_override("acme") is None
+    assert tenants.quota_for("acme") == 32
+
+
+def pytest_feedback_no_tick_within_window(monkeypatch):
+    clock = _Clock()
+    ledger = _feedback_ledger(monkeypatch, _Sink(), clock)
+    tenants = _manager(acme=32)
+    ledger.note_batch("acme", 0, 1, 1.0)
+    clock.advance(ledger.window_s / 2)  # window not yet elapsed
+    assert ledger.maybe_adjust_quotas(tenants) == []
+
+
+def pytest_quota_override_clamped_and_validated():
+    tenants = _manager(acme=8)
+    tenants.set_quota_override("acme", 100)  # above base: clamped at read
+    assert tenants.quota_for("acme") == 8
+    tenants.set_quota_override("acme", 2)
+    assert tenants.quota_for("acme") == 2
+    assert tenants.describe()["acme"]["quota"] == 2
+    assert tenants.describe()["acme"]["quota_override"] == 2
+    with pytest.raises(ValueError):
+        tenants.set_quota_override("acme", 0)
+    with pytest.raises(KeyError):
+        tenants.set_quota_override("ghost", 4)
+    tenants.set_quota_override("acme", None)
+    assert tenants.quota_for("acme") == 8

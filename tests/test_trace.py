@@ -1,0 +1,385 @@
+"""Request tracing (obs/trace.py) + router retry budget + trace echo.
+
+Unit coverage for ISSUE 18's tentpole machinery, no fleet needed:
+header propagation encoding, deterministic head sampling, the
+tail-based flush rules (SLO-missed and errored requests flush at ANY
+non-zero rate), replica span merging, segment accounting summing to the
+root, the anatomy rollup, the RetryBudget token bucket (direct unit
+tests — the fleet tests only exercise it incidentally), the replica's
+trace-id echo on ERROR response bodies, and torn-tail repair of a
+stream holding interleaved span flushes from concurrent requests.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from hydragnn_tpu.obs.events import RunEventLog, validate_events
+from hydragnn_tpu.obs import trace as trace_mod
+from hydragnn_tpu.obs.trace import (
+    RequestTrace,
+    TraceContext,
+    Tracer,
+    anatomy,
+    build_traces,
+    decode_header,
+    dominant_segment,
+    encode_header,
+    head_sampled,
+    load_span_events,
+    new_id,
+    segment_durations,
+)
+from hydragnn_tpu.serve.router import RetryBudget
+
+
+class _Sink:
+    """Collecting emit target (the schema-gated emitter's shape)."""
+
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, event, **fields):
+        self.events.append((event, fields))
+
+
+# ---- header propagation ----------------------------------------------------
+
+
+def pytest_header_roundtrip():
+    tid, sid = new_id(8), new_id()
+    assert len(tid) == 16 and len(sid) == 16
+    value = encode_header(tid, sid)
+    assert decode_header(value) == (tid, sid)
+    ctx = TraceContext.from_header(value)
+    assert ctx.trace_id == tid and ctx.parent_id == sid
+
+
+def pytest_header_malformed_disarms():
+    for bad in (None, "", "justonepart", "-", "a-", "-b"):
+        assert decode_header(bad) is None
+        assert TraceContext.from_header(bad) is None
+
+
+# ---- sampling --------------------------------------------------------------
+
+
+def pytest_head_sampling_deterministic_and_bounded():
+    tid = new_id(8)
+    assert head_sampled(tid, 0.0) is False
+    assert head_sampled(tid, 1.0) is True
+    # same id, same rate -> same answer, every time
+    assert all(
+        head_sampled(tid, 0.37) == head_sampled(tid, 0.37)
+        for _ in range(10)
+    )
+    # the decision threshold is the id's leading 32 bits
+    assert head_sampled("00000000" + "0" * 8, 0.01)
+    assert not head_sampled("ffffffff" + "0" * 8, 0.99)
+
+
+def pytest_tracer_off_costs_nothing():
+    assert Tracer(sample=0.0, emit=_Sink()).start() is None
+    assert Tracer(sample=0.5, emit=None).start() is None
+    assert not Tracer(sample=0.0, emit=None).enabled
+
+
+# ---- tail-based flush rules ------------------------------------------------
+
+
+def _forced(sink, sampled):
+    tracer = Tracer(sample=1.0, emit=sink)
+    tr = tracer.start(tenant="acme", lane="default")
+    tr.sampled = sampled
+    return tr
+
+
+def pytest_unsampled_ok_does_not_flush():
+    sink = _Sink()
+    tr = _forced(sink, sampled=False)
+    assert tr.finish("ok") is False
+    assert sink.events == []
+
+
+def pytest_slo_missed_always_flushes():
+    sink = _Sink()
+    tr = _forced(sink, sampled=False)
+    tr.record("queue_wait", 0.0, 0.5)
+    assert tr.finish("ok", slo_missed=True) is True
+    names = [f["name"] for _, f in sink.events]
+    assert "route" in names and "queue_wait" in names
+    root = next(f for _, f in sink.events if f["name"] == "route")
+    assert root["attrs"]["slo_missed"] is True
+    assert root["parent"] == ""
+
+
+def pytest_error_always_flushes():
+    sink = _Sink()
+    tr = _forced(sink, sampled=False)
+    assert tr.finish("shed", error=True) is True
+    assert [f["name"] for _, f in sink.events] == ["route"]
+
+
+def pytest_head_sampled_flushes_and_finish_idempotent():
+    sink = _Sink()
+    tr = _forced(sink, sampled=True)
+    assert tr.finish("ok") is True
+    n = len(sink.events)
+    assert tr.finish("ok") is False  # second finish: no double emit
+    assert len(sink.events) == n
+
+
+def pytest_tail_capture_rate_is_total_for_slo_missed():
+    """At sample=0.01 essentially no trace head-samples, yet every
+    SLO-missed request flushes — the tail acceptance rule."""
+    sink = _Sink()
+    tracer = Tracer(sample=0.01, emit=sink)
+    flushed = 0
+    for _ in range(50):
+        tr = tracer.start()
+        tr.sampled = False  # force the head decision to "reject"
+        flushed += bool(tr.finish("ok", slo_missed=True))
+    assert flushed == 50
+
+
+# ---- replica span merging --------------------------------------------------
+
+
+def pytest_merge_keeps_own_trace_reparents_orphans():
+    tr = RequestTrace(Tracer(sample=1.0, emit=_Sink()), "a" * 16, True)
+    attempt = new_id()
+    tr.merge([
+        {"trace": "a" * 16, "span": "s1", "parent": attempt,
+         "name": "queue_wait", "start": 1.0, "dur_s": 0.2, "attrs": {}},
+        {"trace": "b" * 16, "span": "s2", "parent": attempt,
+         "name": "dispatch", "start": 1.2, "dur_s": 0.1},  # wrong trace
+        {"trace": "a" * 16, "span": "s3", "parent": None,
+         "name": "dispatch", "start": 1.2, "dur_s": 0.1},  # orphan
+        "garbage", {"trace": "a" * 16},  # malformed
+    ])
+    spans = {s["span"]: s for s in tr._spans}
+    assert set(spans) == {"s1", "s3"}
+    assert spans["s1"]["parent"] == attempt
+    assert spans["s3"]["parent"] == tr.root_id  # re-parented to root
+    tr.merge(None)  # tolerant of absent field
+
+
+# ---- segment accounting ----------------------------------------------------
+
+
+def _synthetic_trace():
+    """route(1.0s) -> admit(0.1) + attempt(0.8) -> queue_wait(0.5) +
+    dispatch(0.2); attempt exclusive = 0.1 (transport), route exclusive
+    = 0.1 (other)."""
+    root, att = "r" * 16, "a" * 16
+    spans = [
+        {"trace": "t1", "span": root, "parent": "", "name": "route",
+         "start": 0.0, "dur_s": 1.0,
+         "attrs": {"tenant": "acme", "lane": "default", "status": "ok",
+                   "slo_missed": True}},
+        {"trace": "t1", "span": "s1", "parent": root, "name": "admit",
+         "start": 0.0, "dur_s": 0.1, "attrs": {}},
+        {"trace": "t1", "span": att, "parent": root, "name": "attempt",
+         "start": 0.1, "dur_s": 0.8, "attrs": {}},
+        {"trace": "t1", "span": "s2", "parent": att, "name": "queue_wait",
+         "start": 0.15, "dur_s": 0.5, "attrs": {}},
+        {"trace": "t1", "span": "s3", "parent": att, "name": "dispatch",
+         "start": 0.65, "dur_s": 0.2, "attrs": {}},
+    ]
+    return [dict(s, event="span") for s in spans]
+
+
+def pytest_segments_sum_to_root():
+    traces = build_traces(_synthetic_trace())
+    assert set(traces) == {"t1"}
+    segments = segment_durations(traces["t1"])
+    assert segments["admit"] == pytest.approx(0.1)
+    assert segments["queue_wait"] == pytest.approx(0.5)
+    assert segments["dispatch"] == pytest.approx(0.2)
+    assert segments["transport"] == pytest.approx(0.1)  # attempt excl.
+    assert segments["other"] == pytest.approx(0.1)  # route exclusive
+    root_dur = traces["t1"]["root"]["dur_s"]
+    assert sum(segments.values()) == pytest.approx(root_dur)
+    assert dominant_segment(traces["t1"]) == "queue_wait"
+
+
+def pytest_anatomy_rollup():
+    rollup = anatomy(build_traces(_synthetic_trace()))
+    assert rollup["traces"] == 1
+    assert rollup["segments"]["queue_wait"]["count"] == 1
+    assert rollup["segments"]["queue_wait"]["p99_s"] == pytest.approx(
+        0.5, abs=1e-6
+    )
+    assert "acme/default" in rollup["groups"]
+    row = rollup["slowest"][0]
+    assert row["dominant"] == "queue_wait"
+    assert row["slo_missed"] is True
+    assert row["tenant"] == "acme"
+
+
+def pytest_trace_cli_renders(tmp_path, capsys):
+    from hydragnn_tpu.obs.__main__ import main as obs_main
+
+    log = RunEventLog(str(tmp_path / "events.jsonl"))
+    for rec in _synthetic_trace():
+        fields = {k: v for k, v in rec.items() if k != "event"}
+        log.emit("span", **fields)
+    assert obs_main(["trace", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "queue_wait" in out and "dominant=queue_wait" in out
+    assert obs_main(["trace", str(tmp_path / "missing")]) == 2
+
+
+# ---- RetryBudget (direct unit tests) --------------------------------------
+
+
+def pytest_retry_budget_starts_at_reserve():
+    budget = RetryBudget(ratio=0.1, reserve=10.0)
+    assert budget.tokens == pytest.approx(10.0)
+
+
+def pytest_retry_budget_refill_ratio_and_cap():
+    budget = RetryBudget(ratio=0.25, reserve=2.0)
+    # drain the reserve
+    assert budget.try_acquire() and budget.try_acquire()
+    assert not budget.try_acquire()
+    assert budget.tokens == pytest.approx(0.0)
+    # each success refills `ratio` tokens: 4 successes buy ONE retry
+    for _ in range(3):
+        budget.on_success()
+        assert not budget.try_acquire()
+    budget.on_success()
+    assert budget.tokens == pytest.approx(1.0)
+    assert budget.try_acquire()
+    # refill never exceeds the reserve cap
+    for _ in range(1000):
+        budget.on_success()
+    assert budget.tokens == pytest.approx(2.0)
+
+
+def pytest_retry_budget_storm_exhausts():
+    """A retry storm dies at the budget: with no successes, acquires
+    stop after `reserve` grants no matter how many requests want one."""
+    budget = RetryBudget(ratio=0.1, reserve=5.0)
+    grants = sum(budget.try_acquire() for _ in range(1000))
+    assert grants == 5
+    assert budget.tokens == pytest.approx(0.0)
+
+
+def pytest_retry_budget_tokens_monotone_under_successes():
+    budget = RetryBudget(ratio=0.25, reserve=8.0)
+    for _ in range(3):
+        budget.try_acquire()
+    seen = [budget.tokens]
+    for _ in range(20):
+        budget.on_success()
+        seen.append(budget.tokens)
+    assert all(b >= a for a, b in zip(seen, seen[1:]))
+    assert seen[-1] <= 8.0
+
+
+# ---- replica error bodies echo the trace id (satellite) -------------------
+
+
+def _bare_replica():
+    """A ReplicaServer shell exercising handle_predict without a real
+    InferenceServer — exactly the attributes the request path touches
+    before submit."""
+    from hydragnn_tpu.serve.fleet import ReplicaServer
+
+    replica = ReplicaServer.__new__(ReplicaServer)
+    replica._lock = threading.Lock()
+    replica._served = 0
+    replica.is_canary = False
+    replica.replica_id = 0
+    return replica
+
+
+def pytest_error_response_echoes_trace_id():
+    replica = _bare_replica()
+    tid = new_id(8)
+    header = encode_header(tid, new_id())
+    code, body, _headers = replica.handle_predict(
+        {"graph": "not-a-graph"}, trace_header=header
+    )
+    assert code == 400
+    assert body["trace"] == tid
+    assert body["spans"] == []
+
+
+def pytest_overload_response_echoes_trace_id():
+    from hydragnn_tpu.serve.server import ServerOverloaded
+
+    replica = _bare_replica()
+
+    class _Shedding:
+        max_wait_s = 0.01
+
+        def submit(self, *a, **kw):
+            raise ServerOverloaded(retry_after_s=0.05)
+
+    replica.server = _Shedding()
+    graph = {  # minimal decodable payload (fleet.decode_graph shape)
+        "x": [[1.0], [2.0]],
+        "edge_index": [[0, 1], [1, 0]],
+        "pos": [[0.0, 0.0, 0.0], [1.0, 0.0, 0.0]],
+    }
+    tid = new_id(8)
+    code, body, _headers = replica.handle_predict(
+        {"graph": graph},
+        trace_header=encode_header(tid, new_id()),
+    )
+    assert code == 503
+    assert body["trace"] == tid
+
+
+def pytest_untraced_error_body_has_no_trace_field():
+    replica = _bare_replica()
+    code, body, _headers = replica.handle_predict({"graph": "nope"})
+    assert code == 400
+    assert "trace" not in body and "spans" not in body
+
+
+# ---- torn-tail repair with interleaved concurrent flushes -----------------
+
+
+def pytest_torn_tail_repair_interleaved_span_flushes(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = RunEventLog(path)
+    tracer = Tracer(sample=1.0, emit=log.emit)
+
+    def one_request(k):
+        tr = tracer.start(tenant=f"t{k % 2}", lane="default")
+        tr.sampled = True
+        tr.record("admit", 0.0, 0.001)
+        tr.record("queue_wait", 0.0, 0.01 * k)
+        tr.finish("ok", slo_missed=(k % 3 == 0))
+
+    threads = [
+        threading.Thread(target=one_request, args=(k,)) for k in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # simulate a crash mid-append: a torn, newline-less partial record
+    with open(path, "a") as f:
+        f.write('{"event": "span", "trace": "dead')
+    # reopen repairs the tail and resumes the seq; the stream then
+    # passes the STRICT validator including the new span schema
+    log2 = RunEventLog(path)
+    tracer2 = Tracer(sample=1.0, emit=log2.emit)
+    tr = tracer2.start()
+    tr.sampled = True
+    assert tr.finish("ok") is True
+    # raises on any schema/seq violation — repair must leave a stream
+    # the STRICT validator accepts, span schema included
+    records = validate_events(path, require=["span"])
+    assert all(r["event"] == "span" for r in records)
+    spans = load_span_events(path)
+    traces = build_traces(spans)
+    assert len(traces) >= 8  # every concurrent request's trace survived
+    for t in traces.values():
+        assert t["root"] is not None
