@@ -1,7 +1,7 @@
-"""jaxlint + threadlint + shardlint: static analysis + runtime guards.
+"""jaxlint + threadlint + shardlint + numlint: analysis + runtime guards.
 
 Static pass (``python -m hydragnn_tpu.analysis``): an AST-based rule
-engine in three suites. The ``jax`` suite (jaxlint) targets JAX/TPU
+engine in four suites. The ``jax`` suite (jaxlint) targets JAX/TPU
 anti-patterns — per-batch host syncs in step loops, jit wrappers rebuilt
 per call, state-threading jits missing ``donate_argnums``, PRNG key
 reuse, recompile-hazard static args, general hygiene. The
@@ -14,9 +14,16 @@ mesh layer — hardcoded axis strings, jit programs missing their
 sharding contract, unknown PartitionSpec axes, sharding-less
 ``device_put``, legacy ``pmap``, leading-dim reshapes in sharded
 bodies; its compiled-HLO sibling (``analysis/hlo.py``) ratchets each
-step program's collective set against ``.shardlint-hlo.json``. See
-``docs/static-analysis.md`` for the rule catalog, suppression syntax,
-and the per-suite baseline ratchets.
+step program's collective set against ``.shardlint-hlo.json``. The
+``numerics`` suite (numlint, ``--suite=numerics``,
+``rules_numerics.py``) guards precision and kernel safety — low-
+precision accumulations, mixed-precision policy bypasses, unguarded
+exp/log/sqrt/division, NaN-unsafe ``where`` branches, unmasked gathers
+on padded neighbor ids, unbudgeted pallas VMEM; its compiled sibling
+(``analysis/mem.py``) ratchets each step program's
+``memory_analysis()`` peak/temp/output bytes against
+``.numlint-mem.json``. See ``docs/static-analysis.md`` for the rule
+catalog, suppression syntax, and the per-suite baseline ratchets.
 
 Runtime guards (``hydragnn_tpu.analysis.guards``): what the static pass
 cannot prove — a :class:`CompileSentinel` asserting the XLA compile
@@ -24,8 +31,10 @@ counter stays flat after warmup, :func:`no_host_syncs`, a
 ``jax.transfer_guard`` harness that turns implicit device->host
 transfers into hard errors inside tests, :func:`lock_sanitizer`, a
 lock-order/deadlock sanitizer with per-lock wait/hold metrics and a
-stack-dumping watchdog, and :func:`sharding_sentinel`, which asserts
-program outputs LAND at their declared shardings.
+stack-dumping watchdog, :func:`sharding_sentinel`, which asserts
+program outputs LAND at their declared shardings, and
+:func:`nan_sentinel`, which localizes a wrapped region's first
+non-finite output leaf to a named head/param subtree.
 """
 
 from hydragnn_tpu.analysis.core import (  # noqa: F401
@@ -43,6 +52,7 @@ from hydragnn_tpu.analysis import (  # noqa: F401  (registration side effect)
     rules_host_sync,
     rules_hygiene,
     rules_jit,
+    rules_numerics,
     rules_prng,
     rules_sharding,
 )
@@ -51,10 +61,14 @@ from hydragnn_tpu.analysis.guards import (  # noqa: F401
     InstrumentedLock,
     LockOrderViolation,
     LockSanitizer,
+    NonFiniteError,
     ShardingSentinel,
     ShardingViolation,
     lock_sanitizer,
+    nan_origin,
+    nan_sentinel,
     no_host_syncs,
     no_implicit_transfers,
+    nonfinite_report,
     sharding_sentinel,
 )

@@ -32,8 +32,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m hydragnn_tpu.analysis",
         description=(
-            "jaxlint/threadlint/shardlint: JAX/TPU, concurrency and "
-            "sharding static analysis (docs/static-analysis.md)"
+            "jaxlint/threadlint/shardlint/numlint: JAX/TPU, "
+            "concurrency, sharding and numerics static analysis "
+            "(docs/static-analysis.md)"
         ),
     )
     p.add_argument(
@@ -67,8 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--suite",
         metavar="SUITE",
         help="run only one rule suite: 'jax' (the jaxlint gate), "
-        "'concurrency' (the threadlint gate) or 'sharding' (the "
-        "shardlint gate); default: every suite",
+        "'concurrency' (the threadlint gate), 'sharding' (the "
+        "shardlint gate) or 'numerics' (the numlint gate); default: "
+        "every suite",
     )
     p.add_argument(
         "--select",
@@ -91,6 +93,7 @@ SUITE_GATES = {
     "jax": "jaxlint",
     "concurrency": "threadlint",
     "sharding": "shardlint",
+    "numerics": "numlint",
 }
 
 
@@ -109,7 +112,7 @@ def main(argv=None) -> int:
         return 2
 
     if args.list_rules:
-        # the per-suite catalog: three suites are too many to keep in
+        # the per-suite catalog: four suites are too many to keep in
         # one flat list (or only in docs) — one block per suite, each
         # rule with its one-line doc
         for suite in sorted(all_suites()):

@@ -24,12 +24,12 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
-# all three tags are accepted everywhere: `jaxlint` predates the
-# concurrency (threadlint) and sharding (shardlint) suites, and a
-# suppression should read as the suite it silences — but the engine is
-# one engine
+# all four tags are accepted everywhere: `jaxlint` predates the
+# concurrency (threadlint), sharding (shardlint) and numerics (numlint)
+# suites, and a suppression should read as the suite it silences — but
+# the engine is one engine
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:jaxlint|threadlint|shardlint):"
+    r"#\s*(?:jaxlint|threadlint|shardlint|numlint):"
     r"\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
 )
 
@@ -131,8 +131,9 @@ class Rule:
     ``suite`` groups rules for ``--suite`` gating: the JAX/TPU rules are
     ``jax`` (the jaxlint gate), the concurrency/shutdown-safety rules are
     ``concurrency`` (the threadlint gate), the sharding-correctness
-    rules are ``sharding`` (the shardlint gate) — each gate ratchets
-    against its own baseline file."""
+    rules are ``sharding`` (the shardlint gate), the numerics/kernel-
+    safety rules are ``numerics`` (the numlint gate) — each gate
+    ratchets against its own baseline file."""
 
     name = ""
     description = ""

@@ -34,6 +34,15 @@ Three harnesses, all designed for tests (cheap, no-op-safe, CPU-friendly):
   deadlock watchdog dumps every thread's stack + held locks and emits a
   ``deadlock_suspect`` event (``events.jsonl`` schema,
   ``obs/events.py``) when an acquisition blocks past its threshold.
+
+- :func:`nan_sentinel` / :func:`nan_origin` — the runtime half of the
+  numlint numerics suite (``rules_numerics.py``). Wraps a step or
+  dispatch and, on any non-finite output, localizes the FIRST offending
+  leaf to a named head/param subtree, emits a schema-gated
+  ``nan_origin`` event, and (in raise mode) fails with the subtree
+  named. Opt-in on the train path via ``HYDRAGNN_NAN_SENTINEL``; the
+  canary controller's NaN hard-veto uses the report mode so every
+  rejection carries an origin.
 """
 
 import contextlib
@@ -591,6 +600,132 @@ class LockSanitizer:
                 f"reverse order ({v['reverse_chain']}) was established "
                 f"at {v['first_seen_site']}"
             )
+
+
+# ---- NaN sentinel ---------------------------------------------------------
+#
+# The runtime half of the numerics suite (rules_numerics.py): the static
+# rules prove exp/log/div/gather sites are *written* guarded; this
+# localizes the first non-finite value an execution actually produces to
+# a named head/param subtree, so a canary NaN veto or a diverged step
+# says "pos_MAE head" instead of "somewhere in a 2000-leaf tree".
+
+
+class NonFiniteError(FloatingPointError):
+    """A sentinel-wrapped region produced NaN/Inf; the message and the
+    attached :attr:`origin` payload localize the first offending leaf."""
+
+    def __init__(self, message: str, origin: Dict):
+        super().__init__(message)
+        self.origin = origin
+
+
+def nonfinite_report(tree) -> List[Tuple[str, int]]:
+    """``(keystr_path, nonfinite_count)`` for every leaf of ``tree``
+    holding at least one NaN/Inf, in deterministic tree order. Host
+    scalars and non-numeric leaves count as finite."""
+    import jax
+    import numpy as np
+
+    bad: List[Tuple[str, int]] = []
+
+    def visit(path, leaf):
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            return leaf
+        if not np.issubdtype(arr.dtype, np.floating) and not np.issubdtype(
+            arr.dtype, np.complexfloating
+        ):
+            return leaf
+        n = int(np.size(arr) - np.sum(np.isfinite(arr)))
+        if n:
+            bad.append((jax.tree_util.keystr(path) or "<root>", n))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return bad
+
+
+def _subtree_of(keystr_path: str) -> str:
+    """First NAMED path component — the head/param group to blame.
+    Bare sequence indices (a step's ``(state, metrics)`` tuple) and the
+    generic ``params``/``opt_state`` containers are skipped so
+    ``"[0].params['encoder_conv_0']['bias']"`` blames ``encoder_conv_0``,
+    not ``0``; ``".loss['energy']"`` -> ``loss``."""
+    parts = [
+        part
+        for part in re.split(r"[\[\].']+", keystr_path)
+        if part and part != "<root>" and not part.isdigit()
+    ]
+    for part in parts:
+        if part not in ("params", "opt_state", "state"):
+            return part
+    return parts[0] if parts else keystr_path
+
+
+def nan_origin(tree, scope: str) -> Optional[Dict]:
+    """Localize non-finite leaves of ``tree`` to a ``nan_origin`` event
+    payload (``obs/events.py`` schema), or None when all-finite.
+
+    ``origin`` is the FIRST offending leaf's keystr path, ``subtree``
+    its leading component, ``leaves``/``total`` the non-finite/total
+    leaf counts. Forces a device sync — diagnosis-path only, never on
+    the hot path."""
+    import jax
+
+    bad = nonfinite_report(tree)
+    if not bad:
+        return None
+    first_path, _ = bad[0]
+    return {
+        "scope": scope,
+        "origin": first_path,
+        "subtree": _subtree_of(first_path),
+        "leaves": len(bad),
+        "total": len(jax.tree_util.tree_leaves(tree)),
+    }
+
+
+def nan_sentinel(fn, *, scope: str, events=None, mode: str = "raise"):
+    """Wrap a step/dispatch: when its output tree contains NaN/Inf,
+    build the :func:`nan_origin` payload, emit a schema-gated
+    ``nan_origin`` event to ``events`` (a
+    :class:`~hydragnn_tpu.obs.events.RunEventLog`, optional) and — in
+    ``mode="raise"`` — raise :class:`NonFiniteError` naming the subtree.
+    ``mode="report"`` returns the output untouched after emitting, for
+    paths with their own rejection machinery (the canary veto).
+
+    The finiteness check is a host readback of the outputs, so only wrap
+    opt-in (``HYDRAGNN_NAN_SENTINEL=1`` in ``train/steps.py``) or on
+    already-host-bound paths."""
+    if mode not in ("raise", "report"):
+        raise ValueError(f"nan_sentinel mode {mode!r}: raise|report")
+
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        origin = nan_origin(out, scope)
+        if origin is not None:
+            if events is not None:
+                events.emit("nan_origin", **origin)
+            if mode == "raise":
+                raise NonFiniteError(
+                    f"{scope}: non-finite output at {origin['origin']} "
+                    f"(subtree `{origin['subtree']}`, "
+                    f"{origin['leaves']}/{origin['total']} leaf/leaves "
+                    "affected)",
+                    origin,
+                )
+        return out
+
+    wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+    # forward the jit surface (lowering/ratchet harnesses, compile
+    # sentinel cache signal) so wrapping a jitted step stays transparent
+    for attr in ("lower", "_cache_size"):
+        inner = getattr(fn, attr, None)
+        if inner is not None:
+            setattr(wrapped, attr, inner)
+    return wrapped
 
 
 @contextlib.contextmanager

@@ -363,15 +363,19 @@ def compile_step_programs(
     }
     if programs is not None:
         lowerings = {k: lowerings[k] for k in programs}
-    texts = {
-        name: low().compile().as_text() for name, low in lowerings.items()
-    }
+    # compile ONCE per program: the texts feed the collective ratchet,
+    # the executables feed the memory ratchet (analysis/mem.py) via
+    # context["compiled"] — recompiling for each consumer would double
+    # the multi-minute CI cost
+    compiled = {name: low().compile() for name, low in lowerings.items()}
+    texts = {name: c.as_text() for name, c in compiled.items()}
     context = {
         "trainer": trainer,
         "state": state,
         "dev_batch": dev_batch,
         "rng": sentinel_rng,
         "mesh": mesh,
+        "compiled": compiled,
     }
     return (
         texts,

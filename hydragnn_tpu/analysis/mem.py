@@ -1,0 +1,352 @@
+"""numlint's compiled-memory ratchet — the HBM twin of the HLO ratchet.
+
+The numerics AST rules (``rules_numerics.py``) prove a kernel's
+precision contract is *written*; nothing static can prove what a change
+costs in device memory. A superblock fusion that materializes one extra
+``[N, K, D]`` temp, a dense path that stops aliasing its donated input,
+or an accidental f64 promotion all land as HBM growth that tier-1 on a
+tiny CPU config never notices — until a real-shape run OOMs. So this
+module fingerprints each canonical ``train/steps.py`` program's
+``Compiled.memory_analysis()`` — peak / temp / output / argument bytes
+(``obs/introspect.normalize_memory_analysis`` semantics, peak =
+arg + out + temp + generated code − aliased) — into a committed
+``.numlint-mem.json`` budget.
+
+CI re-compiles the programs on the same forced-CPU canonical harness the
+HLO ratchet uses (``analysis/hlo.compile_step_programs`` compiles ONCE
+and hands the executables over) and fails with the program, the field
+and the byte counts named when peak/temp/output bytes grow past
+tolerance. ``--prove-injection`` doctors one program's fingerprint with
+a synthetic HBM blow-up and asserts the gate catches it.
+
+Tolerance resolves ``HYDRAGNN_NUMLINT_MEM_TOLERANCE`` through
+``utils/envparse`` (a typo'd value names the variable, not a bare
+``float()`` traceback).
+
+CLI::
+
+    python -m hydragnn_tpu.analysis.mem --check .numlint-mem.json
+    python -m hydragnn_tpu.analysis.mem --write .numlint-mem.json
+    python -m hydragnn_tpu.analysis.mem --check ... --prove-injection
+
+Exit status: 0 clean, 1 budget violations (or a failed injection proof),
+2 usage errors. Byte counts are backend-specific, so the budget records
+the mesh AND is only comparable against the same canonical CPU harness
+that wrote it — the point is the diff, not the absolute number.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Sequence, Tuple
+
+from hydragnn_tpu.utils.envparse import env_float
+
+BUDGET_VERSION = 1
+DEFAULT_BUDGET = ".numlint-mem.json"
+# the fields the gate fails on; the rest ride along informationally
+GATED_FIELDS = ("peak_bytes", "temp_bytes", "output_bytes")
+
+
+def default_tolerance() -> float:
+    """Growth tolerance: ``HYDRAGNN_NUMLINT_MEM_TOLERANCE`` (validated,
+    error names the variable) or 0.25 — generous enough for compiler
+    noise across jaxlib point releases, tight enough that a doubled
+    temp buffer cannot hide."""
+    return env_float("HYDRAGNN_NUMLINT_MEM_TOLERANCE", 0.25)
+
+
+def fingerprint_memory(compiled) -> Dict[str, int]:
+    """One executable's budgetable memory fingerprint (ints, so the
+    JSON diff reads as bytes)."""
+    from hydragnn_tpu.obs.introspect import normalize_memory_analysis
+
+    mem = normalize_memory_analysis(compiled.memory_analysis())
+    if not mem:
+        raise RuntimeError(
+            "memory_analysis() reported nothing on this backend — the "
+            "memory budget needs the canonical CPU harness"
+        )
+    fp = {k: int(v) for k, v in sorted(mem.items())}
+    # XLA's donation/alias accounting is not stable across compiles of
+    # the same program (alias_bytes can report 0 or the donated size),
+    # and the normalized peak subtracts it — a ratchet gated on that
+    # would flap. Gate on the alias-free upper bound instead; the raw
+    # alias_bytes stays in the fingerprint informationally.
+    fp["peak_bytes"] = (
+        fp["argument_bytes"]
+        + fp["output_bytes"]
+        + fp["temp_bytes"]
+        + fp["generated_code_bytes"]
+    )
+    return fp
+
+
+def fingerprint_programs(compiled: Dict[str, object]) -> Dict[str, Dict]:
+    return {name: fingerprint_memory(c) for name, c in compiled.items()}
+
+
+# ---- the budget (the ratchet file) ----------------------------------------
+
+
+def save_budget(
+    path: str,
+    programs: Dict[str, Dict],
+    shape: Sequence[int],
+    tolerance: float,
+):
+    payload = {
+        "version": BUDGET_VERSION,
+        "mesh": {"shape": [int(s) for s in shape]},
+        "tolerance": tolerance,
+        "programs": {k: programs[k] for k in sorted(programs)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_budget(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as f:
+        payload = json.load(f)
+    version = payload.get("version")
+    if version != BUDGET_VERSION:
+        raise ValueError(
+            f"memory budget {path} has version {version!r}; this "
+            f"analyzer writes version {BUDGET_VERSION} — regenerate "
+            "with --write"
+        )
+    return payload
+
+
+def check_fingerprints(
+    current: Dict[str, Dict],
+    budget_programs: Dict[str, Dict],
+    tolerance: float,
+) -> Tuple[List[str], List[str]]:
+    """``(violations, notes)`` of current memory fingerprints vs budget.
+
+    Violations (gate-failing): a program absent from the budget, or a
+    gated field (peak/temp/output bytes) grown past ``tolerance`` — a
+    budgeted 0 tolerates nothing, so a program that today needs no temp
+    buffer cannot silently start materializing one. Notes: fields
+    shrunk past tolerance (tighten the budget) and stale budgeted
+    programs — the ratchet only tightens."""
+    violations: List[str] = []
+    notes: List[str] = []
+    for prog in sorted(current):
+        fp = current[prog]
+        b = budget_programs.get(prog)
+        if b is None:
+            violations.append(
+                f"{prog}: program not in the memory budget — a new "
+                "compiled step program must be budgeted deliberately "
+                "(--write)"
+            )
+            continue
+        for field in GATED_FIELDS:
+            have = int(fp.get(field, 0))
+            allowed = int(b.get(field, 0))
+            if have > allowed * (1.0 + tolerance):
+                violations.append(
+                    f"{prog}: {field} grew {allowed} -> {have} bytes "
+                    f"(> {tolerance:.0%} tolerance) — an HBM "
+                    "regression the tiny-config tests cannot see"
+                )
+            elif allowed and have < allowed * (1.0 - tolerance):
+                notes.append(
+                    f"{prog}: {field} shrank {allowed} -> {have} bytes "
+                    "— tighten the budget with --write"
+                )
+    for prog in sorted(set(budget_programs) - set(current)):
+        notes.append(
+            f"{prog}: budgeted but not compiled here — stale entry, "
+            "prune with --write"
+        )
+    return violations, notes
+
+
+# a synthetic HBM blow-up: one program's peak/temp inflated well past
+# any tolerance — the signature of an accidentally materialized
+# full-size temp (e.g. an unfused [N, K, D] intermediate)
+INJECTED_TEMP_BYTES = 1 << 26  # 64 MiB
+
+
+def prove_injection(
+    current: Dict[str, Dict],
+    budget_programs: Dict[str, Dict],
+    tolerance: float,
+) -> bool:
+    """Inflate one program's temp/peak bytes and assert the budget
+    check CATCHES it — run in CI so 'the gate would fire' is
+    demonstrated, not assumed."""
+    prog = sorted(current)[0]
+    doctored = {k: dict(v) for k, v in current.items()}
+    doctored[prog]["temp_bytes"] = (
+        int(doctored[prog].get("temp_bytes", 0)) + INJECTED_TEMP_BYTES
+    )
+    doctored[prog]["peak_bytes"] = (
+        int(doctored[prog].get("peak_bytes", 0)) + INJECTED_TEMP_BYTES
+    )
+    violations, _ = check_fingerprints(
+        doctored, budget_programs, tolerance=tolerance
+    )
+    return any(
+        prog in v and ("temp_bytes" in v or "peak_bytes" in v)
+        for v in violations
+    )
+
+
+# ---- CLI ------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.analysis.mem",
+        description=(
+            "numlint compiled-memory ratchet: fingerprint the step "
+            "programs' memory_analysis() against the committed budget "
+            "(docs/static-analysis.md)"
+        ),
+    )
+    p.add_argument(
+        "--check",
+        metavar="FILE",
+        help=f"check fingerprints against a budget (e.g. {DEFAULT_BUDGET})",
+    )
+    p.add_argument(
+        "--write",
+        metavar="FILE",
+        help="compile and write the current fingerprints as the budget",
+    )
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=None,
+        help="byte-growth tolerance (default: the budget's, else "
+        "HYDRAGNN_NUMLINT_MEM_TOLERANCE or 0.25)",
+    )
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help='harness mesh "d,m" (default: the HLO ratchet\'s 4,2 canon)',
+    )
+    p.add_argument(
+        "--prove-injection",
+        action="store_true",
+        help="after checking, inflate one program's temp/peak bytes and "
+        "assert the gate catches it (the CI reintroduction proof)",
+    )
+    args = p.parse_args(argv)
+
+    from hydragnn_tpu.analysis import hlo as hlo_mod
+
+    if not args.check and not args.write:
+        print(
+            "mem-ratchet: one of --check/--write is required",
+            file=sys.stderr,
+        )
+        return 2
+    mesh_arg = args.mesh or (
+        f"{hlo_mod.DEFAULT_MESH[0]},{hlo_mod.DEFAULT_MESH[1]}"
+    )
+    try:
+        d, m = (int(v) for v in mesh_arg.split(","))
+    except ValueError:
+        print(
+            f'mem-ratchet: --mesh {mesh_arg!r} is not "d,m"',
+            file=sys.stderr,
+        )
+        return 2
+
+    # validate the budget BEFORE the multi-minute 8-program compile
+    budget = None
+    try:
+        tolerance = (
+            args.tolerance
+            if args.tolerance is not None
+            else default_tolerance()
+        )
+    except ValueError as e:
+        print(f"mem-ratchet: {e}", file=sys.stderr)
+        return 2
+    if args.check and not args.write:
+        try:
+            budget = load_budget(args.check)
+        except FileNotFoundError:
+            print(
+                f"mem-ratchet: budget {args.check} not found — derive "
+                "it with --write",
+                file=sys.stderr,
+            )
+            return 2
+        except ValueError as e:
+            print(f"mem-ratchet: {e}", file=sys.stderr)
+            return 2
+        if args.tolerance is None:
+            tolerance = float(budget.get("tolerance", tolerance))
+        bmesh = budget.get("mesh", {})
+        if list(bmesh.get("shape", [])) != [d, m]:
+            print(
+                f"mem-ratchet: budget was derived on mesh "
+                f"{bmesh.get('shape')} but this run uses [{d}, {m}] — "
+                "fingerprints are not comparable (pass the matching "
+                "--mesh)",
+                file=sys.stderr,
+            )
+            return 2
+
+    # the canonical environment (shared with the HLO ratchet): forced
+    # CPU devices, no ambient HYDRAGNN_MESH leaking into the harness
+    os.environ.pop("HYDRAGNN_MESH", None)
+    hlo_mod._force_cpu_devices(max(d * m, 8))
+
+    print(f"mem-ratchet: compiling 8 step programs on a {d}x{m} CPU mesh")
+    _texts, _axes, shape, context = hlo_mod.compile_step_programs((d, m))
+    try:
+        current = fingerprint_programs(context["compiled"])
+    except RuntimeError as e:
+        print(f"mem-ratchet: {e}", file=sys.stderr)
+        return 2
+
+    if args.write:
+        save_budget(args.write, current, shape, tolerance=tolerance)
+        print(
+            f"mem-ratchet: wrote {len(current)} program memory "
+            f"fingerprint(s) to {args.write}"
+        )
+        return 0
+
+    violations, notes = check_fingerprints(
+        current, budget.get("programs", {}), tolerance=tolerance
+    )
+    for note in notes:
+        print(f"note: {note}", file=sys.stderr)
+    for v in violations:
+        print(f"VIOLATION: {v}")
+    ok = not violations
+    print(
+        f"mem-ratchet: {len(violations)} violation(s) across "
+        f"{len(current)} program(s) (tolerance {tolerance:.0%})"
+    )
+    if ok and args.prove_injection:
+        if prove_injection(
+            current, budget.get("programs", {}), tolerance
+        ):
+            print(
+                "mem-ratchet: injection proof OK — a synthetic HBM "
+                "blow-up IS caught by this budget"
+            )
+        else:
+            print(
+                "mem-ratchet: injection proof FAILED — the gate did "
+                "not catch a synthetic temp/peak-bytes inflation",
+                file=sys.stderr,
+            )
+            return 1
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,7 +131,12 @@ def masked_error(pred, target, mask, kind: str = "mse", axis_name: Optional[str]
     count = jnp.maximum(count, 1.0)
     out = numer / count
     if kind == "rmse":
-        out = jnp.sqrt(out)
+        # double-where: sqrt'(0) is inf, so a perfectly-fit batch (zero
+        # masked error) would NaN the backward pass; forward-identical
+        # (sqrt(0) = 0 either way)
+        positive = out > 0.0
+        safe = jnp.where(positive, out, 1.0)
+        out = jnp.where(positive, jnp.sqrt(safe), 0.0)
     return out
 
 

@@ -47,6 +47,15 @@ _BESSEL_ZEROS = np.array(
 )
 
 
+def _safe_sqrt(x):
+    """sqrt with a finite gradient at 0 (double-where idiom): coincident
+    or padded positions make the squared distance EXACTLY 0, and
+    sqrt'(0) = inf would NaN the backward pass through every such slot
+    even where the forward value is masked away."""
+    positive = x > 0.0
+    return jnp.where(positive, jnp.sqrt(jnp.where(positive, x, 1.0)), 0.0)
+
+
 def _spherical_jn(l_max: int, x):
     """j_0..j_{l_max} via upward recurrence; x > 0 assumed (clamped)."""
     x = jnp.maximum(x, 1e-8)
@@ -191,7 +200,7 @@ def _dimenet_geometry_dense(
     # out-slot validity mask
     out_edge, out_mask = ex["out_edge"], ex["rev_mask"]
 
-    dist = jnp.sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
+    dist = _safe_sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
     dist = jnp.where(batch.edge_mask, dist, cutoff)  # keep env finite
 
     # radial part on the in-edge slots (shared _radial_sbf arithmetic)
@@ -241,7 +250,7 @@ def _dimenet_geometry(
     idx_i, idx_j, idx_k = ex["trip_i"], ex["trip_j"], ex["trip_k"]
     trip_mask = ex["trip_mask"]
 
-    dist = jnp.sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
+    dist = _safe_sqrt(((pos[i] - pos[j]) ** 2).sum(-1))
     dist = jnp.where(batch.edge_mask, dist, cutoff)  # keep env finite
 
     pos_i = pos[idx_i]
@@ -255,7 +264,7 @@ def _dimenet_geometry(
     if partition_axis is not None:
         # per-triplet k->j distance from halo-extended positions (the
         # (k->j) edge row itself may live on another shard)
-        dist_t = jnp.sqrt(((pos[idx_k] - pos[idx_j]) ** 2).sum(-1))
+        dist_t = _safe_sqrt(((pos[idx_k] - pos[idx_j]) ** 2).sum(-1))
         dist_t = jnp.where(trip_mask, dist_t, cutoff)
     sbf = spherical_basis(
         num_spherical,

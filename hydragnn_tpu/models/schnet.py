@@ -50,7 +50,11 @@ class GaussianSmearing(nn.Module):
         coeff = -0.5 / (offset[1] - offset[0]) ** 2
         # rank-agnostic: [E] -> [E, G] and dense [N, K] -> [N, K, G]
         d = dist[..., None] - offset
-        return jnp.exp(coeff * d * d)
+        # coeff < 0 and d*d >= 0, so the clamp is forward-identical (and
+        # gradient-identical: at the d=0 tie the inner chain-rule factor
+        # 2*coeff*d is already 0) — it bounds the exp for the numerics
+        # gate against a future dist that escapes the cutoff clamp
+        return jnp.exp(jnp.minimum(coeff * d * d, 0.0))
 
 
 class CFConv(nn.Module):

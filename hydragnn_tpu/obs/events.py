@@ -178,6 +178,14 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # fleet integrated replica-seconds the rows (plus idle) sum to
     "tenant_cost": ("tenant", "device_s", "flops", "requests",
                     "replica_s"),
+    # NaN sentinel (analysis/guards.py nan_sentinel / nan_origin): the
+    # runtime half of the numlint numerics suite — a wrapped step or a
+    # canary shadow answer produced a non-finite value. scope names the
+    # wrapped region (train_step, canary:<candidate>), origin the FIRST
+    # non-finite leaf's pytree path, subtree its leading component (the
+    # head/param group to blame), leaves/total the non-finite/total leaf
+    # counts of the output tree
+    "nan_origin": ("scope", "origin", "subtree", "leaves", "total"),
 }
 
 _ENVELOPE = ("event", "ts", "seq")

@@ -114,10 +114,15 @@ def build_neighbor_lists(
 def gather_neighbors(x, nbr_idx, rev_idx, rev_mask):
     """``x[nbr_idx]`` ([N, D] -> [N, K, D]) whose backward pass is a
     gather through the reverse list instead of a scatter-add."""
+    # host-built lists: padded slots hold index 0 (always in range);
+    # every consumer masks the gathered rows with nbr_mask before
+    # accumulating, so the raw gather is the masking contract's input
+    # numlint: disable=unmasked-gather-id
     return x[nbr_idx]
 
 
 def _gather_fwd(x, nbr_idx, rev_idx, rev_mask):
+    # numlint: disable=unmasked-gather-id — mirrors the primal above
     return x[nbr_idx], (x.shape, nbr_idx.shape, rev_idx, rev_mask)
 
 
@@ -125,7 +130,10 @@ def _gather_bwd(res, g):
     (n, d), (_, k_in), rev_idx, rev_mask = res
     flat = g.reshape(n * k_in, d)
     contrib = flat[rev_idx]  # [N, K_out, D]
-    gx = jnp.where(rev_mask[..., None], contrib, 0.0).sum(axis=1)
+    # K_out-axis accumulation in f32 (a bf16 cotangent would otherwise
+    # sum at bf16); the upcast is a no-op on the f32 path
+    gm = jnp.where(rev_mask[..., None], contrib, 0.0).astype(jnp.float32)
+    gx = gm.sum(axis=1).astype(g.dtype)
     return gx, None, None, None
 
 
@@ -148,7 +156,10 @@ def group_sum(values, lists, lists_mask, owner_ids, valid):
     host-side lists.
     """
     member = values[lists]  # [G, K, D]
-    return jnp.where(lists_mask[..., None], member, 0.0).sum(axis=1)
+    # masked K-axis sum accumulates in f32, result back at the input
+    # dtype (PNA fused-stats convention; no-op on the f32 path)
+    hm = jnp.where(lists_mask[..., None], member, 0.0).astype(jnp.float32)
+    return hm.sum(axis=1).astype(values.dtype)
 
 
 def _group_sum_fwd(values, lists, lists_mask, owner_ids, valid):
@@ -259,7 +270,10 @@ def aggregate_to_senders(h, nbr_idx, nbr_mask, rev_idx, rev_mask):
     n, k_in, d = h.shape
     flat = h.reshape(n * k_in, d)
     contrib = flat[rev_idx]  # [N, K_out, D]
-    return jnp.where(rev_mask[..., None], contrib, 0.0).sum(axis=1)
+    # masked K_out-axis sum accumulates in f32 (bf16 dense path), cast
+    # back to the message dtype — no-op when h is already f32
+    hm = jnp.where(rev_mask[..., None], contrib, 0.0).astype(jnp.float32)
+    return hm.sum(axis=1).astype(h.dtype)
 
 
 def _agg_send_fwd(h, nbr_idx, nbr_mask, rev_idx, rev_mask):
@@ -284,14 +298,20 @@ def dense_moments(h, nbr_mask):
     ``h [N, K, D]`` — PNA's count/mean/std statistics without a scatter.
     Matches segment_moments semantics: empty receivers -> mean/std of 0."""
     m = nbr_mask[..., None]
-    hm = jnp.where(m, h, 0.0)
-    cnt = nbr_mask.sum(axis=1).astype(h.dtype)[:, None]
+    # statistics accumulate in f32 regardless of the message dtype and
+    # come back at h.dtype — the dense twin of the fused-kernel f32
+    # stats path (models/pna.py casts the same way)
+    hm = jnp.where(m, h, 0.0).astype(jnp.float32)
+    cnt = nbr_mask.sum(axis=1).astype(jnp.float32)[:, None]
     has = cnt > 0
     deg = jnp.maximum(cnt, 1.0)
     mean = hm.sum(axis=1) / deg
     sq = (hm * hm).sum(axis=1) / deg
     std = jnp.sqrt(jnp.maximum(sq - mean * mean, 0.0) + 1e-5)
-    return mean, std, deg, has
+    return (
+        mean.astype(h.dtype), std.astype(h.dtype),
+        deg.astype(h.dtype), has,
+    )
 
 
 def dense_minmax(h, nbr_mask, has, fill=0.0):
@@ -306,7 +326,10 @@ def dense_minmax(h, nbr_mask, has, fill=0.0):
 
 
 def dense_sum(h, nbr_mask):
-    return jnp.where(nbr_mask[..., None], h, 0.0).sum(axis=1)
+    # masked K-axis sum in f32, result at the message dtype (no-op for
+    # f32 inputs; the guard the bf16 dense path needs)
+    hm = jnp.where(nbr_mask[..., None], h, 0.0).astype(jnp.float32)
+    return hm.sum(axis=1).astype(h.dtype)
 
 
 def attach_neighbor_lists(batch):

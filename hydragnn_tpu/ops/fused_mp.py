@@ -422,10 +422,15 @@ def _fused_bwd(op_name, num_segments, interpret, res, g):
         d_xs, d_xr, d_ef, d_params = vjp_fn((ge, gz))
     else:
         d_xs, d_xr, d_ef, d_params = vjp_fn(ge)
-    d_node_a = jax.ops.segment_sum(d_xs, gid_a, num_segments=node_a.shape[0])
+    # the cotangents are f32 by construction (vjp of an f32 edge fn);
+    # the explicit upcast makes the scatter-add's f32 accumulation a
+    # static contract rather than an artifact of the current edge fn
+    d_node_a = jax.ops.segment_sum(
+        d_xs.astype(jnp.float32), gid_a, num_segments=node_a.shape[0]
+    )
     if op.uses_recv:
         d_node_a_b = jax.ops.segment_sum(
-            d_xr, gid_b, num_segments=node_b.shape[0]
+            d_xr.astype(jnp.float32), gid_b, num_segments=node_b.shape[0]
         )
         d_node_b = d_node_a_b.astype(node_b.dtype)
     else:

@@ -164,6 +164,10 @@ class _CandidateStats:
         self.samples = 0
         self.errors = 0
         self.nans = 0
+        # first few NaN-veto origin payloads (analysis/guards.nan_origin
+        # over the head outputs) — the rejection names WHICH head went
+        # non-finite, not just that one did
+        self.nan_origins: List[Dict] = []
         # per head: sum |canary - live|, sum |live|, element count
         self.head_abs_err: Dict[int, float] = {}
         self.head_abs_live: Dict[int, float] = {}
@@ -181,9 +185,19 @@ class _CandidateStats:
         finite = all(
             bool(np.all(np.isfinite(h))) for h in canary_heads
         )
+        origin = None
+        if not finite:
+            from hydragnn_tpu.analysis.guards import nan_origin
+
+            origin = nan_origin(
+                {f"head_{i}": h for i, h in enumerate(canary_heads)},
+                scope="canary",
+            )
         with self._lock:
             if not finite:
                 self.nans += 1
+                if origin is not None and len(self.nan_origins) < 8:
+                    self.nan_origins.append(origin)
                 return False
             for i, (live, cand) in enumerate(zip(live_heads, canary_heads)):
                 live = np.asarray(live, np.float64)
@@ -235,6 +249,7 @@ class _CandidateStats:
                 "samples": self.samples,
                 "errors": self.errors,
                 "nans": self.nans,
+                "nan_origins": [dict(o) for o in self.nan_origins],
                 "head_mae": head_mae,
                 "head_live_mag": head_live_mag,
                 "buckets": buckets,
@@ -249,11 +264,18 @@ def evaluate_gates(stats: Dict, gates: CanaryGates) -> Dict:
     the per-head and per-bucket gates. Separated from the controller so
     the decision table is unit-testable without any serving stack."""
     if stats["nans"] > 0:
+        origins = stats.get("nan_origins") or []
+        where = (
+            f" (first origin: `{origins[0]['subtree']}` at "
+            f"{origins[0]['origin']})"
+            if origins
+            else ""
+        )
         return {
             "verdict": "reject",
             "reason": (
                 f"nan_outputs: {stats['nans']} non-finite canary "
-                "answer(s) — hard veto"
+                f"answer(s) — hard veto{where}"
             ),
         }
     if stats["errors"] > gates.max_shadow_errors:
@@ -687,7 +709,8 @@ class CanaryController:
             self._promote(cand, stats)
         elif decision["verdict"] == "reject":
             self._reject(cand, decision["reason"],
-                         samples=stats["samples"])
+                         samples=stats["samples"],
+                         nan_origins=stats.get("nan_origins") or [])
         elif (
             time.monotonic() - self._armed_ts > self.gates.decide_timeout_s
         ):
@@ -733,6 +756,13 @@ class CanaryController:
             self.metrics.registry.inc("rejects_total")
             if reason and reason.startswith("nan_outputs"):
                 self.metrics.registry.inc("nan_vetoes_total")
+                # every NaN veto carries its origin into the event
+                # stream: WHICH head went non-finite, not just a count
+                for origin in decision.get("nan_origins") or []:
+                    self.fleet.emit(
+                        "nan_origin",
+                        **{**origin, "scope": f"canary:{seq}"},
+                    )
             self.fleet.emit(
                 "canary_rejected", candidate=seq,
                 checkpoint=manifest["checkpoint"], reason=reason,
@@ -747,8 +777,9 @@ class CanaryController:
             )
         return decision
 
-    def _reject(self, manifest: Dict, reason: str, samples: int = 0):
-        self._record(manifest, "rejected", reason, samples)
+    def _reject(self, manifest: Dict, reason: str, samples: int = 0,
+                **extra):
+        self._record(manifest, "rejected", reason, samples, **extra)
         self._teardown()
 
     def _promote(self, manifest: Dict, stats: Dict):

@@ -460,6 +460,22 @@ def build_steps(
     steps.train_multi = _jit(
         "train_multi", multi_train_step, donate_argnums=(0,)
     )
+    # opt-in NaN sentinel (the numlint suite's runtime half): wrap the
+    # per-step train programs so a diverged step fails IMMEDIATELY with
+    # the first non-finite head/param subtree named, instead of epochs
+    # later as a NaN loss curve. Opt-in because localization reads the
+    # outputs back per step — a debug harness, not a production default
+    from hydragnn_tpu.utils.envparse import env_int
+
+    if env_int("HYDRAGNN_NAN_SENTINEL", 0):
+        from hydragnn_tpu.analysis.guards import nan_sentinel
+
+        steps.train_step = nan_sentinel(
+            steps.train_step, scope="train_step"
+        )
+        steps.train_multi = nan_sentinel(
+            steps.train_multi, scope="train_multi"
+        )
     steps.epoch_scan = _jit("epoch_scan", epoch_scan, donate_argnums=(0,))
     steps.eval_epoch = _jit("eval_epoch", eval_epoch)
     steps.predict_scan = _jit("predict_scan", predict_scan)

@@ -1,0 +1,359 @@
+"""numlint's compiled-memory ratchet (analysis/mem.py) + NaN sentinel.
+
+Budget-level: fingerprint shape, save/load/version gate, the tolerance
+semantics (growth past tolerance fails naming program + field + bytes,
+a budgeted zero tolerates nothing, shrinkage and stale programs are
+notes), and the injection regression — a synthetic HBM blow-up on one
+program's temp/peak bytes MUST be caught.
+
+Env knobs: ``HYDRAGNN_NUMLINT_MEM_TOLERANCE`` and
+``HYDRAGNN_NAN_SENTINEL`` route through ``utils/envparse`` — a bad
+value raises naming the variable, never a bare ``float()`` traceback.
+
+Runtime: the :func:`~hydragnn_tpu.analysis.guards.nan_sentinel` harness
+(origin localization to a named head/param subtree, raise vs report
+modes, schema-gated ``nan_origin`` events) and one compiled e2e — two
+real step programs' ``memory_analysis()`` fingerprinted, budgeted,
+checked clean, then caught regressing.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.analysis.mem import (
+    BUDGET_VERSION,
+    GATED_FIELDS,
+    INJECTED_TEMP_BYTES,
+    check_fingerprints,
+    default_tolerance,
+    fingerprint_memory,
+    load_budget,
+    prove_injection,
+    save_budget,
+)
+
+_FP = {
+    "argument_bytes": 1000,
+    "output_bytes": 400,
+    "temp_bytes": 2000,
+    "alias_bytes": 0,
+    "generated_code_bytes": 0,
+    "peak_bytes": 3400,
+}
+
+
+def _programs():
+    return {"train_step": dict(_FP), "eval_step": dict(_FP)}
+
+
+# ---- budget roundtrip -----------------------------------------------------
+
+
+def pytest_budget_roundtrip_and_version_gate(tmp_path):
+    path = tmp_path / "mem.json"
+    save_budget(str(path), _programs(), (4, 2), tolerance=0.25)
+    budget = load_budget(str(path))
+    assert budget["version"] == BUDGET_VERSION
+    assert budget["mesh"]["shape"] == [4, 2]
+    assert budget["tolerance"] == 0.25
+    assert set(budget["programs"]) == {"train_step", "eval_step"}
+    assert budget["programs"]["train_step"]["peak_bytes"] == 3400
+    # a version-bumped budget must be regenerated, not reinterpreted
+    doctored = dict(budget, version=BUDGET_VERSION + 1)
+    path.write_text(json.dumps(doctored))
+    with pytest.raises(ValueError, match="version"):
+        load_budget(str(path))
+
+
+# ---- tolerance semantics --------------------------------------------------
+
+
+def pytest_check_semantics():
+    budget = _programs()
+    # identical fingerprints: clean
+    v, n = check_fingerprints(_programs(), budget, tolerance=0.25)
+    assert not v and not n
+    # growth inside tolerance: clean
+    ok = _programs()
+    ok["train_step"]["temp_bytes"] = 2400  # +20% < 25%
+    v, _ = check_fingerprints(ok, budget, tolerance=0.25)
+    assert not v
+    # growth past tolerance: violation naming program, field and bytes
+    grown = _programs()
+    grown["train_step"]["peak_bytes"] = 5000
+    v, _ = check_fingerprints(grown, budget, tolerance=0.25)
+    assert len(v) == 1
+    assert "train_step" in v[0] and "peak_bytes" in v[0]
+    assert "3400" in v[0] and "5000" in v[0]
+    # a budgeted zero tolerates NOTHING: a program with no temp buffer
+    # today cannot silently start materializing one
+    zb = _programs()
+    zb["eval_step"]["temp_bytes"] = 0
+    zb["eval_step"]["peak_bytes"] = 1400
+    now = _programs()
+    now["eval_step"]["temp_bytes"] = 64
+    now["eval_step"]["peak_bytes"] = 1400
+    v, _ = check_fingerprints(now, zb, tolerance=0.25)
+    assert any("eval_step" in x and "temp_bytes" in x for x in v)
+    # shrinkage is a note (tighten the budget), not a violation
+    small = _programs()
+    small["train_step"]["temp_bytes"] = 100
+    v, n = check_fingerprints(small, budget, tolerance=0.25)
+    assert not v
+    assert any("shrank" in x for x in n)
+    # an unbudgeted program is a violation; a stale budgeted one a note
+    v, n = check_fingerprints(
+        {**_programs(), "fit_scan": dict(_FP)}, budget, tolerance=0.25
+    )
+    assert any("fit_scan" in x and "not in the memory budget" in x
+               for x in v)
+    v, n = check_fingerprints(
+        {"train_step": dict(_FP)}, budget, tolerance=0.25
+    )
+    assert not v
+    assert any("eval_step" in x and "stale" in x for x in n)
+
+
+def pytest_injection_is_caught():
+    assert prove_injection(_programs(), _programs(), tolerance=0.25)
+    # a tolerance wide enough to swallow the synthetic blow-up means
+    # the gate is NOT proving anything — the proof must say so
+    huge = INJECTED_TEMP_BYTES * 10 / _FP["temp_bytes"]
+    assert not prove_injection(_programs(), _programs(), tolerance=huge)
+
+
+# ---- env knobs route through envparse -------------------------------------
+
+
+def pytest_mem_tolerance_env_knob(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_NUMLINT_MEM_TOLERANCE", raising=False)
+    assert default_tolerance() == 0.25
+    monkeypatch.setenv("HYDRAGNN_NUMLINT_MEM_TOLERANCE", "0.5")
+    assert default_tolerance() == 0.5
+    for bad in ("soon", "nan", "-0.5"):
+        monkeypatch.setenv("HYDRAGNN_NUMLINT_MEM_TOLERANCE", bad)
+        with pytest.raises(
+            ValueError, match="HYDRAGNN_NUMLINT_MEM_TOLERANCE"
+        ):
+            default_tolerance()
+
+
+def pytest_nan_sentinel_env_knob(monkeypatch):
+    from hydragnn_tpu.utils.envparse import env_int
+
+    monkeypatch.setenv("HYDRAGNN_NAN_SENTINEL", "yes")
+    with pytest.raises(ValueError, match="HYDRAGNN_NAN_SENTINEL"):
+        env_int("HYDRAGNN_NAN_SENTINEL", 0)
+    monkeypatch.setenv("HYDRAGNN_NAN_SENTINEL", "1")
+    assert env_int("HYDRAGNN_NAN_SENTINEL", 0) == 1
+
+
+# ---- nan sentinel (the runtime half) --------------------------------------
+
+
+def pytest_nonfinite_report_and_origin():
+    from hydragnn_tpu.analysis.guards import nan_origin, nonfinite_report
+
+    tree = {
+        "params": {
+            "head_energy": jnp.array([1.0, np.nan]),
+            "head_forces": jnp.ones(3),
+        },
+        "loss": jnp.array(np.inf),
+        "step": jnp.array(3),  # int leaves count as finite
+    }
+    bad = nonfinite_report(tree)
+    assert [p for p, _ in bad] == ["['loss']", "['params']['head_energy']"]
+    origin = nan_origin(tree, "train_step")
+    assert origin == {
+        "scope": "train_step",
+        "origin": "['loss']",
+        "subtree": "loss",
+        "leaves": 2,
+        "total": 4,
+    }
+    assert nan_origin({"x": jnp.ones(2)}, "s") is None
+
+
+def pytest_nan_sentinel_raise_and_report_modes():
+    from hydragnn_tpu.analysis.guards import NonFiniteError, nan_sentinel
+
+    def step(x):
+        return {"loss": jnp.log(x), "aux": x}
+
+    wrapped = nan_sentinel(step, scope="train_step")
+    out = wrapped(jnp.array(2.0))  # finite passes through untouched
+    assert float(out["loss"]) == pytest.approx(np.log(2.0))
+    with pytest.raises(NonFiniteError, match="train_step.*loss"):
+        wrapped(jnp.array(-1.0))
+
+    class Log:
+        def __init__(self):
+            self.recs = []
+
+        def emit(self, event, **fields):
+            self.recs.append((event, fields))
+
+    log = Log()
+    reporter = nan_sentinel(
+        step, scope="canary:7", events=log, mode="report"
+    )
+    out = reporter(jnp.array(-1.0))  # report mode never raises
+    assert not np.isfinite(float(out["loss"]))
+    assert log.recs == [
+        (
+            "nan_origin",
+            {
+                "scope": "canary:7",
+                "origin": "['loss']",
+                "subtree": "loss",
+                "leaves": 1,
+                "total": 2,
+            },
+        )
+    ]
+    with pytest.raises(ValueError, match="mode"):
+        nan_sentinel(step, scope="s", mode="maybe")
+
+
+def pytest_nan_origin_event_is_schema_valid(tmp_path):
+    from hydragnn_tpu.analysis.guards import nan_origin
+    from hydragnn_tpu.obs.events import (
+        EVENT_FIELDS,
+        RunEventLog,
+        validate_events,
+    )
+
+    assert EVENT_FIELDS["nan_origin"] == (
+        "scope", "origin", "subtree", "leaves", "total",
+    )
+    log = RunEventLog(str(tmp_path / "events.jsonl"))
+    payload = nan_origin({"loss": jnp.array(np.nan)}, "train_step")
+    log.emit("nan_origin", **payload)
+    log.close()
+    # validate_events raises on any schema violation; requiring the
+    # type proves the emit really landed
+    records = validate_events(
+        str(tmp_path / "events.jsonl"), require=["nan_origin"]
+    )
+    assert records[0]["subtree"] == "loss"
+
+
+def pytest_canary_nan_veto_carries_origin():
+    from hydragnn_tpu.serve.canary import (
+        CanaryGates,
+        _CandidateStats,
+        evaluate_gates,
+    )
+
+    stats = _CandidateStats()
+    live = [np.ones((2, 1), np.float32)]
+    bad = [np.full((2, 1), np.nan, np.float32)]
+    assert stats.add_sample(live, bad, bucket=0,
+                            live_latency_s=0.01, canary_latency_s=0.01) \
+        is False
+    snap = stats.snapshot()
+    assert snap["nans"] == 1
+    assert snap["nan_origins"][0]["subtree"] == "head_0"
+    decision = evaluate_gates(snap, CanaryGates(min_samples=1))
+    assert decision["verdict"] == "reject"
+    assert decision["reason"].startswith("nan_outputs")
+    assert "head_0" in decision["reason"]
+
+
+def pytest_nan_sentinel_wired_into_train_step(monkeypatch):
+    """HYDRAGNN_NAN_SENTINEL=1 wraps the built train step: poisoned
+    params fail the FIRST step with the offending subtree named,
+    instead of an epochs-later NaN loss curve."""
+    from test_models_forward import FakeData
+
+    from hydragnn_tpu.analysis.guards import NonFiniteError
+    from hydragnn_tpu.graph import collate_graphs, pad_sizes_for
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train.trainer import Trainer
+
+    monkeypatch.setenv("HYDRAGNN_NAN_SENTINEL", "1")
+    rng = np.random.default_rng(0)
+    n_pad, e_pad, g_pad = pad_sizes_for(6, 12, 4, graph_multiple=4)
+    batch = collate_graphs(
+        [FakeData(rng, 5) for _ in range(4)], n_pad, e_pad, g_pad,
+        head_types=("graph",), head_dims=(1,),
+    )
+    model = create_model_config({
+        "model_type": "GIN",
+        "input_dim": 1,
+        "hidden_dim": 8,
+        "output_dim": [1],
+        "output_type": ["graph"],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1, "dim_sharedlayers": 4,
+                "num_headlayers": 1, "dim_headlayers": [4],
+            },
+        },
+        "task_weights": [1.0],
+        "num_conv_layers": 1,
+        "num_nodes": 6,
+        "edge_dim": None,
+        "equivariance": False,
+    })
+    trainer = Trainer(model, training_config={
+        "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+    })
+    state = trainer.init_state(batch)
+    poisoned = state.replace(
+        params=jax.tree_util.tree_map(
+            lambda p: jnp.full_like(p, jnp.nan), state.params
+        )
+    )
+    with pytest.raises(NonFiniteError, match="train_step"):
+        trainer._train_step(poisoned, batch, jax.random.PRNGKey(0))
+
+
+# ---- compiled e2e (two real programs) -------------------------------------
+
+
+def pytest_compiled_memory_fingerprint_is_stable_components():
+    """fingerprint_memory on a real compiled program: integer bytes,
+    and the gated peak is the alias-free component sum (XLA's alias
+    accounting is not stable across compiles — the ratchet must not
+    flap on it)."""
+    fn = jax.jit(lambda x: (x @ x).sum())
+    compiled = fn.lower(jnp.ones((16, 16), jnp.float32)).compile()
+    fp = fingerprint_memory(compiled)
+    for field in GATED_FIELDS:
+        assert isinstance(fp[field], int)
+    assert fp["peak_bytes"] == (
+        fp["argument_bytes"] + fp["output_bytes"] + fp["temp_bytes"]
+        + fp["generated_code_bytes"]
+    )
+    assert fp["argument_bytes"] >= 16 * 16 * 4
+
+
+def pytest_compiled_programs_budget_and_ratchet(tmp_path):
+    """Compile train_step + eval_step on a real 2x2 mesh, budget their
+    memory fingerprints, check clean, then prove the synthetic HBM
+    blow-up fails — the CI memory-ratchet smoke in miniature."""
+    from hydragnn_tpu.analysis.hlo import compile_step_programs
+    from hydragnn_tpu.analysis.mem import fingerprint_programs
+
+    _texts, _axes, shape, context = compile_step_programs(
+        (2, 2), programs=("train_step", "eval_step")
+    )
+    current = fingerprint_programs(context["compiled"])
+    assert set(current) == {"train_step", "eval_step"}
+    # a real train step moves real bytes
+    assert current["train_step"]["peak_bytes"] > 0
+    assert current["train_step"]["argument_bytes"] > 0
+
+    path = tmp_path / "mem.json"
+    save_budget(str(path), current, shape, tolerance=0.25)
+    budget = load_budget(str(path))
+    v, n = check_fingerprints(current, budget["programs"], tolerance=0.25)
+    assert not v and not n
+    assert prove_injection(current, budget["programs"], tolerance=0.25)

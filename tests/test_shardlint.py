@@ -365,6 +365,7 @@ def pytest_list_rules_groups_by_suite(capsys):
         "suite jax (jaxlint gate",
         "suite concurrency (threadlint gate",
         "suite sharding (shardlint gate",
+        "suite numerics (numlint gate",
     ):
         assert header in listed, listed
     # every registered rule appears with its one-line doc
@@ -386,17 +387,18 @@ def pytest_list_rules_groups_by_suite(capsys):
 
 def pytest_multi_suite_stats_and_github_in_one_invocation(tmp_path, capsys):
     """One invocation with NO --suite must report findings from all
-    three suites: github annotations for each, and a --stats table
+    FOUR suites: github annotations for each, and a --stats table
     listing every suite's rules (satellite: report coverage across
     suites, previously only exercised per-suite)."""
     bad = tmp_path / "serve" / "s.py"
     bad.parent.mkdir(parents=True)
     bad.write_text(
         "import queue\n"
-        "import jax\n\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
         "q = queue.Queue()\n\n"
         "def f(x, acc=[]):\n"
-        "    return jax.device_put(x)\n"
+        "    return jax.device_put(x.astype(jnp.bfloat16))\n"
     )
     assert lint_main([str(bad), "--format=github", "--stats"]) == 1
     out = capsys.readouterr().out
@@ -405,11 +407,13 @@ def pytest_multi_suite_stats_and_github_in_one_invocation(tmp_path, capsys):
         "queue-misuse",  # concurrency
         "mutable-default-arg",  # jax
         "device-put-without-sharding",  # sharding
+        "precision-policy-bypass",  # numerics
     ):
         assert f"title=jaxlint {rule}" in out, out
-    # the stats table covers all three suites' rules in one run
+    # the stats table covers all four suites' rules in one run
     for rule in ("queue-misuse", "mutable-default-arg",
-                 "device-put-without-sharding", "hardcoded-mesh-axis"):
+                 "device-put-without-sharding", "hardcoded-mesh-axis",
+                 "precision-policy-bypass"):
         assert rule in out.split("new finding(s)")[-1], out
     # and per-suite baselines compose in one gate each: the sharding
     # baseline absorbs the sharding finding, the others still fail

@@ -42,13 +42,18 @@ def _rules_of(findings):
 
 
 def pytest_suite_registry_is_partitioned():
-    assert all_suites() == {"jax", "concurrency", "sharding"}
+    assert all_suites() == {"jax", "concurrency", "sharding", "numerics"}
     assert rules_in_suite("concurrency") == CONCURRENCY_RULES
     # jax suite still carries every pre-existing rule
     assert "host-sync-in-hot-loop" in rules_in_suite("jax")
     assert not rules_in_suite("jax") & CONCURRENCY_RULES
     assert not rules_in_suite("sharding") & (
         rules_in_suite("jax") | CONCURRENCY_RULES
+    )
+    assert not rules_in_suite("numerics") & (
+        rules_in_suite("jax")
+        | rules_in_suite("sharding")
+        | CONCURRENCY_RULES
     )
 
 
