@@ -14,6 +14,9 @@ Subcommands::
     trace <run-or-coordination dir | events.jsonl>
         [--format text|json] [--slow N]
 
+    drift <run-or-coordination dir | events.jsonl>
+        [--format text|json]
+
 ``fleet`` merges every per-host event stream (rank 0's ``events.jsonl``
 plus the elastic hosts' ``events-host<k>.jsonl``) and the elastic
 heartbeat leases' step-time digests found under the directory into one
@@ -34,6 +37,7 @@ import argparse
 import os
 import sys
 
+from hydragnn_tpu.obs import drift as drift_mod
 from hydragnn_tpu.obs import ledger as ledger_mod
 from hydragnn_tpu.obs import report as report_mod
 from hydragnn_tpu.obs import trace as trace_mod
@@ -132,6 +136,22 @@ def build_parser() -> argparse.ArgumentParser:
         default=10,
         help="slowest traces to list with their dominant segment "
         "(default: 10)",
+    )
+    dr = sub.add_parser(
+        "drift",
+        help="model-quality report: drift scores vs the pinned "
+        "reference, alert ledger, uncertainty quantiles, feedback sink",
+    )
+    dr.add_argument(
+        "dir",
+        help="run or coordination directory (searched recursively for "
+        "events*.jsonl) or one stream file",
+    )
+    dr.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
     )
     return p
 
@@ -322,6 +342,25 @@ def _run_trace(args) -> int:
     return 0
 
 
+def _run_drift(args) -> int:
+    records = drift_mod.load_quality_events(args.dir)
+    if not records:
+        print(
+            f"obs drift: no drift/quality events under {args.dir} "
+            "(was HYDRAGNN_DRIFT_WINDOW set for the run?)",
+            file=sys.stderr,
+        )
+        return 2
+    report = drift_mod.build_drift_report(records)
+    if args.format == "json":
+        import json
+
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    print(drift_mod.render_drift_text(report), end="")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "report":
@@ -330,6 +369,8 @@ def main(argv=None) -> int:
         return _run_fleet(args)
     if args.command == "trace":
         return _run_trace(args)
+    if args.command == "drift":
+        return _run_drift(args)
     build_parser().print_help(sys.stderr)
     return 2
 

@@ -178,6 +178,24 @@ EVENT_FIELDS: Dict[str, tuple] = {
     # fleet integrated replica-seconds the rows (plus idle) sum to
     "tenant_cost": ("tenant", "device_s", "flops", "requests",
                     "replica_s"),
+    # model-quality observatory (obs/drift.py DriftDetector): one per
+    # completed tumbling window — scores maps "tenant|feature|head" to
+    # {psi, ks} vs the version-pinned reference; optional `uncertainty`
+    # carries per-"tenant|head" predictive-variance quantiles
+    "drift_window": ("version", "window", "scores"),
+    # model-quality observatory: a feature's drift score crossed the
+    # hysteresis threshold (status raised) or came back under it for
+    # clear_after consecutive windows (status cleared) — always scored
+    # vs what `version` was vetted on, never a moving baseline
+    "drift_alert": (
+        "tenant", "feature", "head", "kind", "score", "status",
+        "version",
+    ),
+    # feedback sink (serve/quality.py FeedbackSink): cumulative queue-
+    # dir counters at each pack flush — accepted (buffered for
+    # labeling), deduped (canonical_graph_key repeats), graphs/packs
+    # (persisted shard_store totals)
+    "feedback_sink": ("accepted", "deduped", "graphs", "packs"),
     # NaN sentinel (analysis/guards.py nan_sentinel / nan_origin): the
     # runtime half of the numlint numerics suite — a wrapped step or a
     # canary shadow answer produced a non-finite value. scope names the

@@ -618,8 +618,20 @@ def build_fleet_report(
         if r.get("event")
         in ("run_manifest", "host_lost", "world_resize", "stall",
             "guard_restore", "checkpoint_restored", "resume", "run_end",
-            "early_stop", "wallclock_stop")
+            "early_stop", "wallclock_stop", "drift_alert")
     ]
+
+    # model-quality rollup: merge drift/sink events from every stream
+    # (old streams carry none — the section stays None and renderers
+    # omit it, so pre-observatory fleets keep rendering unchanged)
+    quality = None
+    from hydragnn_tpu.obs.drift import QUALITY_EVENTS, build_drift_report
+
+    quality_records = [
+        r for r in records if r.get("event") in QUALITY_EVENTS
+    ]
+    if quality_records:
+        quality = build_drift_report(quality_records)
 
     return {
         "root": root,
@@ -638,6 +650,7 @@ def build_fleet_report(
         "mean_goodput_fraction": (
             round(sum(goodputs) / len(goodputs), 6) if goodputs else None
         ),
+        "quality": quality,
         "timeline": timeline,
     }
 
@@ -686,6 +699,23 @@ def render_fleet_text(report: Dict) -> str:
                 f"{r['t']:>10.3f}s  gen {r['gen']}: {r['old_world']} -> "
                 f"{r['new_world']} hosts, recovery {r['recovery_s']}s"
             )
+    q = report.get("quality")
+    if q:
+        lines += ["", "-- model quality (fleet-merged drift events) --"]
+        lines.append(
+            f"windows: {q.get('windows', 0)}  alert events: "
+            f"{len(q.get('alerts') or [])}  active: "
+            f"{len(q.get('alerts_active') or [])}"
+        )
+        for key in q.get("alerts_active") or []:
+            lines.append(f"ACTIVE ALERT: {key}")
+        sink = q.get("sink")
+        if sink:
+            lines.append(
+                f"feedback sink: accepted={sink.get('accepted')} "
+                f"deduped={sink.get('deduped')} "
+                f"graphs={sink.get('graphs')} packs={sink.get('packs')}"
+            )
     if report["timeline"]:
         lines += ["", "-- cross-host timeline (s after first event) --"]
         for item in report["timeline"]:
@@ -727,6 +757,16 @@ def render_fleet_markdown(report: Dict) -> str:
                 f"- t={r['t']}s gen {r['gen']}: {r['old_world']} -> "
                 f"{r['new_world']} hosts, recovery {r['recovery_s']}s"
             )
+    q = report.get("quality")
+    if q:
+        lines += ["", "## Model quality (fleet-merged drift events)", ""]
+        lines.append(
+            f"windows: {q.get('windows', 0)}  alert events: "
+            f"{len(q.get('alerts') or [])}  active: "
+            f"{len(q.get('alerts_active') or [])}  "
+        )
+        for key in q.get("alerts_active") or []:
+            lines.append(f"- ACTIVE ALERT: `{key}`")
     return "\n".join(lines) + "\n"
 
 

@@ -205,6 +205,17 @@ def build_report(records: List[Dict]) -> Dict:
             "replica_s": _num(r.get("replica_s")),
         }
 
+    # model-quality observatory (obs/drift.py): folded only when the
+    # stream actually carries quality events, so reports over old
+    # streams omit the section instead of rendering an empty one
+    quality = None
+    from hydragnn_tpu.obs.drift import QUALITY_EVENTS, build_drift_report
+
+    if any(r["event"] in QUALITY_EVENTS for r in records):
+        quality = build_drift_report(
+            [r for r in records if r["event"] in QUALITY_EVENTS]
+        )
+
     counts = {
         key: sum(1 for r in records if r["event"] == key)
         for key in (
@@ -212,6 +223,7 @@ def build_report(records: List[Dict]) -> Dict:
             "guard_skip", "guard_restore", "resume", "staged", "fit_chunk",
             "candidate_published", "canary_promoted", "canary_rejected",
             "span", "quota_adjusted",
+            "drift_window", "drift_alert", "feedback_sink",
         )
     }
     counts["profile_done"] = sum(
@@ -262,6 +274,12 @@ def build_report(records: List[Dict]) -> Dict:
                 f"candidate={r.get('candidate')} {r.get('checkpoint')}: "
                 f"{r.get('reason')}"
             )
+        elif ev == "drift_alert":
+            desc = (
+                f"{r.get('status')} tenant={r.get('tenant')} "
+                f"feature={r.get('feature')} head={r.get('head')} "
+                f"{r.get('kind')}={r.get('score')}"
+            )
         else:
             continue
         timeline.append(
@@ -295,6 +313,7 @@ def build_report(records: List[Dict]) -> Dict:
         "goodput": goodput,
         "trace_anatomy": trace_anatomy,
         "tenant_bill": tenant_bill,
+        "quality": quality,
         "counts": counts,
         "timeline": timeline,
     }
@@ -502,6 +521,45 @@ def _anatomy_rows(report) -> List[List[str]]:
     ]
 
 
+_QUALITY_HEADERS = ("tenant", "feature", "head", "psi", "ks", "ref_ver")
+
+
+def _quality_rows(report) -> List[List[str]]:
+    q = report.get("quality") or {}
+    rows = []
+    for key in sorted(q.get("scores") or {}):
+        tenant, feature, head = (key.split("|") + ["-", "-"])[:3]
+        sc = q["scores"][key]
+        rows.append(
+            [
+                tenant, feature, head,
+                _fmt(_num(sc.get("psi")), 4),
+                _fmt(_num(sc.get("ks")), 4),
+                _fmt(sc.get("version")),
+            ]
+        )
+    return rows
+
+
+def _quality_summary(report) -> List[str]:
+    q = report.get("quality") or {}
+    lines = [
+        f"windows: {q.get('windows', 0)}  "
+        f"alert events: {len(q.get('alerts') or [])}  "
+        f"active: {len(q.get('alerts_active') or [])}"
+    ]
+    for key in q.get("alerts_active") or []:
+        lines.append(f"ACTIVE ALERT: {key}")
+    sink = q.get("sink")
+    if sink:
+        lines.append(
+            f"feedback sink: accepted={sink.get('accepted')} "
+            f"deduped={sink.get('deduped')} graphs={sink.get('graphs')} "
+            f"packs={sink.get('packs')}"
+        )
+    return lines
+
+
 def _bill_rows(report) -> List[List[str]]:
     return [
         [
@@ -550,6 +608,12 @@ def render_text(report: Dict) -> str:
     if report.get("tenant_bill"):
         lines += ["", "-- tenant bill (device-time attribution) --"]
         lines += _text_table(list(_BILL_HEADERS), _bill_rows(report))
+    if report.get("quality"):
+        lines += ["", "-- model quality (drift vs pinned reference) --"]
+        lines += _quality_summary(report)
+        rows = _quality_rows(report)
+        if rows:
+            lines += _text_table(list(_QUALITY_HEADERS), rows)
     if report["timeline"]:
         lines += ["", "-- timeline (s after first event) --"]
         for item in report["timeline"]:
@@ -594,6 +658,12 @@ def render_markdown(report: Dict) -> str:
     if report.get("tenant_bill"):
         lines += ["", "## Tenant bill (device-time attribution)", ""]
         lines += _md_table(list(_BILL_HEADERS), _bill_rows(report))
+    if report.get("quality"):
+        lines += ["", "## Model quality (drift vs pinned reference)", ""]
+        lines += [line + "  " for line in _quality_summary(report)]
+        rows = _quality_rows(report)
+        if rows:
+            lines += [""] + _md_table(list(_QUALITY_HEADERS), rows)
     if report["timeline"]:
         lines += ["", "## Timeline", ""]
         lines += _md_table(

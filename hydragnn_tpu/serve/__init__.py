@@ -52,6 +52,10 @@ from hydragnn_tpu.serve.fleet import (
 )
 from hydragnn_tpu.serve.http import ObservabilityServer
 from hydragnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from hydragnn_tpu.serve.quality import (
+    FeedbackSink,
+    UncertaintyScorer,
+)
 from hydragnn_tpu.serve.registry import (
     CandidateChannel,
     ModelEntry,
@@ -84,6 +88,7 @@ __all__ = [
     "CandidateChannel",
     "CostLedger",
     "DeadlineExceeded",
+    "FeedbackSink",
     "FleetAutoscaler",
     "FleetMetrics",
     "FleetRouter",
@@ -106,6 +111,7 @@ __all__ = [
     "TenantManager",
     "TenantOverQuota",
     "TenantSpec",
+    "UncertaintyScorer",
     "canonical_graph_key",
     "merge_bills",
     "plan_from_layout",

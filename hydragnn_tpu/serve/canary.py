@@ -52,6 +52,7 @@ import numpy as np
 
 from hydragnn_tpu import coord
 from hydragnn_tpu.obs.metrics import MetricsRegistry
+from hydragnn_tpu.utils import envparse
 from hydragnn_tpu.serve.fleet import (
     CANARY,
     DEFAULT_HEARTBEAT_S,
@@ -97,6 +98,12 @@ class CanaryGates:
     max_shadow_errors: int = 0
     max_crashes: int = 1
     decide_timeout_s: float = 120.0
+    # uncertainty veto (None = gate off): reject when the candidate's
+    # mean predictive uncertainty exceeds live's by more than this
+    # ratio — only meaningful when the serving path runs an
+    # UncertaintyScorer, inert otherwise (no uncertainty samples ever
+    # accumulate, and the gate skips on an empty record)
+    max_unc_ratio: Optional[float] = None
 
     @classmethod
     def from_env(cls, **overrides) -> "CanaryGates":
@@ -121,6 +128,13 @@ class CanaryGates:
                 "HYDRAGNN_CANARY_MAX_CRASHES", base.max_crashes),
             decide_timeout_s=_env_float(
                 "HYDRAGNN_CANARY_DECIDE_TIMEOUT_S", base.decide_timeout_s),
+            max_unc_ratio=(
+                envparse.env_float(
+                    "HYDRAGNN_CANARY_MAX_UNC_RATIO", 0.0, minimum=1e-9
+                )
+                if os.getenv("HYDRAGNN_CANARY_MAX_UNC_RATIO")
+                else base.max_unc_ratio
+            ),
         )
 
 
@@ -176,10 +190,18 @@ class _CandidateStats:
         self.bucket_live_s: Dict[int, float] = {}
         self.bucket_canary_s: Dict[int, float] = {}
         self.bucket_n: Dict[int, int] = {}
+        # mean predictive uncertainty sums (present only when the
+        # serving path runs an UncertaintyScorer; the uncertainty veto
+        # skips when either side never reported)
+        self.unc_live_sum = 0.0
+        self.unc_live_n = 0
+        self.unc_canary_sum = 0.0
+        self.unc_canary_n = 0
 
     def add_sample(self, live_heads: List[np.ndarray],
                    canary_heads: List[np.ndarray], bucket: int,
-                   live_latency_s: float, canary_latency_s: float) -> bool:
+                   live_latency_s: float, canary_latency_s: float,
+                   live_unc=None, canary_unc=None) -> bool:
         """Fold one compared pair in; returns False (and records a NaN
         veto instead of a sample) when the canary answer is non-finite."""
         finite = all(
@@ -219,6 +241,22 @@ class _CandidateStats:
                 self.bucket_canary_s.get(b, 0.0) + float(canary_latency_s)
             )
             self.bucket_n[b] = self.bucket_n.get(b, 0) + 1
+            for vals, which in ((live_unc, "live"), (canary_unc, "canary")):
+                if not vals:
+                    continue
+                finite_u = [
+                    float(v) for v in vals
+                    if v is not None and np.isfinite(float(v))
+                ]
+                if not finite_u:
+                    continue
+                mean_u = sum(finite_u) / len(finite_u)
+                if which == "live":
+                    self.unc_live_sum += mean_u
+                    self.unc_live_n += 1
+                else:
+                    self.unc_canary_sum += mean_u
+                    self.unc_canary_n += 1
             self.samples += 1
         return True
 
@@ -253,6 +291,18 @@ class _CandidateStats:
                 "head_mae": head_mae,
                 "head_live_mag": head_live_mag,
                 "buckets": buckets,
+                "uncertainty": {
+                    "live_n": self.unc_live_n,
+                    "live_mean": (
+                        self.unc_live_sum / self.unc_live_n
+                        if self.unc_live_n else None
+                    ),
+                    "canary_n": self.unc_canary_n,
+                    "canary_mean": (
+                        self.unc_canary_sum / self.unc_canary_n
+                        if self.unc_canary_n else None
+                    ),
+                },
             }
 
 
@@ -311,6 +361,23 @@ def evaluate_gates(stats: Dict, gates: CanaryGates) -> Dict:
                 f"{rec['canary_mean_s'] * 1e3:.1f}ms > limit "
                 f"{limit * 1e3:.1f}ms (live "
                 f"{rec['live_mean_s'] * 1e3:.1f}ms over {rec['n']})"
+            )
+    unc = stats.get("uncertainty") or {}
+    if (
+        gates.max_unc_ratio is not None
+        and unc.get("live_mean") is not None
+        and unc.get("canary_mean") is not None
+        and unc.get("live_n", 0) >= gates.min_bucket_samples
+        and unc.get("canary_n", 0) >= gates.min_bucket_samples
+    ):
+        # the 1e-12 floor keeps a zero-variance live baseline (models
+        # without dropout) from turning ANY canary noise into a reject
+        limit = max(unc["live_mean"], 1e-12) * gates.max_unc_ratio
+        if unc["canary_mean"] > limit:
+            failures.append(
+                f"uncertainty: canary mean {unc['canary_mean']:.3e} > "
+                f"limit {limit:.3e} (live {unc['live_mean']:.3e}, "
+                f"ratio tol {gates.max_unc_ratio})"
             )
     if failures:
         return {
@@ -522,7 +589,10 @@ class CanaryController:
             self.metrics.registry.inc("shadow_shed_total")
             return
         try:
-            self._q.put_nowait((graph, body.get("heads"), float(latency_s)))
+            self._q.put_nowait((
+                graph, body.get("heads"), float(latency_s),
+                body.get("uncertainty"),
+            ))
         except queue.Full:
             self.metrics.registry.inc("shadow_shed_total")
             return
@@ -820,7 +890,9 @@ class CanaryController:
     def _shadow_worker(self):
         while not self._stop.is_set():
             try:
-                graph, live_heads, live_latency = self._q.get(timeout=0.1)
+                graph, live_heads, live_latency, live_unc = self._q.get(
+                    timeout=0.1
+                )
             except queue.Empty:
                 continue
             self.metrics.registry.set(
@@ -860,7 +932,8 @@ class CanaryController:
                 continue
             ok = self._stats.add_sample(
                 live_arrs, canary_heads, bucket, live_latency,
-                canary_latency,
+                canary_latency, live_unc=live_unc,
+                canary_unc=body.get("uncertainty"),
             )
             if ok:
                 self.metrics.registry.inc("shadow_samples_total")

@@ -318,6 +318,11 @@ class ReplicaServer:
         self._base_versions: Dict[str, int] = {}
         self._last_cmd_handled = 0
         self._active_seqs: Dict[str, int] = {}
+        # model-quality observatory hooks, wired by replica_main (or a
+        # test harness) after construction — same pattern as
+        # server.costs: None = feature off, zero request-path cost
+        self.drift = None  # obs/drift.py DriftDetector
+        self.sink = None   # serve/quality.py FeedbackSink
 
     def serving_names(self) -> List[str]:
         """Every model name this replica serves (the default plus all
@@ -460,6 +465,18 @@ class ReplicaServer:
         # "server stopped") — the PR 6 stop-under-load contract; handler
         # threads waiting on those futures answer their clients from it
         self.server.stop(drain=drain, timeout=timeout)
+        # flush partial quality state so no accepted feedback graph or
+        # drift sample is lost across a drain (both calls are idempotent)
+        if self.sink is not None:
+            try:
+                self.sink.close()
+            except Exception:
+                pass
+        if self.drift is not None:
+            try:
+                self.drift.evaluate_window()  # close the partial window
+            except Exception:
+                pass
         with self._lock:
             httpd, self._httpd = self._httpd, None
             thread, self._http_thread = self._http_thread, None
@@ -503,6 +520,13 @@ class ReplicaServer:
                         # cost families append AFTER the server's stable
                         # series so existing scrape offsets never shift
                         text += costs.render_prometheus()
+                    # quality families (uncertainty quantiles + drift
+                    # scores) append after costs, same stable-offset rule
+                    scorer = getattr(replica.server, "scorer", None)
+                    if scorer is not None:
+                        text += scorer.render_prometheus()
+                    if replica.drift is not None:
+                        text += replica.drift.render_prometheus()
                     self._reply(200, text.encode(), "text/plain")
                 else:
                     self._reply(404, b"not found\n", "text/plain")
@@ -590,6 +614,9 @@ class ReplicaServer:
             graph = decode_graph(payload["graph"])
         except (KeyError, ValueError, TypeError):
             return _out(400, {"error": "malformed graph payload"}, {})
+        # input-distribution-shift injection (drift-detector testing):
+        # scales THIS replica's decoded copy only
+        graph = faults.shift_inputs(graph, ordinal)
         deadline_s = payload.get("deadline_s")
         tenant = payload.get("tenant")
         try:
@@ -647,21 +674,35 @@ class ReplicaServer:
                 np.full(np.shape(np.asarray(h)), np.nan, np.float32)
                 for h in heads
             ]
-        return _out(
-            200,
-            {
-                "heads": [np.asarray(h).tolist() for h in heads],
-                "version": fut.version,
-                # which packed model answered: the cross-tenant isolation
-                # proof reads this (a tenant's responses must ALL carry
-                # its own model), and the router's cache keys put() on it
-                "model": fut.model_name,
-                "tenant": tenant,
-                "batch_seq": fut.batch_seq,
-                "replica": self.replica_id,
-            },
-            {},
-        )
+        # model-quality observatory: fold this request into the drift
+        # sketches and offer interesting graphs to the feedback sink.
+        # Both hooks are advisory — a broken detector must never turn a
+        # successful prediction into an error response.
+        unc = getattr(fut, "uncertainty", None)
+        drifted = False
+        if self.drift is not None:
+            try:
+                drifted = self.drift.observe(
+                    tenant, graph=graph, heads=heads, uncertainty=unc
+                )
+            except Exception:
+                drifted = False
+        if self.sink is not None:
+            self.sink.offer(graph, uncertainty=unc, drifted=drifted)
+        body = {
+            "heads": [np.asarray(h).tolist() for h in heads],
+            "version": fut.version,
+            # which packed model answered: the cross-tenant isolation
+            # proof reads this (a tenant's responses must ALL carry
+            # its own model), and the router's cache keys put() on it
+            "model": fut.model_name,
+            "tenant": tenant,
+            "batch_seq": fut.batch_seq,
+            "replica": self.replica_id,
+        }
+        if unc is not None:
+            body["uncertainty"] = [float(v) for v in unc]
+        return _out(200, body, {})
 
     def health(self) -> Dict:
         h = self.server.health()
@@ -1661,10 +1702,15 @@ def build_server_from_spec(spec: Dict):
     from hydragnn_tpu.serve.cache import ResponseCache
 
     cache = ResponseCache.from_env(spec.get("cache"))
+    from hydragnn_tpu.serve.quality import UncertaintyScorer
+
+    # opt-in K-sample uncertainty path (HYDRAGNN_UNC_SAMPLES=0 → None,
+    # zero scoring programs compiled, steady state unchanged)
+    scorer = UncertaintyScorer.from_env(registry)
     server_kw = dict(spec.get("server", {}))
     server = InferenceServer(
         registry, plan, default_model=name, tenants=tenants,
-        cache=cache, **server_kw
+        cache=cache, scorer=scorer, **server_kw
     )
     return server, spec.get("arch"), name
 
@@ -1702,6 +1748,36 @@ def replica_main(spec_path: str) -> int:
         # no promote watcher
         role=CANARY if os.getenv("HYDRAGNN_FLEET_CANARY") else REPLICA,
     )
+    # model-quality observatory: drift detector with version-pinned
+    # reference windows (snapshotted in the coord dir so promote and
+    # rollback can never alias baselines) plus the feedback sink; both
+    # are env-gated and None when their knobs are unset
+    from hydragnn_tpu.obs.drift import DriftDetector
+    from hydragnn_tpu.serve.quality import FeedbackSink
+
+    # reference snapshots and feedback packs are PER-PROCESS state
+    # (DriftDetector persists drift-ref-v<N>.json on bootstrap/promote,
+    # FeedbackSink's pack ranks count from 0), so each replica gets its
+    # own subdir — two replicas sharing one path would overwrite each
+    # other's reference file / shard.00000.gpk
+    drift = DriftDetector.from_env(
+        os.path.join(coord_dir, f"drift-replica{rid}"),
+        emit=cost_events.emit,
+    )
+    replica.drift = drift
+    sink = FeedbackSink.from_env(emit=cost_events.emit)
+    if sink is not None:
+        sink.queue_dir = os.path.join(
+            sink.queue_dir, f"replica{rid}"
+        )
+    replica.sink = sink
+    if drift is not None:
+        # promote/rollback re-pins the reference to the activated
+        # version; the initial call adopts (or loads) v_active's window
+        server.registry.add_activation_listener(
+            lambda _name, version: drift.on_activate(version)
+        )
+        drift.on_activate(server.registry.active_version(name))
     replica.serve_forever()
     return 0
 

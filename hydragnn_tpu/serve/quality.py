@@ -1,0 +1,424 @@
+"""Per-request uncertainty scoring + the labeled-on-demand feedback sink.
+
+The serving half of the model-quality observatory (``obs/drift.py`` is
+the scoring half):
+
+- :class:`UncertaintyScorer` — an OPT-IN K-sample scoring path producing
+  per-head predictive variance for every dispatched batch. Two modes,
+  both the standard recipes: ``dropout`` (MC dropout, Gal & Ghahramani
+  2016: K forward passes under K fixed PRNG dropout keys — models
+  without dropout layers honestly report zero variance) and ``ensemble``
+  (deep-ensemble style, Lakshminarayanan et al. 2017: one pass per
+  registered version of the model, up to the last K). Each (model
+  version, bucket) gets ONE extra compiled program with a leading sample
+  axis — warmed at startup/promote exactly like the predict program, so
+  steady state stays recompile-free and the compile counter keeps being
+  the regression alarm.
+- :class:`FeedbackSink` — high-uncertainty / drifted request graphs,
+  deduplicated by ``canonical_graph_key`` (permutation-stable, so the
+  same molecule re-sent with shuffled atoms cannot enqueue twice),
+  buffered and flushed as bounded shard_store packs under a queue dir.
+  The queue dir is a valid ``ShardStoreSource``/``ShardDataset`` input:
+  the next active-learning PR points a ``WeightedMix`` at it and trains.
+"""
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MODES = ("dropout", "ensemble")
+
+
+class UncertaintyScorer:
+    """K-sample predictive-variance scoring over the serving registry.
+
+    ``dispatch(entry, dev_batch)`` runs the (cached, jitted) scoring
+    program for the entry and returns one variance array per head,
+    shaped exactly like the predict program's outputs — the server
+    slices them per request with the same coordinates. ``signature``
+    keys the server's seen-shapes accounting so a scorer compile is
+    counted (and warmed) like any other bucket program.
+    """
+
+    def __init__(
+        self,
+        mode: str = "dropout",
+        samples: int = 4,
+        seed: int = 0,
+        registry=None,
+        metrics=None,
+    ):
+        if mode not in MODES:
+            raise ValueError(
+                f"HYDRAGNN_UNC_MODE must be one of {MODES}, got {mode!r}"
+            )
+        if samples < 2:
+            raise ValueError(
+                f"uncertainty scoring needs samples >= 2, got {samples}"
+            )
+        from hydragnn_tpu.obs.metrics import MetricsRegistry
+
+        self.mode = mode
+        self.samples = int(samples)
+        self.seed = int(seed)
+        self.registry = registry
+        self.metrics = metrics or MetricsRegistry("hydragnn")
+        self.metrics.labeled_gauge(
+            "uncertainty",
+            "per-tenant/bucket/head predictive-variance quantiles",
+        )
+        self._fns: Dict[Tuple, object] = {}
+        self._stacked: Dict[Tuple, Tuple] = {}
+        self._lock = threading.Lock()
+        self._quant: Dict[Tuple, Dict] = {}
+        self.scored = 0
+
+    # ---- compiled scoring programs -------------------------------------
+    def signature(self, entry) -> Tuple:
+        """Extra shape-accounting key: the scoring program recompiles
+        when (and only when) its member set changes — for dropout never,
+        for ensemble on promote (which re-warms anyway)."""
+        if self.mode == "ensemble":
+            return ("score", "ensemble", entry.name,
+                    self._member_versions(entry))
+        return ("score", "dropout", entry.key, self.samples, self.seed)
+
+    def dispatch(self, entry, dev_batch):
+        """Per-head predictive variance for one packed batch (device
+        arrays; the caller device_gets alongside the predict outputs)."""
+        if self.mode == "ensemble":
+            fn, stacked_params, stacked_bs = self._ensemble_fn(entry)
+            return fn(stacked_params, stacked_bs, dev_batch)
+        fn = self._dropout_fn(entry)
+        return fn(entry.params, entry.batch_stats, dev_batch)
+
+    def _dropout_fn(self, entry):
+        key = ("dropout", entry.key)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from hydragnn_tpu.obs.introspect import instrument
+        from hydragnn_tpu.parallel.mesh import jit_replicated
+
+        model = entry.model
+        k, seed = self.samples, self.seed
+
+        def _apply(params, batch_stats, batch):
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+
+            def one(rng):
+                # train=True activates the dropout masks; BatchNorm's
+                # batch-stats mutation is computed and DISCARDED — the
+                # served running averages never move
+                out, _ = model.apply(
+                    variables, batch, train=True,
+                    rngs={"dropout": rng}, mutable=["batch_stats"],
+                )
+                return out
+
+            # fixed keys: same sample set every dispatch, so the scored
+            # variance is a deterministic function of the input (and the
+            # program never sees a novel shape after warmup)
+            keys = jax.random.split(jax.random.PRNGKey(seed), k)
+            outs = jax.vmap(one)(keys)
+            return tuple(jnp.var(o, axis=0) for o in outs)
+
+        fn = instrument(
+            f"serve_score:{entry.name}:v{entry.version}",
+            jit_replicated(_apply),
+        )
+        self._fns[key] = fn
+        return fn
+
+    def _member_versions(self, entry) -> Tuple[int, ...]:
+        """The ensemble member set: the entry's version plus up to K-1
+        predecessors still registered (entries are never removed, so
+        every promoted version remains available)."""
+        versions = [entry.version]
+        if self.registry is not None:
+            v = entry.version - 1
+            while len(versions) < self.samples and v >= 1:
+                try:
+                    self.registry.get(entry.name, v)
+                except KeyError:
+                    break
+                versions.append(v)
+                v -= 1
+        return tuple(sorted(versions))
+
+    def _ensemble_fn(self, entry):
+        versions = self._member_versions(entry)
+        key = ("ensemble", entry.name, versions)
+        cached = self._stacked.get(key)
+        if cached is None:
+            import jax
+
+            members = [
+                self.registry.get(entry.name, v) if self.registry
+                else entry
+                for v in versions
+            ]
+            stacked_params = jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[m.params for m in members],
+            )
+            has_bs = bool(members[0].batch_stats)
+            stacked_bs = (
+                jax.tree_util.tree_map(
+                    lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                    *[m.batch_stats for m in members],
+                )
+                if has_bs
+                else {}
+            )
+            cached = (stacked_params, stacked_bs)
+            self._stacked[key] = cached
+        fn = self._fns.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            from hydragnn_tpu.obs.introspect import instrument
+            from hydragnn_tpu.parallel.mesh import jit_replicated
+
+            model = entry.model
+            has_bs = bool(cached[1])
+
+            def _apply(stacked_params, stacked_bs, batch):
+                def one(params, batch_stats):
+                    variables = {"params": params}
+                    if has_bs:
+                        variables["batch_stats"] = batch_stats
+                    return model.apply(variables, batch, train=False)
+
+                outs = jax.vmap(one)(stacked_params, stacked_bs)
+                return tuple(jnp.var(o, axis=0) for o in outs)
+
+            fn = instrument(
+                f"serve_score:{entry.name}:"
+                f"ens{'-'.join(str(v) for v in versions)}",
+                jit_replicated(_apply),
+            )
+            self._fns[key] = fn
+        return fn, cached[0], cached[1]
+
+    # ---- per-tenant/bucket histograms ----------------------------------
+    def observe(self, tenant, bucket, head_vars: List[float]):
+        """Fold one request's per-head variance scalars into the
+        per-(tenant, bucket, head) quantile sketches + gauges."""
+        from hydragnn_tpu.obs.drift import P2Quantile
+
+        with self._lock:
+            self.scored += 1
+            for ihead, v in enumerate(head_vars):
+                if v is None or not math.isfinite(float(v)):
+                    continue
+                key = (tenant or "-", int(bucket), ihead)
+                qs = self._quant.get(key)
+                if qs is None:
+                    qs = self._quant[key] = {
+                        "p50": P2Quantile(0.5),
+                        "p90": P2Quantile(0.9),
+                        "p99": P2Quantile(0.99),
+                    }
+                for name, sk in qs.items():
+                    sk.add(float(v))
+                    val = sk.value()
+                    if val is not None:
+                        self.metrics.set_labeled(
+                            "uncertainty", val,
+                            tenant=key[0], bucket=key[1],
+                            head=ihead, q=name,
+                        )
+
+    def stats(self) -> Dict:
+        with self._lock:
+            quantiles = {
+                f"{t}|{b}|{h}": {
+                    name: sk.value() for name, sk in qs.items()
+                }
+                for (t, b, h), qs in sorted(self._quant.items())
+            }
+            return {
+                "mode": self.mode,
+                "samples": self.samples,
+                "scored": self.scored,
+                "quantiles": quantiles,
+            }
+
+    def render_prometheus(self) -> str:
+        return self.metrics.render_prometheus()
+
+    @classmethod
+    def from_env(cls, registry=None) -> Optional["UncertaintyScorer"]:
+        """``HYDRAGNN_UNC_SAMPLES`` >= 2 enables scoring (default 0 =
+        off); ``HYDRAGNN_UNC_MODE`` picks the recipe,
+        ``HYDRAGNN_UNC_SEED`` the dropout sample keys. All parsed via
+        ``utils/envparse`` so a bad value names its variable."""
+        import os
+
+        from hydragnn_tpu.utils.envparse import env_int
+
+        samples = env_int("HYDRAGNN_UNC_SAMPLES", 0)
+        if samples == 0:
+            return None
+        if samples < 2:
+            raise ValueError(
+                "HYDRAGNN_UNC_SAMPLES must be 0 (off) or >= 2 "
+                f"(K scoring samples), got {samples}"
+            )
+        mode = os.getenv("HYDRAGNN_UNC_MODE", "dropout")
+        return cls(
+            mode=mode,
+            samples=samples,
+            seed=env_int("HYDRAGNN_UNC_SEED", 0),
+            registry=registry,
+        )
+
+
+class FeedbackSink:
+    """Dedup + bound + persist the graphs worth labeling.
+
+    ``offer`` admits a graph when the request was drifted (detector
+    alert active) or its max per-head predictive variance clears
+    ``min_unc``; admitted graphs dedup by ``canonical_graph_key`` (an
+    LRU seen-set, so permuted duplicates of the same graph never enqueue
+    twice), buffer up to ``max_graphs`` and flush as one shard_store
+    pack (``shard.<packs:05d>.gpk``) under ``queue_dir`` — which is then
+    directly consumable by ``ShardStoreSource``/``ShardDataset``. At
+    most ``max_packs`` packs are ever written (bounded disk), after
+    which offers count as dropped.
+    """
+
+    def __init__(
+        self,
+        queue_dir: str,
+        *,
+        max_graphs: int = 256,
+        max_packs: int = 16,
+        min_unc: float = 0.0,
+        emit=None,
+    ):
+        self.queue_dir = queue_dir
+        self.max_graphs = max(int(max_graphs), 1)
+        self.max_packs = max(int(max_packs), 1)
+        self.min_unc = float(min_unc)
+        self.emit = emit
+        self._lock = threading.Lock()
+        self._buf: List = []
+        self._seen: "dict" = {}  # canonical key -> True, LRU-bounded
+        self._seen_cap = max(4 * self.max_graphs, 1024)
+        self.offered = 0
+        self.accepted = 0
+        self.deduped = 0
+        self.dropped = 0
+        self.graphs = 0  # persisted
+        self.packs = 0
+        self._next_rank = 0  # reserved under the lock: concurrent
+        # flushes must never write the same shard.<rank>.gpk
+
+    def offer(self, graph, uncertainty=None, drifted: bool = False) -> bool:
+        """Consider one served graph; returns True when it was buffered
+        for labeling. Never raises into the request path."""
+        try:
+            return self._offer(graph, uncertainty, drifted)
+        except Exception:
+            return False
+
+    def _offer(self, graph, uncertainty, drifted) -> bool:
+        admit = bool(drifted)
+        if not admit and uncertainty is not None:
+            finite = [
+                float(v) for v in uncertainty
+                if v is not None and math.isfinite(float(v))
+            ]
+            admit = bool(finite) and max(finite) >= self.min_unc
+        with self._lock:
+            self.offered += 1
+            if not admit:
+                return False
+            from hydragnn_tpu.serve.cache import canonical_graph_key
+
+            key = canonical_graph_key(graph)
+            if key in self._seen:
+                self._seen.pop(key)
+                self._seen[key] = True  # refresh LRU position
+                self.deduped += 1
+                return False
+            if self.packs >= self.max_packs:
+                self.dropped += 1
+                return False
+            self._seen[key] = True
+            while len(self._seen) > self._seen_cap:
+                self._seen.pop(next(iter(self._seen)))
+            self._buf.append(graph.clone())
+            self.accepted += 1
+            flush = len(self._buf) >= self.max_graphs
+        if flush:
+            self.flush()
+        return True
+
+    def flush(self):
+        """Persist the buffered graphs as one pack (tmp + rename via
+        ShardWriter, so a reader never sees a torn pack)."""
+        with self._lock:
+            if not self._buf or self._next_rank >= self.max_packs:
+                return
+            buf, self._buf = self._buf, []
+            rank = self._next_rank
+            self._next_rank += 1
+        from hydragnn_tpu.data.shard_store import ShardWriter
+
+        writer = ShardWriter(self.queue_dir, rank=rank)
+        writer.add(buf)
+        writer.save()
+        with self._lock:
+            self.packs += 1
+            self.graphs += len(buf)
+        if self.emit is not None:
+            self.emit("feedback_sink", **self.stats())
+
+    def close(self):
+        self.flush()
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "offered": self.offered,
+                "accepted": self.accepted,
+                "deduped": self.deduped,
+                "dropped": self.dropped,
+                "graphs": self.graphs,
+                "packs": self.packs,
+                "buffered": len(self._buf),
+            }
+
+    @classmethod
+    def from_env(cls, emit=None) -> Optional["FeedbackSink"]:
+        """``HYDRAGNN_FEEDBACK_DIR`` (unset = sink off) + bounded-size
+        knobs, all via ``utils/envparse``."""
+        import os
+
+        from hydragnn_tpu.utils.envparse import env_float, env_int
+
+        queue_dir = os.getenv("HYDRAGNN_FEEDBACK_DIR")
+        if not queue_dir:
+            return None
+        return cls(
+            queue_dir,
+            max_graphs=env_int(
+                "HYDRAGNN_FEEDBACK_MAX_GRAPHS", 256, minimum=1
+            ),
+            max_packs=env_int(
+                "HYDRAGNN_FEEDBACK_MAX_PACKS", 16, minimum=1
+            ),
+            min_unc=env_float("HYDRAGNN_FEEDBACK_MIN_UNC", 0.0),
+            emit=emit,
+        )

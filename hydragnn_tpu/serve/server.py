@@ -79,6 +79,10 @@ class ServeFuture:
         self.version: Optional[int] = None
         self.model_name: Optional[str] = None
         self.batch_seq: Optional[int] = None
+        # per-head predictive-variance scalars when the server scores
+        # uncertainty (serve/quality.py); None otherwise (incl. cache
+        # hits — a cached answer re-used no device samples)
+        self.uncertainty: Optional[List[float]] = None
         self._on_done = None
 
     def done(self) -> bool:
@@ -170,6 +174,7 @@ class InferenceServer:
         tenants=None,
         cache=None,
         costs=None,
+        scorer=None,
     ):
         self.registry = registry
         self.plan = plan
@@ -197,6 +202,11 @@ class InferenceServer:
         # device time + compiled FLOPs attributed to its tenant, with
         # the cost->quota feedback tick riding the batcher loop
         self.costs = costs
+        # uncertainty scorer (serve/quality.py UncertaintyScorer): when
+        # set, every dispatched batch also runs the K-sample scoring
+        # program — warmed per bucket like the predict program, so the
+        # zero-steady-state-recompiles contract covers it too
+        self.scorer = scorer
         self._shape_flops: Dict[Tuple, float] = {}
         self._last_flops = 0.0  # batcher-thread-only scratch
         self._queue: "queue.Queue[_Request]" = queue.Queue(
@@ -348,6 +358,8 @@ class InferenceServer:
         for b in range(self.plan.num_buckets):
             batch, _ = self.plan.pack([sample], b)
             self._dispatch_compiled(entry, b, batch)
+            if self.scorer is not None:
+                self._dispatch_scored(entry, batch)
 
     def _warmup_sample(self):
         sample = self.plan.warmup_sample
@@ -370,7 +382,9 @@ class InferenceServer:
         pass, interleaving with live traffic — the zero-downtime half of
         a hot-swap promote. Returns per-pass compile-counter deltas so
         the caller can verify the warm took: pass 1 must compile exactly
-        ``num_buckets`` novel shapes, every later pass ZERO (a non-zero
+        ``num_buckets`` novel shapes (times two with an uncertainty
+        scorer — its per-bucket scoring program warms in the same
+        dispatch), every later pass ZERO (a non-zero
         later pass means the candidate's executables did not cache — a
         promote gated on this never swaps onto a version that would
         recompile under traffic). Requires a started server."""
@@ -390,12 +404,13 @@ class InferenceServer:
             for fut in futures:
                 fut.result(timeout)  # dispatch errors propagate loudly
             deltas.append(self.metrics.compiles_total - before)
+        per_bucket = 1 if self.scorer is None else 2
         return {
             "buckets": self.plan.num_buckets,
             "first_pass_compiles": deltas[0],
             "later_pass_compiles": sum(deltas[1:]),
             "verified": (
-                deltas[0] == self.plan.num_buckets
+                deltas[0] == self.plan.num_buckets * per_bucket
                 and sum(deltas[1:]) == 0
             ),
         }
@@ -714,6 +729,17 @@ class InferenceServer:
             outputs = [
                 np.asarray(o) for o in jax.device_get(list(outputs))
             ]
+            variances = None
+            if self.scorer is not None:
+                # scoring is advisory: a scorer failure degrades the
+                # batch to unscored responses, never to errors
+                try:
+                    v = self._dispatch_scored(entry, batch)
+                    variances = [
+                        np.asarray(a) for a in jax.device_get(list(v))
+                    ]
+                except Exception:
+                    variances = None
         except Exception as e:  # fail the batch, keep the server alive
             self.metrics.on_error(len(requests))
             for req in requests:
@@ -756,6 +782,21 @@ class InferenceServer:
                     per_head.append(outputs[ihead][g])
                 else:
                     per_head.append(outputs[ihead][off: off + n])
+            if variances is not None:
+                unc = []
+                for ihead, kind in enumerate(entry.output_type):
+                    arr = (
+                        variances[ihead][g]
+                        if kind == "graph"
+                        else variances[ihead][off: off + n]
+                    )
+                    # `variances` was device_get + np.asarray'd above —
+                    # this mean runs on host memory, not a device sync
+                    unc.append(
+                        float(np.mean(arr)) if arr.size else 0.0  # jaxlint: disable=host-sync-in-hot-loop
+                    )
+                req.future.uncertainty = unc
+                self.scorer.observe(req.tenant, bucket, unc)
             # stamped before resolution: a waiter that wakes on
             # set_result reads a consistent (version, batch) pair
             req.future.version = entry.version
@@ -850,6 +891,26 @@ class InferenceServer:
             self._last_flops = self._shape_flops.get(shape_key, 0.0)
         return out
 
+    def _dispatch_scored(self, entry: ModelEntry, batch):
+        """Run the bucket's K-sample uncertainty program with the SAME
+        seen-shapes/compile accounting as the predict program: warmup
+        sees every (scorer signature, shape) once, so the scoring path
+        is held to the zero-steady-state-recompiles contract too."""
+        import jax
+
+        shape_key = (
+            self.scorer.signature(entry),
+            tuple(
+                (tuple(a.shape), str(getattr(a, "dtype", type(a))))
+                for a in jax.tree_util.tree_leaves(batch)
+            ),
+        )
+        if shape_key not in self._seen_shapes:
+            self._seen_shapes.add(shape_key)
+            self.metrics.on_compile()
+        dev_batch = jax.tree_util.tree_map(np.asarray, batch)
+        return self.scorer.dispatch(entry, dev_batch)
+
     def _captured_flops(self, entry: ModelEntry, dev_batch) -> float:
         """This bucket's compiled per-dispatch FLOPs from introspect's
         capture record (0 when introspection is off or the backend has
@@ -911,4 +972,6 @@ class InferenceServer:
             out["cache"] = self.cache.stats()
         if self.costs is not None:
             out["costs"] = self.costs.bill()
+        if self.scorer is not None:
+            out["quality"] = self.scorer.stats()
         return out

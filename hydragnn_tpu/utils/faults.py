@@ -62,6 +62,13 @@ Serving-side knobs (consumed by ``serve/fleet.py`` replicas and
   covered by ``SPEC`` (NAN_AT_STEP grammar; ``SECONDS`` defaults to
   0.25). Canary-only for the same reason: exercises the per-bucket
   latency-regression gate without touching live SLOs.
+- ``HYDRAGNN_FAULT_SHIFT_INPUTS=SPEC@SCALE`` — multiply the node
+  features (and positions) of each decoded request graph whose 0-based
+  ordinal is covered by ``SPEC`` by ``SCALE`` (default 3.0). The
+  input-distribution-shift injection: exercises the drift detector's
+  window scoring + alert hysteresis (``obs/drift.py``) without the
+  load generator having to craft shifted traffic. Gated per replica
+  via ``HYDRAGNN_FAULT_SHIFT_REPLICA`` (unset = every replica).
 
 Counters are process-global and monotonic; :func:`reset` exists for tests
 that exercise several scenarios in one process.
@@ -213,6 +220,32 @@ def slow_replica(request_ordinal: int) -> None:
         return
     if _parse_step_spec(step_spec)(int(request_ordinal)):
         time.sleep(float(secs) if secs else 0.25)
+
+
+def shift_inputs(graph, request_ordinal: int):
+    """Input-drift injection: scale a covered request graph's node
+    features (and positions, when present) in place. Spec is
+    ``"SPEC@SCALE"`` (``"200:@3.0"`` shifts every request from ordinal
+    200 on by 3x); ``SCALE`` defaults to 3.0. The caller passes its own
+    DECODED copy of the request — the client's payload is untouched.
+    ``HYDRAGNN_FAULT_SHIFT_REPLICA=K`` restricts the shift to replica
+    ``K`` (unset shifts every replica that sees a covered ordinal)."""
+    spec = os.getenv("HYDRAGNN_FAULT_SHIFT_INPUTS")
+    if spec is None:
+        return graph
+    replica_s = os.getenv("HYDRAGNN_FAULT_SHIFT_REPLICA")
+    if replica_s is not None and replica_s.strip() != "":
+        if _this_replica() != int(replica_s):
+            return graph
+    member, _, scale_s = spec.partition("@")
+    if not _parse_step_spec(member)(int(request_ordinal)):
+        return graph
+    scale = float(scale_s) if scale_s else 3.0
+    if getattr(graph, "x", None) is not None:
+        graph.x = graph.x * scale
+    if getattr(graph, "pos", None) is not None:
+        graph.pos = graph.pos * scale
+    return graph
 
 
 def nan_candidate(request_ordinal: int) -> bool:

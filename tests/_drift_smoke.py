@@ -1,0 +1,411 @@
+"""CI model-quality observatory smoke (standalone, NOT a pytest module).
+
+The drift-detection twin of ``tests/_fleet_smoke.py``: a two-replica
+:class:`ServingFleet` with the full quality stack armed — K-sample
+uncertainty scoring (``HYDRAGNN_UNC_SAMPLES``), streaming drift
+detection against a version-pinned reference (``HYDRAGNN_DRIFT_*``) and
+the labeled-on-demand feedback sink (``HYDRAGNN_FEEDBACK_*``) — under
+closed-loop two-tenant load:
+
+1. quiet phase: bounded request count, every response must carry a
+   finite per-head ``uncertainty`` vector, and the detector must close
+   at least one SCORED window with ZERO alerts (no flapping — the
+   thresholds sit above the measured finite-window noise floor),
+2. shift phase: ``HYDRAGNN_FAULT_SHIFT_INPUTS`` scales every request
+   graph 6x once a replica's request ordinal crosses the spec, and the
+   smoke hammers until a schema-valid ``drift_alert`` raises — on a
+   shift-affected feature only (an alert on ``num_nodes`` /
+   ``num_edges`` / ``unc`` would be a false positive),
+3. the compile counter scraped from every replica's ``/metrics`` must
+   not move between quiet steady state and the end of the run (the
+   scoring program is warmed like every bucket program — a drifted
+   input is a VALUE change, never a shape change),
+4. after shutdown the feedback queue must hold deduped packs of the
+   SHIFTED graphs (admission here is drifted-only: ``MIN_UNC`` is set
+   above GIN's honest zero dropout variance), each bitwise identical to
+   a client-side reconstruction and readable back through
+   ``ShardStoreSource`` into a ``WeightedMix``,
+5. every per-replica event stream validates against the documented
+   schema and ``python -m hydragnn_tpu.obs drift`` renders the run.
+
+Usage: python tests/_drift_smoke.py <workdir>
+"""
+
+import glob
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TENANTS = ("acme", "beta")
+NUM_WORKERS = 4
+REQUEST_DEADLINE_S = 30.0
+
+# the quiet phase is a BOUNDED request count kept strictly below the
+# fault spec's ordinal, so no quiet request can be shifted even if the
+# router sent every single one to the same replica
+QUIET_REQUESTS = 1400
+SHIFT_AT = 2000
+SHIFT_SCALE = 6.0
+
+# detector window 256 with two tenants -> ~128 samples per (tenant,
+# feature) key per window; the measured worst-case same-distribution
+# noise over a fixed 32-graph pool at that count is PSI ~0.40 / KS
+# ~0.23, while the 6x input scale scores PSI > 3 / KS > 0.8 — the
+# thresholds sit between with >2x margin on both sides
+KNOBS = {
+    "HYDRAGNN_UNC_SAMPLES": "3",
+    "HYDRAGNN_DRIFT_WINDOW": "256",
+    "HYDRAGNN_DRIFT_PSI": "0.9",
+    "HYDRAGNN_DRIFT_KS": "0.5",
+    "HYDRAGNN_DRIFT_RAISE": "2",
+    "HYDRAGNN_DRIFT_CLEAR": "2",
+    "HYDRAGNN_FEEDBACK_MAX_GRAPHS": "8",
+    "HYDRAGNN_FEEDBACK_MAX_PACKS": "4",
+    # above the GIN stack's honest zero dropout variance: the sink may
+    # admit through the DRIFTED path only, so it must stay empty until
+    # an alert is active and then fill with shifted graphs exclusively
+    "HYDRAGNN_FEEDBACK_MIN_UNC": "0.5",
+    "HYDRAGNN_FAULT_SHIFT_INPUTS": f"{SHIFT_AT}:@{SHIFT_SCALE}",
+}
+
+DETECT_DEADLINE_S = 300.0
+HAMMER_CAP = 16000
+POST_DETECT_REQUESTS = 600
+
+# the only feature streams the 6x input scale moves — species is x[:, 0],
+# edge_len follows pos, pred follows the model outputs; num_nodes /
+# num_edges / unc are shift-invariant so an alert there is flapping
+SHIFTED_FEATURES = {"species", "edge_len", "pred"}
+
+
+def blast(router, samples, n, seed0):
+    """Send ``n`` requests from ``NUM_WORKERS`` closed-loop clients,
+    tenants interleaved; returns (ok, failed, bad_uncertainty)."""
+    import numpy as np
+
+    counts = [n // NUM_WORKERS] * NUM_WORKERS
+    for i in range(n % NUM_WORKERS):
+        counts[i] += 1
+    ok = [0] * NUM_WORKERS
+    failed = [0] * NUM_WORKERS
+    bad_unc = [0] * NUM_WORKERS
+
+    def worker(w):
+        rng = np.random.default_rng(seed0 + w)
+        for j in range(counts[w]):
+            g = samples[int(rng.integers(0, len(samples)))]
+            tenant = TENANTS[(w + j) % len(TENANTS)]
+            try:
+                body = router.route(
+                    g, deadline_s=REQUEST_DEADLINE_S, raw=True,
+                    tenant=tenant,
+                )
+            except Exception:
+                failed[w] += 1
+                continue
+            unc = body.get("uncertainty")
+            if (
+                isinstance(unc, list)
+                and len(unc) == 2
+                and all(
+                    v is not None
+                    and math.isfinite(float(v))
+                    and float(v) >= 0.0
+                    for v in unc
+                )
+            ):
+                ok[w] += 1
+            else:
+                bad_unc[w] += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(NUM_WORKERS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(ok), sum(failed), sum(bad_unc)
+
+
+def quality_events(coord_dir):
+    from hydragnn_tpu.obs.drift import load_quality_events
+
+    return load_quality_events(coord_dir)
+
+
+def raised_alerts(records, since=None):
+    out = []
+    for r in records:
+        if r.get("event") != "drift_alert" or r.get("status") != "raised":
+            continue
+        if since is not None and float(r.get("ts") or 0.0) < since:
+            continue
+        out.append(r)
+    return out
+
+
+def scrape_compiles(coord_dir):
+    """``hydragnn_serve_compiles_total`` per live replica, scraped off
+    each replica's ``/metrics`` (port from its heartbeat lease)."""
+    out = {}
+    for lease in sorted(
+        glob.glob(os.path.join(coord_dir, "replicas", "replica-*.json"))
+    ):
+        try:
+            with open(lease) as f:
+                info = json.load(f)
+            port = int(info["port"])
+            text = (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                )
+                .read()
+                .decode()
+            )
+        except Exception:
+            continue
+        value = None
+        for line in text.splitlines():
+            if line.startswith("hydragnn_serve_compiles_total "):
+                value = float(line.split()[-1])
+        out[os.path.basename(lease)] = (value, text)
+    return out
+
+
+def shifted_lookup(samples):
+    """canonical key -> the exact shifted graph every replica-side
+    ``shift_inputs`` call must have produced (same numpy, same op, same
+    float32 inputs after the JSON round-trip -> bitwise identical)."""
+    from hydragnn_tpu.serve.cache import canonical_graph_key
+
+    out = {}
+    for g in samples:
+        s = g.clone()
+        s.x = s.x * SHIFT_SCALE
+        s.pos = s.pos * SHIFT_SCALE
+        out[canonical_graph_key(s)] = s
+    return out
+
+
+def main(workdir):
+    os.makedirs(workdir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    feedback_dir = os.path.join(workdir, "feedback")
+    knobs = dict(KNOBS, HYDRAGNN_FEEDBACK_DIR=feedback_dir)
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        run(workdir, feedback_dir)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run(workdir, feedback_dir):
+    from _fleet_smoke import build_artifacts
+
+    from hydragnn_tpu.data.stream.mix import WeightedMix
+    from hydragnn_tpu.data.stream.source import ShardStoreSource
+    from hydragnn_tpu.obs.__main__ import main as obs_main
+    from hydragnn_tpu.obs.events import validate_events
+    from hydragnn_tpu.serve import FleetRouter
+    from hydragnn_tpu.serve.cache import canonical_graph_key
+    from hydragnn_tpu.serve.fleet import ServingFleet
+
+    spec_path, ckdir, samples = build_artifacts(workdir)
+    # declare the two tenants (sharing the default model) — a tenant
+    # label on a request is rejected unless the server has a
+    # TenantManager, and the drift keys are per-tenant
+    with open(spec_path) as f:
+        spec = json.load(f)
+    spec["tenants"] = [{"name": t, "model": "m"} for t in TENANTS]
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    coord_dir = os.path.join(workdir, "coord")
+    fleet = ServingFleet(
+        coord_dir,
+        2,
+        spec_path=spec_path,
+        heartbeat_s=0.1,
+        lease_s=0.75,
+        poll_s=0.05,
+        log_dir=os.path.join(workdir, "log"),
+    )
+    fleet.start(wait_serving=True, timeout=300)
+    detect_s = None
+    hammer_sent = 0
+    try:
+        assert fleet.health()["live"] == 2, fleet.health()
+        router = FleetRouter(
+            coord_dir,
+            lease_s=0.75,
+            scan_interval_s=0.1,
+            max_attempts=6,
+            retry_base_delay_s=0.05,
+        )
+
+        # ---- phase 1: quiet two-tenant traffic --------------------------
+        sent = QUIET_REQUESTS
+        ok, failed, bad_unc = blast(router, samples, QUIET_REQUESTS, 100)
+        assert bad_unc == 0, (
+            f"{bad_unc} responses lacked a finite 2-head uncertainty"
+        )
+        assert ok >= 0.8 * QUIET_REQUESTS, (ok, failed)
+        # a vacuously alert-free quiet phase proves nothing: require at
+        # least one SCORED (non-bootstrap) window before the shift,
+        # topping up in small bounded bites if routing skew delayed it
+        def scored_windows():
+            return sum(
+                1
+                for r in quality_events(coord_dir)
+                if r.get("event") == "drift_window" and r.get("scores")
+            )
+
+        while scored_windows() == 0 and sent + 100 <= SHIFT_AT - 50:
+            ok2, failed2, bad2 = blast(router, samples, 100, 7000 + sent)
+            assert bad2 == 0
+            sent += 100
+        assert scored_windows() >= 1, (
+            f"no scored drift window after {sent} quiet requests"
+        )
+        t_mark = time.time()
+        assert not raised_alerts(quality_events(coord_dir)), (
+            "drift alert raised on QUIET traffic (flapping): "
+            f"{raised_alerts(quality_events(coord_dir))}"
+        )
+
+        # quiet steady state reached: the compile counter must be flat
+        # from here to the end of the run, shift included
+        base = scrape_compiles(coord_dir)
+        assert len(base) == 2, f"scraped {sorted(base)} of 2 replicas"
+        for name, (value, _) in sorted(base.items()):
+            assert value is not None and value > 0, (name, value)
+
+        # ---- phase 2: hammer across the fault-injected shift ------------
+        t_hammer = time.monotonic()
+        detected = None
+        seed = 9000
+        while detected is None:
+            if time.monotonic() - t_hammer > DETECT_DEADLINE_S:
+                break
+            if hammer_sent >= HAMMER_CAP:
+                break
+            ok3, failed3, bad3 = blast(router, samples, 240, seed)
+            assert bad3 == 0
+            hammer_sent += 240
+            seed += NUM_WORKERS
+            hits = raised_alerts(quality_events(coord_dir), since=t_mark)
+            if hits:
+                detected = hits[0]
+        assert detected is not None, (
+            f"no drift_alert raised within {hammer_sent} shifted-phase "
+            f"requests / {DETECT_DEADLINE_S}s"
+        )
+        detect_s = time.monotonic() - t_hammer
+        # keep serving shifted traffic so the (drifted-only) sink
+        # accumulates past the alert on both tenants
+        ok4, failed4, bad4 = blast(
+            router, samples, POST_DETECT_REQUESTS, 31000
+        )
+        assert bad4 == 0
+
+        end = scrape_compiles(coord_dir)
+        assert sorted(end) == sorted(base), (sorted(base), sorted(end))
+        for name, (value, text) in sorted(end.items()):
+            assert value == base[name][0], (
+                f"{name}: compiles moved {base[name][0]} -> {value} "
+                "after warmup (steady state must be recompile-free, "
+                "shift included)"
+            )
+            assert "hydragnn_drift_score" in text, name
+            assert "hydragnn_uncertainty" in text, name
+    finally:
+        fleet.stop()
+
+    # ---- post-mortem: events, alerts, sink, CLI -------------------------
+    streams = sorted(
+        glob.glob(os.path.join(coord_dir, "events-replica*.jsonl"))
+    )
+    assert streams, coord_dir
+    names = set()
+    for stream in streams:
+        records = validate_events(stream)
+        names.update(r["event"] for r in records)
+    for required in ("drift_window", "drift_alert", "feedback_sink"):
+        assert required in names, (required, sorted(names))
+
+    records = quality_events(coord_dir)
+    early = raised_alerts(records, since=None)
+    assert all(float(r.get("ts") or 0.0) >= t_mark for r in early), (
+        f"alert(s) raised on quiet traffic: "
+        f"{[r for r in early if float(r.get('ts') or 0.0) < t_mark]}"
+    )
+    raised = raised_alerts(records, since=t_mark)
+    assert raised
+    for r in raised:
+        assert r.get("feature") in SHIFTED_FEATURES, (
+            f"alert on a shift-invariant feature (false positive): {r}"
+        )
+    windows = sum(1 for r in records if r.get("event") == "drift_window")
+
+    # the sink persisted SHIFTED graphs only, deduped per replica, and
+    # every pack reads back bitwise through ShardStoreSource/WeightedMix
+    expect = shifted_lookup(samples)
+    sink_dirs = [
+        d
+        for d in sorted(glob.glob(os.path.join(feedback_dir, "replica*")))
+        if glob.glob(os.path.join(d, "shard.*.gpk"))
+    ]
+    assert sink_dirs, f"no feedback packs under {feedback_dir}"
+    total_graphs = 0
+    for d in sink_dirs:
+        seen = set()
+        mix = WeightedMix([ShardStoreSource(d)], seed=3)
+        for _, g in mix:
+            key = canonical_graph_key(g)
+            assert key not in seen, f"duplicate graph in {d}"
+            seen.add(key)
+            s = expect.get(key)
+            assert s is not None, (
+                f"sink graph in {d} is not one of the shifted inputs"
+            )
+            assert g.x.tobytes() == s.x.tobytes()
+            assert g.pos.tobytes() == s.pos.tobytes()
+            assert g.edge_index.tobytes() == s.edge_index.tobytes()
+            total_graphs += 1
+        packs = len(glob.glob(os.path.join(d, "shard.*.gpk")))
+        assert packs <= int(KNOBS["HYDRAGNN_FEEDBACK_MAX_PACKS"]), d
+    assert total_graphs >= 1
+
+    # the run renders through the CLI in both formats
+    assert obs_main(["drift", coord_dir]) == 0
+    assert obs_main(["drift", coord_dir, "--format", "json"]) == 0
+
+    print(
+        f"drift smoke OK: windows={windows} "
+        f"alerts_raised={len(raised)} "
+        f"first_alert={detected.get('tenant')}|{detected.get('feature')}"
+        f"|{detected.get('head')} ({detected.get('kind')}="
+        f"{detected.get('score')}) "
+        f"detect_s={detect_s:.1f} hammer_requests={hammer_sent} "
+        f"sink_graphs={total_graphs} sink_dirs={len(sink_dirs)}"
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(__doc__)
+        sys.exit(2)
+    main(sys.argv[1])

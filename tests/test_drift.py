@@ -1,0 +1,667 @@
+"""Model-quality observatory (obs/drift.py + serve/quality.py).
+
+Acceptance: P² quantile estimates stay within a rank-error bound on
+adversarial streams; StreamingHistogram merges are associative (fleet
+rollups and reference snapshots must not depend on merge order); the
+sketch-vs-sketch PSI/KS scores agree with an exact scipy-free reference
+on raw data; the feedback sink dedups permuted duplicates via
+``canonical_graph_key`` and its queue dir round-trips bitwise through
+``ShardStoreSource`` into a ``WeightedMix``; the drift detector's
+hysteresis raises/clears on consecutive windows and pins its reference
+per version (promote snapshots, rollback reloads — never overwrites);
+every ``HYDRAGNN_DRIFT_*``/``HYDRAGNN_UNC_*``/``HYDRAGNN_FEEDBACK_*``
+knob is unit-locked; and the opt-in uncertainty scorer keeps the
+zero-steady-state-recompiles contract (compile-counter-verified).
+"""
+
+import contextlib
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.obs.drift import (
+    DriftDetector,
+    P2Quantile,
+    StreamingHistogram,
+    build_drift_report,
+    graph_features,
+    ks,
+    load_quality_events,
+    psi,
+    render_drift_text,
+)
+from hydragnn_tpu.obs.events import RunEventLog, validate_events
+from hydragnn_tpu.serve import (
+    FeedbackSink,
+    InferenceServer,
+    ModelRegistry,
+    UncertaintyScorer,
+    canonical_graph_key,
+    plan_from_samples,
+)
+from hydragnn_tpu.serve.canary import (
+    CanaryGates,
+    _CandidateStats,
+    evaluate_gates,
+)
+from hydragnn_tpu.train.trainer import Trainer
+
+from test_models_forward import arch_config
+from test_serve import _graph
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    saved = {k: os.environ.get(k) for k in kv}
+    try:
+        for k, v in kv.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---- sketches ------------------------------------------------------------
+
+
+def _p2_estimate(data, q):
+    sk = P2Quantile(q)
+    for v in data:
+        sk.add(float(v))
+    return sk.value()
+
+
+def pytest_p2_quantile_rank_error_bound_on_adversarial_streams():
+    """P² stays within a ±0.12 rank-error band (plus a 2%-of-range
+    slack) even on the classic adversarial orderings: sorted ascending /
+    descending (markers chase a moving front), alternating extremes
+    (bimodal), and a heavy-tailed draw."""
+    rng = np.random.default_rng(11)
+    streams = {
+        "ascending": np.arange(2000, dtype=np.float64),
+        "descending": np.arange(2000, dtype=np.float64)[::-1],
+        "alternating": np.tile([0.0, 100.0], 1000),
+        "heavy_tail": rng.pareto(1.5, 2000),
+        "gaussian": rng.normal(3.0, 2.0, 2000),
+    }
+    for name, data in streams.items():
+        span = float(np.max(data) - np.min(data))
+        for q in (0.5, 0.9):
+            est = _p2_estimate(data, q)
+            lo = float(np.quantile(data, max(q - 0.12, 0.0)))
+            hi = float(np.quantile(data, min(q + 0.12, 1.0)))
+            assert lo - 0.02 * span <= est <= hi + 0.02 * span, (
+                f"{name} q={q}: estimate {est} outside rank band "
+                f"[{lo}, {hi}]"
+            )
+
+
+def pytest_p2_quantile_exact_below_five_samples():
+    sk = P2Quantile(0.5)
+    assert sk.value() is None
+    for v in (5.0, 1.0, 3.0):
+        sk.add(v)
+    assert sk.value() == 3.0  # nearest-rank median of {1, 3, 5}
+
+
+def _hist_of(data, max_bins=48):
+    h = StreamingHistogram(max_bins)
+    for v in data:
+        h.add(float(v))
+    return h
+
+
+def pytest_streaming_histogram_merge_associativity():
+    """(A ⊎ B) ⊎ C and A ⊎ (B ⊎ C) agree: exact same total mass, and
+    quantiles within the sketch's own approximation error of each other
+    AND of the exact concatenated stream — the property that lets fleet
+    rollups and reference snapshots merge in any order."""
+    rng = np.random.default_rng(5)
+    a = rng.normal(0.0, 1.0, 1500)
+    b = rng.normal(5.0, 2.0, 1500)
+    c = rng.exponential(2.0, 1500)
+    concat = np.concatenate([a, b, c])
+    spread = float(np.quantile(concat, 0.99) - np.quantile(concat, 0.01))
+
+    left = _hist_of(a)
+    left.merge(_hist_of(b))
+    left.merge(_hist_of(c))
+    bc = _hist_of(b)
+    bc.merge(_hist_of(c))
+    right = _hist_of(a)
+    right.merge(bc)
+
+    assert left.total == right.total == float(concat.size)
+    assert left.min == right.min == float(np.min(concat))
+    assert left.max == right.max == float(np.max(concat))
+    for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+        ql, qr = left.quantile(q), right.quantile(q)
+        exact = float(np.quantile(concat, q))
+        assert abs(ql - qr) <= 0.05 * spread, (q, ql, qr)
+        assert abs(ql - exact) <= 0.08 * spread, (q, ql, exact)
+
+
+def pytest_streaming_histogram_serialization_roundtrip():
+    rng = np.random.default_rng(6)
+    h = _hist_of(rng.normal(0.0, 1.0, 400), max_bins=16)
+    h2 = StreamingHistogram.from_dict(
+        json.loads(json.dumps(h.to_dict()))
+    )
+    assert h2.total == h.total and h2.bins == h.bins
+    assert h2.quantile(0.5) == h.quantile(0.5)
+
+
+# ---- PSI / KS vs an exact scipy-free reference ---------------------------
+
+
+def _exact_psi(ref, live, bins=10, eps=1e-4):
+    edges = np.quantile(ref, [i / bins for i in range(1, bins)])
+    edges = np.concatenate([[-np.inf], edges, [np.inf]])
+    p = np.histogram(ref, edges)[0] / ref.size
+    q = np.histogram(live, edges)[0] / live.size
+    p = np.maximum(p, eps)
+    q = np.maximum(q, eps)
+    return float(np.sum((p - q) * np.log(p / q)))
+
+
+def _exact_ks(x, y):
+    pts = np.concatenate([x, y])
+    fx = np.searchsorted(np.sort(x), pts, side="right") / x.size
+    fy = np.searchsorted(np.sort(y), pts, side="right") / y.size
+    return float(np.max(np.abs(fx - fy)))
+
+
+def pytest_psi_ks_agree_with_exact_reference():
+    """Sketch-vs-sketch scores track the exact raw-data scores: near
+    zero for same-distribution streams (below the alert thresholds),
+    and matching the exact values for a 1.5-sigma shift (well above)."""
+    rng = np.random.default_rng(17)
+    ref = rng.normal(0.0, 1.0, 4000)
+    same = rng.normal(0.0, 1.0, 4000)
+    shift = rng.normal(1.5, 1.0, 4000)
+    h_ref, h_same, h_shift = _hist_of(ref, 64), _hist_of(same, 64), \
+        _hist_of(shift, 64)
+
+    assert psi(h_ref, h_same) < 0.1   # "stable" rule-of-thumb band
+    assert ks(h_ref, h_same) < 0.08
+    e_psi, e_ks = _exact_psi(ref, shift), _exact_ks(ref, shift)
+    s_psi, s_ks = psi(h_ref, h_shift), ks(h_ref, h_shift)
+    assert abs(s_ks - e_ks) <= 0.05, (s_ks, e_ks)
+    assert abs(s_psi - e_psi) <= 0.05 + 0.25 * e_psi, (s_psi, e_psi)
+    # and both sides agree the shift clears the default thresholds
+    assert s_psi > 0.25 and e_psi > 0.25
+    assert s_ks > 0.35 and e_ks > 0.35
+
+
+def pytest_psi_ks_empty_and_identical_sketches():
+    empty = StreamingHistogram(8)
+    h = _hist_of([1.0, 2.0, 3.0], 8)
+    assert psi(empty, h) == 0.0 and ks(h, empty) == 0.0
+    assert psi(h, h) == pytest.approx(0.0, abs=1e-9)
+    assert ks(h, h) == 0.0
+
+
+# ---- drift detector: hysteresis + version-pinned reference ---------------
+
+
+def _feed(det, values, tenant="acme"):
+    active = False
+    for v in values:
+        active = det.observe(tenant, heads=[np.asarray([v], np.float64)])
+    return active
+
+
+def pytest_drift_detector_hysteresis_raise_and_clear(tmp_path):
+    """Bootstrap window becomes the reference; two consecutive shifted
+    windows raise (not one — no flapping), two clean windows clear.
+    Events land schema-valid in the stream."""
+    log = RunEventLog(str(tmp_path / "events.jsonl"))
+    det = DriftDetector(
+        str(tmp_path), window=64, raise_after=2, clear_after=2,
+        emit=log.emit,
+    )
+    det.on_activate(1)  # nothing to snapshot yet: ref arrives at window 1
+    rng = np.random.default_rng(23)
+    base = rng.normal(0.0, 1.0, 64)  # SAME values every clean window:
+    # identical sketches score exactly 0, so "clean" cannot flake
+
+    assert _feed(det, base) is False          # window 1: bootstrap
+    assert os.path.exists(str(tmp_path / "drift-ref-v1.json"))
+    assert _feed(det, base + 8.0) is False    # window 2: over, 1 < raise_after
+    assert _feed(det, base + 8.0) is True     # window 3: raised
+    assert det.alert_active("acme") and det.alert_active()
+    assert _feed(det, base) is True           # window 4: 1 clean, still active
+    assert _feed(det, base) is False          # window 5: cleared
+    assert not det.alert_active()
+    st = det.stats()
+    assert st["windows_evaluated"] == 5
+    assert st["alerts_raised"] == 1 and st["alerts_cleared"] == 1
+
+    records = validate_events(
+        log.path, require=["drift_window", "drift_alert"]
+    )
+    alerts = [r for r in records if r["event"] == "drift_alert"]
+    assert [a["status"] for a in alerts] == ["raised", "cleared"]
+    assert alerts[0]["tenant"] == "acme" and alerts[0]["version"] == 1
+    # the CLI report folds the same stream back into an empty active set
+    report = build_drift_report(load_quality_events(log.path))
+    assert report["windows"] == 5 and report["alerts_active"] == []
+    assert "model-quality" in render_drift_text(report)
+
+
+def pytest_drift_reference_pinned_per_version(tmp_path):
+    """Promote snapshots a NEW per-version file; rollback to an earlier
+    version RELOADS its frozen file byte-identically — baselines never
+    alias across versions."""
+    det = DriftDetector(str(tmp_path), window=32)
+    det.on_activate(1)
+    rng = np.random.default_rng(29)
+    _feed(det, rng.normal(0.0, 1.0, 32))  # bootstrap ref for v1
+    v1_path = str(tmp_path / "drift-ref-v1.json")
+    v1_bytes = open(v1_path, "rb").read()
+
+    _feed(det, rng.normal(9.0, 1.0, 32))  # candidate-era traffic
+    det.on_activate(2)                    # promote: snapshot fresh traffic
+    v2_path = str(tmp_path / "drift-ref-v2.json")
+    assert os.path.exists(v2_path)
+    assert det.stats()["reference_version"] == 2
+    assert open(v1_path, "rb").read() == v1_bytes  # v1 untouched
+
+    det.on_activate(1)                    # rollback: reload, never re-snapshot
+    assert det.stats()["reference_version"] == 1
+    assert open(v1_path, "rb").read() == v1_bytes
+    ref = json.load(open(v1_path))
+    assert ref["version"] == 1 and ref["sketches"]
+
+
+def pytest_graph_features_shapes():
+    g = _graph(10, np.random.default_rng(3), with_targets=False)
+    feats = graph_features(g)
+    assert feats["num_nodes"] == [10.0]
+    assert feats["num_edges"] == [float(g.num_edges)]
+    assert len(feats["species"]) == 10
+    assert feats["edge_len"] and all(v >= 0.0 for v in feats["edge_len"])
+
+
+# ---- knob unit locks -----------------------------------------------------
+
+
+def pytest_drift_knob_unit_locks(tmp_path):
+    d = str(tmp_path)
+    with _env(HYDRAGNN_DRIFT_WINDOW="0"):
+        assert DriftDetector.from_env(d) is None  # 0 = detection off
+    with _env(HYDRAGNN_DRIFT_WINDOW="banana"):
+        with pytest.raises(ValueError, match="HYDRAGNN_DRIFT_WINDOW"):
+            DriftDetector.from_env(d)
+    with _env(HYDRAGNN_DRIFT_WINDOW="16", HYDRAGNN_DRIFT_RAISE="0"):
+        with pytest.raises(ValueError, match="HYDRAGNN_DRIFT_RAISE"):
+            DriftDetector.from_env(d)
+    with _env(HYDRAGNN_DRIFT_WINDOW="16", HYDRAGNN_DRIFT_PSI="nan"):
+        with pytest.raises(ValueError, match="HYDRAGNN_DRIFT_PSI"):
+            DriftDetector.from_env(d)
+    with _env(HYDRAGNN_DRIFT_WINDOW="16", HYDRAGNN_DRIFT_BINS="4"):
+        with pytest.raises(ValueError, match="HYDRAGNN_DRIFT_BINS"):
+            DriftDetector.from_env(d)
+    with _env(
+        HYDRAGNN_DRIFT_WINDOW="16", HYDRAGNN_DRIFT_PSI="0.1",
+        HYDRAGNN_DRIFT_KS="0.2", HYDRAGNN_DRIFT_RAISE="3",
+        HYDRAGNN_DRIFT_CLEAR="4", HYDRAGNN_DRIFT_BINS="32",
+    ):
+        det = DriftDetector.from_env(d)
+        assert (det.window, det.psi_threshold, det.ks_threshold,
+                det.raise_after, det.clear_after, det.max_bins) == (
+            16, 0.1, 0.2, 3, 4, 32)
+
+
+def pytest_uncertainty_knob_unit_locks():
+    with _env(HYDRAGNN_UNC_SAMPLES=None):
+        assert UncertaintyScorer.from_env() is None  # unset = off
+    with _env(HYDRAGNN_UNC_SAMPLES="0"):
+        assert UncertaintyScorer.from_env() is None
+    with _env(HYDRAGNN_UNC_SAMPLES="1"):
+        with pytest.raises(ValueError, match="HYDRAGNN_UNC_SAMPLES"):
+            UncertaintyScorer.from_env()
+    with _env(HYDRAGNN_UNC_SAMPLES="two"):
+        with pytest.raises(ValueError, match="HYDRAGNN_UNC_SAMPLES"):
+            UncertaintyScorer.from_env()
+    with _env(HYDRAGNN_UNC_SAMPLES="3", HYDRAGNN_UNC_MODE="bayes"):
+        with pytest.raises(ValueError, match="HYDRAGNN_UNC_MODE"):
+            UncertaintyScorer.from_env()
+    with _env(HYDRAGNN_UNC_SAMPLES="3", HYDRAGNN_UNC_MODE="ensemble",
+              HYDRAGNN_UNC_SEED="9"):
+        sc = UncertaintyScorer.from_env()
+        assert (sc.mode, sc.samples, sc.seed) == ("ensemble", 3, 9)
+
+
+def pytest_feedback_knob_unit_locks(tmp_path):
+    with _env(HYDRAGNN_FEEDBACK_DIR=None):
+        assert FeedbackSink.from_env() is None  # unset = sink off
+    d = str(tmp_path / "queue")
+    with _env(HYDRAGNN_FEEDBACK_DIR=d, HYDRAGNN_FEEDBACK_MAX_GRAPHS="0"):
+        with pytest.raises(
+            ValueError, match="HYDRAGNN_FEEDBACK_MAX_GRAPHS"
+        ):
+            FeedbackSink.from_env()
+    with _env(HYDRAGNN_FEEDBACK_DIR=d, HYDRAGNN_FEEDBACK_MIN_UNC="nan"):
+        with pytest.raises(ValueError, match="HYDRAGNN_FEEDBACK_MIN_UNC"):
+            FeedbackSink.from_env()
+    with _env(HYDRAGNN_FEEDBACK_DIR=d, HYDRAGNN_FEEDBACK_MAX_GRAPHS="7",
+              HYDRAGNN_FEEDBACK_MAX_PACKS="2",
+              HYDRAGNN_FEEDBACK_MIN_UNC="0.5"):
+        sink = FeedbackSink.from_env()
+        assert (sink.queue_dir, sink.max_graphs, sink.max_packs,
+                sink.min_unc) == (d, 7, 2, 0.5)
+
+
+def pytest_canary_unc_ratio_knob_unit_lock():
+    with _env(HYDRAGNN_CANARY_MAX_UNC_RATIO=None):
+        assert CanaryGates.from_env().max_unc_ratio is None  # gate off
+    with _env(HYDRAGNN_CANARY_MAX_UNC_RATIO="-1"):
+        with pytest.raises(
+            ValueError, match="HYDRAGNN_CANARY_MAX_UNC_RATIO"
+        ):
+            CanaryGates.from_env()
+    with _env(HYDRAGNN_CANARY_MAX_UNC_RATIO="2.5"):
+        assert CanaryGates.from_env().max_unc_ratio == 2.5
+
+
+# ---- canary uncertainty veto ---------------------------------------------
+
+
+def _stats_with_unc(live_unc, canary_unc, n=6):
+    stats = _CandidateStats()
+    heads = [np.ones((4,), np.float32)]
+    for _ in range(n):
+        assert stats.add_sample(
+            heads, heads, bucket=0, live_latency_s=0.01,
+            canary_latency_s=0.01, live_unc=live_unc,
+            canary_unc=canary_unc,
+        )
+    return stats.snapshot()
+
+
+def pytest_canary_uncertainty_veto():
+    gates = CanaryGates(
+        min_samples=6, min_bucket_samples=4, max_unc_ratio=2.0
+    )
+    # canary 5x noisier than live: reject, and the failure names the gate
+    snap = _stats_with_unc([0.01], [0.05])
+    verdict = evaluate_gates(snap, gates)
+    assert verdict["verdict"] == "reject"
+    assert any("uncertainty" in f for f in verdict["failures"])
+    # within the ratio: promote
+    assert evaluate_gates(
+        _stats_with_unc([0.01], [0.015]), gates
+    )["verdict"] == "promote"
+    # gate off (max_unc_ratio None) ignores the same evidence
+    off = CanaryGates(min_samples=6, min_bucket_samples=4)
+    assert evaluate_gates(snap, off)["verdict"] == "promote"
+    # no uncertainty evidence (scorer not running): gate skips
+    assert evaluate_gates(
+        _stats_with_unc(None, None), gates
+    )["verdict"] == "promote"
+    # an old snapshot dict without the "uncertainty" key: no KeyError
+    legacy = dict(snap)
+    del legacy["uncertainty"]
+    assert evaluate_gates(legacy, gates)["verdict"] == "promote"
+    # below the per-side sample floor: not enough evidence to veto
+    small = _stats_with_unc([0.01], [0.05], n=3)
+    assert evaluate_gates(
+        small, CanaryGates(min_samples=2, min_bucket_samples=4,
+                           max_unc_ratio=2.0)
+    )["verdict"] == "promote"
+
+
+# ---- feedback sink -------------------------------------------------------
+
+
+def _permuted_copy(g, rng):
+    """Same graph, relabeled nodes + shuffled edge columns — the
+    canonical key must not move."""
+    n = g.x.shape[0]
+    perm = rng.permutation(n)  # perm[old] = new label
+    h = g.clone()
+    inv = np.empty(n, np.int64)
+    inv[perm] = np.arange(n)
+    h.x = g.x[inv]
+    h.pos = g.pos[inv]
+    ei = perm[g.edge_index]
+    cols = rng.permutation(ei.shape[1])
+    h.edge_index = ei[:, cols]
+    return h
+
+
+def pytest_canonical_key_invariant_under_permutation():
+    rng = np.random.default_rng(31)
+    g = _graph(14, rng, with_targets=False)
+    assert canonical_graph_key(_permuted_copy(g, rng)) == \
+        canonical_graph_key(g)
+    other = _graph(14, np.random.default_rng(32), with_targets=False)
+    assert canonical_graph_key(other) != canonical_graph_key(g)
+
+
+def pytest_feedback_sink_dedups_permuted_duplicates(tmp_path):
+    rng = np.random.default_rng(37)
+    sink = FeedbackSink(str(tmp_path / "queue"), max_graphs=64)
+    g = _graph(12, rng, with_targets=False)
+    assert sink.offer(g, drifted=True) is True
+    assert sink.offer(_permuted_copy(g, rng), drifted=True) is False
+    assert sink.offer(_permuted_copy(g, rng), drifted=True) is False
+    assert sink.offer(
+        _graph(12, np.random.default_rng(38), with_targets=False),
+        drifted=True,
+    ) is True
+    st = sink.stats()
+    assert st["accepted"] == 2 and st["deduped"] == 2
+    # admission policy: neither drifted nor above min_unc = not buffered
+    quiet = FeedbackSink(str(tmp_path / "q2"), min_unc=0.5)
+    assert quiet.offer(g, uncertainty=[0.1]) is False
+    assert quiet.offer(g, uncertainty=[0.9]) is True
+    assert quiet.offer(g, uncertainty=[float("nan")]) is False
+
+
+def pytest_feedback_sink_roundtrips_through_shardstore_mix(tmp_path):
+    """The queue dir is a REAL StreamSource input: flushed packs read
+    back through ShardStoreSource into a WeightedMix with every array
+    bitwise intact."""
+    from hydragnn_tpu.data.stream.mix import WeightedMix
+    from hydragnn_tpu.data.stream.source import ShardStoreSource
+
+    rng = np.random.default_rng(41)
+    qdir = str(tmp_path / "queue")
+    sink = FeedbackSink(qdir, max_graphs=3, max_packs=4)
+    originals = {}
+    for seed in range(5):
+        g = _graph(
+            int(rng.integers(6, 16)), np.random.default_rng(100 + seed),
+            with_targets=False,
+        )
+        assert sink.offer(g, drifted=True)
+        originals[canonical_graph_key(g)] = g
+    sink.close()  # flush the partial tail pack
+    st = sink.stats()
+    assert st["graphs"] == 5 and st["packs"] == 2 and st["buffered"] == 0
+
+    src = ShardStoreSource(qdir)
+    mix = WeightedMix([src], seed=1)
+    got = [d for _, d in mix]
+    assert len(got) == 5
+    for d in got:
+        g = originals.pop(canonical_graph_key(d))
+        assert d.x.tobytes() == g.x.tobytes()
+        assert d.pos.tobytes() == g.pos.tobytes()
+        assert d.edge_index.tobytes() == g.edge_index.tobytes()
+    assert not originals  # every offered graph came back exactly once
+
+
+def pytest_feedback_sink_bounded_packs(tmp_path):
+    sink = FeedbackSink(str(tmp_path / "q"), max_graphs=1, max_packs=2)
+    for seed in range(4):
+        sink.offer(
+            _graph(8, np.random.default_rng(200 + seed),
+                   with_targets=False),
+            drifted=True,
+        )
+    st = sink.stats()
+    assert st["packs"] == 2 and st["dropped"] == 2  # disk stays bounded
+    assert sink.offer(None, drifted=True) is False  # never raises
+
+
+# ---- uncertainty scorer (compile-counter-verified) -----------------------
+
+_GAT = {}
+
+
+def _gat_harness():
+    """GAT is the dropout-bearing stack (attention dropout 0.25), so MC
+    dropout produces genuinely nonzero variance."""
+    if _GAT:
+        return _GAT
+    rng = np.random.default_rng(7)
+    samples = [_graph(int(n), rng) for n in rng.integers(4, 24, 24)]
+    samples.append(_graph(24, rng))  # pin the top bucket's capacity
+    model = create_model_config(arch_config("GAT"))
+    trainer = Trainer(
+        model, {"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}}
+    )
+    plan = plan_from_samples(samples, max_batch_graphs=4, num_buckets=2)
+    init_batch, _ = plan.pack([samples[0]], 0)
+    state = trainer.init_state(init_batch)
+    registry = ModelRegistry()
+    registry.register("gat", model, state.params, state.batch_stats)
+    _GAT.update(
+        samples=samples, model=model, state=state, registry=registry,
+        plan=plan,
+    )
+    return _GAT
+
+
+@pytest.mark.slow
+def pytest_scorer_zero_steady_state_recompiles():
+    """The tentpole compile contract: with dropout scoring on, warmup
+    compiles exactly 2 programs per bucket (predict + score) and the
+    counter stays FLAT across mixed traffic; every response carries
+    per-head variance, nonzero for a dropout-bearing model."""
+    h = _gat_harness()
+    scorer = UncertaintyScorer(mode="dropout", samples=3, seed=0)
+    with InferenceServer(
+        h["registry"], h["plan"], max_wait_s=0.002, scorer=scorer
+    ) as server:
+        warm = server.metrics.compiles_total
+        assert warm == h["plan"].num_buckets * 2
+        rng = np.random.default_rng(3)
+        futs = [
+            server.submit(_graph(int(n), rng, with_targets=False))
+            for n in rng.integers(4, 24, 40)
+        ]
+        for f in futs:
+            heads = f.result(120)
+            assert all(np.isfinite(o).all() for o in heads)
+        assert server.metrics.compiles_total == warm  # zero recompiles
+        uncs = [f.uncertainty for f in futs]
+        assert all(u is not None and len(u) == 2 for u in uncs)
+        assert all(
+            v is None or (math.isfinite(v) and v >= 0.0)
+            for u in uncs for v in u
+        )
+        assert any(v and v > 0.0 for u in uncs for v in u)
+        q = server.health()["quality"]
+        assert q["mode"] == "dropout" and q["scored"] >= 40
+        assert q["quantiles"]  # per-(tenant,bucket,head) sketches filled
+
+
+@pytest.mark.slow
+def pytest_scorer_ensemble_variance_across_versions():
+    """Ensemble mode: two registered versions with different weights
+    disagree, so the stacked-member variance is nonzero; the scoring
+    signature tracks the member set (recompile only at promote)."""
+    import jax
+
+    h = _gat_harness()
+    reg = ModelRegistry()
+    reg.register("gat", h["model"], h["state"].params,
+                 h["state"].batch_stats)
+    bumped = jax.tree_util.tree_map(
+        lambda a: np.asarray(a) * 1.05 + 0.02, h["state"].params
+    )
+    reg.register("gat", h["model"], bumped, h["state"].batch_stats)
+    scorer = UncertaintyScorer(mode="ensemble", samples=2, registry=reg)
+    e1, e2 = reg.get("gat", 1), reg.get("gat", 2)
+    assert scorer.signature(e1) != scorer.signature(e2)
+
+    g = _graph(8, np.random.default_rng(9), with_targets=False)
+    batch, _ = h["plan"].pack([g], 0)
+    batch = jax.tree_util.tree_map(np.asarray, batch)
+    variances = [np.asarray(v) for v in jax.device_get(
+        list(scorer.dispatch(e2, batch))
+    )]
+    assert len(variances) == 2
+    assert all(np.isfinite(v).all() and (v >= 0.0).all()
+               for v in variances)
+    assert any(float(np.max(v)) > 0.0 for v in variances)
+
+
+# ---- report / ledger tolerate pre-quality streams ------------------------
+
+
+def pytest_reports_tolerate_streams_without_quality_events(tmp_path):
+    from hydragnn_tpu.obs import ledger as ledger_mod
+    from hydragnn_tpu.obs import report as report_mod
+    from hydragnn_tpu.obs.__main__ import main as obs_main
+
+    log = RunEventLog(str(tmp_path / "events.jsonl"))
+    log.emit("epoch", epoch=0, train_loss=1.0, val_loss=1.1,
+             test_loss=1.2, mode="f32")
+    report = report_mod.build_report(report_mod.load_events(log.path))
+    assert report["quality"] is None  # old stream: section omitted
+    for render in (report_mod.render_text, report_mod.render_markdown):
+        assert "model quality" not in render(report).lower()
+
+    fleet = ledger_mod.build_fleet_report(str(tmp_path))
+    assert fleet["quality"] is None
+    ledger_mod.render_fleet_text(fleet)
+    ledger_mod.render_fleet_markdown(fleet)
+    # `obs drift` on a quality-free dir: usage exit (2), not a crash
+    assert obs_main(["drift", str(tmp_path)]) == 2
+
+
+def pytest_reports_surface_quality_section(tmp_path):
+    from hydragnn_tpu.obs import ledger as ledger_mod
+    from hydragnn_tpu.obs import report as report_mod
+    from hydragnn_tpu.obs.__main__ import main as obs_main
+
+    log = RunEventLog(str(tmp_path / "events.jsonl"))
+    det = DriftDetector(
+        str(tmp_path), window=32, raise_after=1, emit=log.emit
+    )
+    det.on_activate(1)
+    rng = np.random.default_rng(43)
+    base = rng.normal(0.0, 1.0, 32)
+    for vals in (base, base + 9.0):  # bootstrap, then one raising window
+        for v in vals:
+            det.observe("acme", heads=[np.asarray([v])],
+                        uncertainty=[abs(float(v)) * 0.01])
+    assert det.alert_active("acme")
+
+    report = report_mod.build_report(report_mod.load_events(log.path))
+    assert report["quality"] and report["quality"]["alerts_active"]
+    assert "model quality" in report_mod.render_text(report)
+    assert "ACTIVE ALERT" in report_mod.render_text(report)
+    fleet = ledger_mod.build_fleet_report(str(tmp_path))
+    assert fleet["quality"]["alerts_active"]
+    assert "model quality" in ledger_mod.render_fleet_text(fleet)
+    assert obs_main(["drift", str(tmp_path)]) == 0
+    assert obs_main(["drift", str(tmp_path), "--format", "json"]) == 0
+    # prometheus families present for scrapes
+    assert "hydragnn_drift_score" in det.render_prometheus()
